@@ -1,0 +1,18 @@
+"""hefl_tpu — TPU-native homomorphic-encryption federated learning framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+`Homomorphic-Encryption-and-Federated-Learning-based-Privacy-Preserving-CNN-Training-`
+repository (mounted at /root/reference): CNN local training, IID/non-IID federated
+partitioning, RNS-CKKS homomorphic encryption of model weights, and encrypted
+FedAvg aggregation — with one FL client per TPU device and the encrypted
+aggregation running as an XLA collective (`psum` of ciphertext RNS limbs) over ICI.
+
+The reference (FLPyfhelin.py) drives Pyfhel/SEAL one scalar at a time from
+Python and moves ciphertexts as pickle files; here ciphertexts are batched
+`uint32[n_ct, 2, L, N]` device arrays, every hot op is jit-compiled, and the
+"network" between federated parties is the TPU interconnect.
+"""
+
+__version__ = "0.1.0"
+
+from hefl_tpu import ckks  # noqa: F401

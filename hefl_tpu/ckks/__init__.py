@@ -1,0 +1,19 @@
+"""RNS-CKKS homomorphic encryption, TPU-native.
+
+Replaces the reference's Pyfhel 2.3.1 → Microsoft SEAL (C++) dependency
+(`/root/reference/FLPyfhelin.py:27` and SURVEY.md §2.12). The reference used
+BFV with a fractional encoder, one ciphertext per scalar weight; we use the
+modern SIMD-batched equivalent — RNS-CKKS — so one ciphertext carries N
+(default 4096) weight coefficients and every primitive is a batched JAX op
+on `uint32[..., L, N]` residue-number-system limb arrays.
+
+Module map:
+    primes   — host-side number theory (NTT-friendly prime search, roots of unity)
+    modular  — vectorized 32-bit Montgomery arithmetic (the SEAL bignum core, TPU-style)
+    ntt      — negacyclic number-theoretic transform (merged Cooley-Tukey / Gentleman-Sande)
+    encoding — coefficient + canonical-slot encode/decode (the `encryptFrac` analog)
+    keys     — keygen, public/secret/relinearization key material (SURVEY §2.6)
+    ops      — encrypt / decrypt / ct+ct / ct×pt / rescale (SURVEY §2.7, §2.8, §2.10)
+"""
+
+from hefl_tpu.ckks import primes, modular, ntt, encoding, keys, ops  # noqa: F401

@@ -1,0 +1,151 @@
+"""CKKS context and key material (the analog of SURVEY.md §2.6).
+
+The reference's key lifecycle (`gen_pk`/`get_pk`/`get_sk`,
+/root/reference/FLPyfhelin.py:330-364 and :251-261) pickles a live Pyfhel
+object; here keys are plain arrays with an explicit trust split:
+
+  * `PublicMaterial` (context params + pk) — held by every client and by the
+    aggregating server; enough to encrypt and to add ciphertexts.
+  * `SecretKey` — held only by the model owner; the only object that can
+    decrypt. Serialization (utils.serialization) never bundles it with
+    ciphertexts, unlike the reference's `export_weights` wart (SURVEY §5).
+
+Key polynomials are stored in evaluation (NTT) domain, Montgomery form, so
+every use inside encrypt/decrypt is a single fused pointwise multiply.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hefl_tpu.ckks import modular
+from hefl_tpu.ckks.ntt import NTTContext, ntt_forward, to_mont
+from hefl_tpu.ckks.primes import find_ntt_primes
+
+DEFAULT_N = 4096
+DEFAULT_NUM_PRIMES = 3
+DEFAULT_PRIME_BITS = 27   # < 2**27 so a 16-client psum of residues fits int32
+DEFAULT_SCALE = 2.0**30
+DEFAULT_SIGMA = 3.2       # discrete-gaussian noise width (HE-standard default)
+
+
+@dataclasses.dataclass(frozen=True)
+class CkksContext:
+    """Public parameters — the analog of Pyfhel's context
+    (`contextGen(p=65537, m=1024, sec=128)`, FLPyfhelin.py:334-336).
+
+    Security: N=4096 with log2(q) = 3*27 = 81 <= 109 satisfies the
+    HomomorphicEncryption.org 128-bit classical bound for ternary secrets.
+    """
+
+    ntt: NTTContext
+    scale: float = DEFAULT_SCALE
+    sigma: float = DEFAULT_SIGMA
+
+    @classmethod
+    def create(
+        cls,
+        n: int = DEFAULT_N,
+        num_primes: int = DEFAULT_NUM_PRIMES,
+        prime_bits: int = DEFAULT_PRIME_BITS,
+        scale: float = DEFAULT_SCALE,
+        sigma: float = DEFAULT_SIGMA,
+    ) -> "CkksContext":
+        prime_list = find_ntt_primes(num_primes, prime_bits, 2 * n)
+        return cls(ntt=NTTContext.build(prime_list, n), scale=scale, sigma=sigma)
+
+    @property
+    def n(self) -> int:
+        return self.ntt.n
+
+    @property
+    def num_primes(self) -> int:
+        return int(self.ntt.p.shape[0])
+
+    @property
+    def modulus(self) -> int:
+        q = 1
+        for p in np.asarray(self.ntt.p)[:, 0]:
+            q *= int(p)
+        return q
+
+    def __hash__(self):
+        return hash((self.ntt, self.scale, self.sigma))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, CkksContext)
+            and self.ntt == other.ntt
+            and self.scale == other.scale
+            and self.sigma == other.sigma
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SecretKey:
+    s_mont: jax.Array          # uint32[L, N], eval domain, Montgomery form
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PublicKey:
+    b_mont: jax.Array          # uint32[L, N]: -(a*s) + e, eval/Montgomery
+    a_mont: jax.Array          # uint32[L, N]: uniform a, eval/Montgomery
+
+
+def sample_ternary_residues(ctx: CkksContext, key: jax.Array, batch=()) -> jnp.ndarray:
+    """Uniform ternary polynomial {-1,0,1}^N as canonical residues [..., L, N]."""
+    coeffs = jax.random.randint(key, batch + (ctx.n,), -1, 2, dtype=jnp.int32)
+    p = jnp.asarray(ctx.ntt.p).astype(jnp.int32)
+    return jnp.remainder(coeffs[..., None, :], p).astype(jnp.uint32)
+
+
+def sample_gaussian_residues(ctx: CkksContext, key: jax.Array, batch=()) -> jnp.ndarray:
+    """Rounded gaussian noise polynomial (sigma=ctx.sigma, clipped at 6 sigma)."""
+    e = jnp.round(
+        jax.random.normal(key, batch + (ctx.n,), dtype=jnp.float32) * ctx.sigma
+    )
+    e = jnp.clip(e, -6.0 * ctx.sigma, 6.0 * ctx.sigma).astype(jnp.int32)
+    p = jnp.asarray(ctx.ntt.p).astype(jnp.int32)
+    return jnp.remainder(e[..., None, :], p).astype(jnp.uint32)
+
+
+def sample_uniform_eval(ctx: CkksContext, key: jax.Array, batch=()) -> jnp.ndarray:
+    """Uniform element of R_q, sampled directly in eval domain [..., L, N].
+
+    Uniform residues per prime are exactly uniform mod q (CRT bijection), and
+    the NTT is a bijection, so sampling in eval domain is equivalent.
+    """
+    p = jnp.asarray(ctx.ntt.p).astype(jnp.int32)    # [L, 1]
+    u = jax.random.randint(
+        key, batch + (ctx.num_primes, ctx.n), 0, jnp.broadcast_to(p, (ctx.num_primes, ctx.n)),
+        dtype=jnp.int32,
+    )
+    return u.astype(jnp.uint32)
+
+
+@partial(jax.jit, static_argnums=0)
+def keygen(ctx: CkksContext, key: jax.Array) -> tuple[SecretKey, PublicKey]:
+    """RLWE keygen: s ternary; pk = (b, a) with b = -(a s) + e (eval domain).
+
+    Mirrors `HE.keyGen()` (FLPyfhelin.py:336) but as a pure jittable function
+    of an explicit PRNG key.
+    """
+    k_s, k_a, k_e = jax.random.split(key, 3)
+    ntt = ctx.ntt
+    s_eval = ntt_forward(ntt, sample_ternary_residues(ctx, k_s))
+    s_mont = to_mont(ntt, s_eval)
+    a_eval = sample_uniform_eval(ctx, k_a)
+    e_eval = ntt_forward(ntt, sample_gaussian_residues(ctx, k_e))
+    p = jnp.asarray(ntt.p)
+    a_s = modular.mont_mul(a_eval, s_mont, p, jnp.asarray(ntt.pinv_neg))
+    b = modular.add_mod(modular.neg_mod(a_s, p), e_eval, p)
+    return SecretKey(s_mont=s_mont), PublicKey(
+        b_mont=to_mont(ntt, b), a_mont=to_mont(ntt, a_eval)
+    )

@@ -1,0 +1,101 @@
+"""Vectorized 32-bit Montgomery modular arithmetic for TPU.
+
+This is the bignum core that Microsoft SEAL provides the reference in C++
+(SURVEY.md §2.12); here it is expressed as elementwise uint32 ops so XLA maps
+it onto the TPU's 8×128 VPU lanes and fuses it into surrounding kernels.
+
+TPUs have no native 64-bit integer multiply, so the 32×32→64 product is
+assembled from four 16-bit partial products with explicit carry propagation
+(`mul32_wide`), and reduction is Montgomery REDC (`mont_mul`). All functions
+broadcast: residue tensors are typically `uint32[..., L, N]` with per-prime
+constants shaped `uint32[L, 1]`.
+
+Conventions:
+  * residues are canonical (0 <= x < p) uint32
+  * "Montgomery form" of x is x * 2**32 mod p
+  * `mont_mul(a_plain, b_mont) -> (a*b)_plain` — tables are pre-lifted to
+    Montgomery form so data never leaves the plain domain.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_MASK16 = jnp.uint32(0xFFFF)
+
+
+def mul32_wide(a: jnp.ndarray, b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Full 64-bit product of uint32 operands as a (hi, lo) uint32 pair.
+
+    uint32 multiplication in JAX wraps mod 2**32, which makes the 16-bit
+    schoolbook decomposition exact: every partial product of 16-bit halves
+    fits in uint32.
+    """
+    a0 = a & _MASK16
+    a1 = a >> 16
+    b0 = b & _MASK16
+    b1 = b >> 16
+    ll = a0 * b0
+    lh = a0 * b1
+    hl = a1 * b0
+    hh = a1 * b1
+    mid = lh + hl                        # may wrap: carry detected below
+    mid_carry = (mid < lh).astype(jnp.uint32)
+    lo = ll + (mid << 16)                # may wrap
+    lo_carry = (lo < ll).astype(jnp.uint32)
+    hi = hh + (mid >> 16) + (mid_carry << 16) + lo_carry
+    return hi, lo
+
+
+def mont_reduce(hi: jnp.ndarray, lo: jnp.ndarray, p: jnp.ndarray, pinv_neg: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery REDC: (hi*2**32 + lo) * 2**-32 mod p, for hi*2**32+lo < p*2**32.
+
+    `pinv_neg` = -p^{-1} mod 2**32. Result is canonical (< p) for p < 2**31.
+    """
+    m = lo * pinv_neg                    # mod 2**32 by uint32 wraparound
+    mp_hi, mp_lo = mul32_wide(m, p)
+    # lo + mp_lo ≡ 0 (mod 2**32) by construction; it carries iff lo != 0.
+    carry = (lo != 0).astype(jnp.uint32)
+    t = hi + mp_hi + carry               # < 2p < 2**32 for p < 2**31
+    return jnp.where(t >= p, t - p, t)
+
+
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray, p: jnp.ndarray, pinv_neg: jnp.ndarray) -> jnp.ndarray:
+    """a * b * 2**-32 mod p. With b in Montgomery form this is plain a*b mod p."""
+    hi, lo = mul32_wide(a, b)
+    return mont_reduce(hi, lo, p, pinv_neg)
+
+
+def add_mod(a: jnp.ndarray, b: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """(a + b) mod p for canonical inputs; a+b < 2p < 2**32 never wraps."""
+    t = a + b
+    return jnp.where(t >= p, t - p, t)
+
+
+def sub_mod(a: jnp.ndarray, b: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """(a - b) mod p for canonical inputs."""
+    t = a + p - b
+    return jnp.where(t >= p, t - p, t)
+
+
+def neg_mod(a: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """(-a) mod p for canonical input."""
+    return jnp.where(a == 0, a, p - a)
+
+
+def barrett_mod_small(x: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """x mod p for 0 <= x < 2**31 held in int32/uint32 (post-psum reduction).
+
+    Used once after the FedAvg `psum` of residues: with primes < 2**27 and up
+    to 16 clients the lane sum stays below 2**31, so a single remainder
+    restores canonical form. XLA lowers integer Rem natively; this is not in
+    the NTT hot loop.
+    """
+    return jnp.remainder(x.astype(jnp.uint32), p)
+
+
+def to_signed_center(x: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """Map canonical residue to the centered representative in (-p/2, p/2] as int32."""
+    half = p >> 1
+    wrapped = x > half
+    return jnp.where(wrapped, x.astype(jnp.int32) - p.astype(jnp.int32), x.astype(jnp.int32))

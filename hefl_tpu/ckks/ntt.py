@@ -1,0 +1,158 @@
+"""Negacyclic number-theoretic transform over RNS limbs, batched for TPU.
+
+The reference delegates all polynomial arithmetic in Z_q[x]/(x^N+1) to SEAL's
+C++ NTT (via Pyfhel, SURVEY.md §2.12). Here the forward transform is the
+merged Cooley-Tukey decimation-in-time with the 2N-th root folded into
+bit-reversed twiddle tables (Longa-Naehrig style), and the inverse is the
+matching Gentleman-Sande decimation-in-frequency — so no separate psi^i
+pre/post-scaling pass and no runtime bit-reversal permutation.
+
+Shapes: residue tensors are `uint32[..., L, N]` (L = number of RNS primes,
+N = polynomial degree, N in the TPU lane dimension). The log2(N) stages are a
+static Python loop inside jit — XLA sees straight-line vector code, every
+butterfly a fused mul/add across lanes.
+
+Domain convention: "evaluation domain" means bit-reversed NTT order.
+Ciphertexts live their whole life in evaluation domain (add / ct×pt / psum
+are pointwise there); only encode/decode cross back to coefficients.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from hefl_tpu.ckks import primes as primes_mod
+from hefl_tpu.ckks.modular import add_mod, mont_mul, sub_mod
+
+
+@dataclasses.dataclass(frozen=True)
+class NTTContext:
+    """Per-modulus-chain constant tables, all device-ready numpy.
+
+    Built once per CKKS context (host-side bignum in :mod:`primes`), then
+    closed over by the jitted transforms. Everything is `uint32[L, ...]` with
+    twiddles in Montgomery form.
+    """
+
+    n: int
+    logn: int
+    p: np.ndarray             # uint32[L, 1]
+    pinv_neg: np.ndarray      # uint32[L, 1]
+    r2: np.ndarray            # uint32[L, 1]
+    psi_rev: np.ndarray       # uint32[L, N]
+    psi_inv_rev: np.ndarray   # uint32[L, N]
+    n_inv_mont: np.ndarray    # uint32[L, 1]
+
+    @classmethod
+    def build(cls, prime_list: list[int], n: int, seed: int = 0) -> "NTTContext":
+        infos = [primes_mod.PrimeInfo.build(p, n, seed=seed) for p in prime_list]
+        col = lambda attr: np.array([[getattr(i, attr)] for i in infos], dtype=np.uint32)  # noqa: E731
+        return cls(
+            n=n,
+            logn=n.bit_length() - 1,
+            p=col("p"),
+            pinv_neg=col("pinv_neg"),
+            r2=col("r2"),
+            psi_rev=np.stack([i.psi_rev for i in infos]),
+            psi_inv_rev=np.stack([i.psi_inv_rev for i in infos]),
+            n_inv_mont=col("n_inv_mont"),
+        )
+
+    def slice_limbs(self, lo: int, hi: int) -> "NTTContext":
+        """Sub-context over primes [lo, hi) — used by rescale and level drops."""
+        return NTTContext(
+            n=self.n,
+            logn=self.logn,
+            p=self.p[lo:hi],
+            pinv_neg=self.pinv_neg[lo:hi],
+            r2=self.r2[lo:hi],
+            psi_rev=self.psi_rev[lo:hi],
+            psi_inv_rev=self.psi_inv_rev[lo:hi],
+            n_inv_mont=self.n_inv_mont[lo:hi],
+        )
+
+    def __hash__(self):  # static-arg hashing for jit
+        # Twiddle tables are seed-dependent (choice of primitive root), so
+        # they must participate in the jit static-arg identity — otherwise a
+        # context built with a different root could silently reuse a compiled
+        # executable holding the wrong tables as constants.
+        return hash((self.n, tuple(int(x) for x in self.p[:, 0]), self.psi_rev[:, :2].tobytes()))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, NTTContext)
+            and self.n == other.n
+            and np.array_equal(self.p, other.p)
+            and np.array_equal(self.psi_rev, other.psi_rev)
+        )
+
+
+def ntt_forward(ctx: NTTContext, a: jnp.ndarray) -> jnp.ndarray:
+    """Coefficient domain -> evaluation (bit-reversed NTT) domain.
+
+    `a`: uint32[..., L, N] canonical residues. Static unrolled radix-2 CT
+    stages; stage s has m=2**s blocks of half-width t=N/2m, twiddle slice
+    psi_rev[:, m:2m].
+    """
+    n, logn = ctx.n, ctx.logn
+    p = jnp.asarray(ctx.p)
+    pinv = jnp.asarray(ctx.pinv_neg)
+    psi_rev = jnp.asarray(ctx.psi_rev)
+    batch = a.shape[:-2]
+    num_l = a.shape[-2]
+    for s in range(logn):
+        m = 1 << s
+        t = n // (2 * m)
+        blocks = a.reshape(*batch, num_l, m, 2, t)
+        lo = blocks[..., 0, :]
+        hi = blocks[..., 1, :]
+        tw = jnp.asarray(psi_rev[:, m : 2 * m])[:, :, None]          # [L, m, 1]
+        v = mont_mul(hi, tw, p[..., None], pinv[..., None])
+        out_lo = add_mod(lo, v, p[..., None])
+        out_hi = sub_mod(lo, v, p[..., None])
+        a = jnp.stack([out_lo, out_hi], axis=-2).reshape(*batch, num_l, n)
+    return a
+
+
+def ntt_inverse(ctx: NTTContext, a: jnp.ndarray) -> jnp.ndarray:
+    """Evaluation (bit-reversed) domain -> coefficient domain, including the
+    final N^{-1} scaling (folded in as one extra Montgomery multiply)."""
+    n, logn = ctx.n, ctx.logn
+    p = jnp.asarray(ctx.p)
+    pinv = jnp.asarray(ctx.pinv_neg)
+    psi_inv_rev = jnp.asarray(ctx.psi_inv_rev)
+    batch = a.shape[:-2]
+    num_l = a.shape[-2]
+    for s in range(logn - 1, -1, -1):
+        h = 1 << s
+        t = n // (2 * h)
+        blocks = a.reshape(*batch, num_l, h, 2, t)
+        lo = blocks[..., 0, :]
+        hi = blocks[..., 1, :]
+        tw = jnp.asarray(psi_inv_rev[:, h : 2 * h])[:, :, None]      # [L, h, 1]
+        out_lo = add_mod(lo, hi, p[..., None])
+        diff = sub_mod(lo, hi, p[..., None])
+        out_hi = mont_mul(diff, tw, p[..., None], pinv[..., None])
+        a = jnp.stack([out_lo, out_hi], axis=-2).reshape(*batch, num_l, n)
+    return mont_mul(a, jnp.asarray(ctx.n_inv_mont), p, pinv)
+
+
+def pointwise_mul(ctx: NTTContext, a: jnp.ndarray, b_mont: jnp.ndarray) -> jnp.ndarray:
+    """Evaluation-domain product a ∘ b where `b_mont` is pre-lifted to
+    Montgomery form (e.g. a key polynomial). Result is plain-domain."""
+    return mont_mul(a, b_mont, jnp.asarray(ctx.p), jnp.asarray(ctx.pinv_neg))
+
+
+def to_mont(ctx: NTTContext, a: jnp.ndarray) -> jnp.ndarray:
+    """Lift residues to Montgomery form (multiply by 2**32 mod p)."""
+    return mont_mul(a, jnp.asarray(ctx.r2), jnp.asarray(ctx.p), jnp.asarray(ctx.pinv_neg))
+
+
+def negacyclic_poly_mul(ctx: NTTContext, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Full coefficient-domain negacyclic product (test/reference path, not hot)."""
+    ea = ntt_forward(ctx, a)
+    eb = to_mont(ctx, ntt_forward(ctx, b))
+    return ntt_inverse(ctx, pointwise_mul(ctx, ea, eb))

@@ -1,0 +1,194 @@
+"""CKKS cipher operations: encrypt, decrypt, add, plaintext-multiply, rescale.
+
+Covers the full homomorphic op surface the reference exercises
+(SURVEY.md §2.7, §2.8, §2.10):
+
+    reference (Pyfhel/SEAL, per scalar)         here (batched, on TPU)
+    -------------------------------------       -------------------------------
+    HE.encryptFrac(w[k])      :217              encrypt(ctx, pk, encode(w), key)
+    HE.decryptFrac(ct)        :295              decode(decrypt(ctx, sk, ct))
+    PyCtxt + PyCtxt           :381              ct_add
+    PyCtxt * plaintext denom  :385              ct_mul_scalar (exact tracked scale)
+    (relin keygen — dead code :357)             not needed: no ct x ct anywhere
+
+Ciphertexts are `Ciphertext(c0, c1, scale)` with components
+`uint32[..., L, N]` living permanently in evaluation (NTT) domain — addition,
+scalar multiply, and the cross-client `psum` are all pointwise there, so the
+aggregation path never runs a transform.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hefl_tpu.ckks import modular
+from hefl_tpu.ckks.keys import (
+    CkksContext,
+    PublicKey,
+    SecretKey,
+    sample_gaussian_residues,
+    sample_ternary_residues,
+)
+from hefl_tpu.ckks.ntt import ntt_forward, ntt_inverse, to_mont
+from hefl_tpu.ckks.primes import host_to_mont
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Ciphertext:
+    """RLWE pair in eval domain. Decrypt(c0 + c1*s) recovers m*scale + noise.
+
+    `scale` is static metadata (python float): the exact cumulative integer
+    factor the plaintext has been multiplied by. Tracking the *exact* applied
+    multiplier (not an idealized Delta^2) means plaintext-scalar multiplies
+    introduce zero scale-quantization error.
+    """
+
+    c0: jax.Array
+    c1: jax.Array
+    scale: float = dataclasses.field(metadata=dict(static=True))
+
+
+@partial(jax.jit, static_argnums=0)
+def encrypt(
+    ctx: CkksContext, pk: PublicKey, m_res: jax.Array, key: jax.Array
+) -> Ciphertext:
+    """Public-key encrypt coefficient-domain residues `m_res` [..., L, N].
+
+    ct = (b*u + e0 + m, a*u + e1), all eval-domain. Batched over leading dims
+    of `m_res` with independent (u, e0, e1) per ciphertext.
+    """
+    batch = m_res.shape[:-2]
+    k_u, k_e0, k_e1 = jax.random.split(key, 3)
+    ntt = ctx.ntt
+    p = jnp.asarray(ntt.p)
+    pinv = jnp.asarray(ntt.pinv_neg)
+    u_eval = ntt_forward(ntt, sample_ternary_residues(ctx, k_u, batch))
+    e0_eval = ntt_forward(ntt, sample_gaussian_residues(ctx, k_e0, batch))
+    e1_eval = ntt_forward(ntt, sample_gaussian_residues(ctx, k_e1, batch))
+    m_eval = ntt_forward(ntt, m_res)
+    c0 = modular.add_mod(
+        modular.add_mod(modular.mont_mul(u_eval, pk.b_mont, p, pinv), e0_eval, p),
+        m_eval,
+        p,
+    )
+    c1 = modular.add_mod(modular.mont_mul(u_eval, pk.a_mont, p, pinv), e1_eval, p)
+    return Ciphertext(c0=c0, c1=c1, scale=ctx.scale)
+
+
+@partial(jax.jit, static_argnums=0)
+def decrypt(ctx: CkksContext, sk: SecretKey, ct: Ciphertext) -> jax.Array:
+    """-> coefficient-domain residues uint32[..., L, N] of m*scale + noise."""
+    p = jnp.asarray(ctx.ntt.p)
+    d_eval = modular.add_mod(
+        ct.c0,
+        modular.mont_mul(ct.c1, sk.s_mont, p, jnp.asarray(ctx.ntt.pinv_neg)),
+        p,
+    )
+    return ntt_inverse(ctx.ntt, d_eval)
+
+
+def ct_add(ctx: CkksContext, a: Ciphertext, b: Ciphertext) -> Ciphertext:
+    """Homomorphic addition (the server op at FLPyfhelin.py:381)."""
+    if a.scale != b.scale:
+        raise ValueError(f"scale mismatch: {a.scale} vs {b.scale}")
+    p = jnp.asarray(ctx.ntt.p)
+    return Ciphertext(
+        c0=modular.add_mod(a.c0, b.c0, p),
+        c1=modular.add_mod(a.c1, b.c1, p),
+        scale=a.scale,
+    )
+
+
+def ct_add_plain(ctx: CkksContext, a: Ciphertext, m_res: jax.Array) -> Ciphertext:
+    """ct + plaintext (coefficient-domain residues encoded at the same scale)."""
+    p = jnp.asarray(ctx.ntt.p)
+    return Ciphertext(
+        c0=modular.add_mod(a.c0, ntt_forward(ctx.ntt, m_res), p),
+        c1=a.c1,
+        scale=a.scale,
+    )
+
+
+def _scalar_mont(ctx: CkksContext, k: int) -> np.ndarray:
+    """Montgomery lift of a small plaintext integer per prime -> uint32[L, 1]."""
+    p = np.asarray(ctx.ntt.p)[:, 0]
+    return np.array([[host_to_mont(int(k), int(pi))] for pi in p], dtype=np.uint32)
+
+
+def ct_mul_scalar(ctx: CkksContext, a: Ciphertext, k: int) -> Ciphertext:
+    """ct * integer plaintext scalar; the FedAvg 1/N step.
+
+    The reference multiplies by the *float* 1/N under BFV's fractional
+    encoder (FLPyfhelin.py:385). Here the scalar is the integer k and the
+    ciphertext's tracked scale absorbs it exactly: decode later divides by
+    scale*k, so representing 1/N costs no precision at all.
+    """
+    k_mont = jnp.asarray(_scalar_mont(ctx, k))
+    p = jnp.asarray(ctx.ntt.p)
+    pinv = jnp.asarray(ctx.ntt.pinv_neg)
+    return Ciphertext(
+        c0=modular.mont_mul(a.c0, k_mont, p, pinv),
+        c1=modular.mont_mul(a.c1, k_mont, p, pinv),
+        scale=a.scale * k,
+    )
+
+
+def ct_mul_plain_poly(ctx: CkksContext, a: Ciphertext, m_res: jax.Array, pt_scale: float) -> Ciphertext:
+    """ct * plaintext polynomial (coefficient-domain residues, encoded at pt_scale)."""
+    m_mont = to_mont(ctx.ntt, ntt_forward(ctx.ntt, m_res))
+    p = jnp.asarray(ctx.ntt.p)
+    pinv = jnp.asarray(ctx.ntt.pinv_neg)
+    return Ciphertext(
+        c0=modular.mont_mul(a.c0, m_mont, p, pinv),
+        c1=modular.mont_mul(a.c1, m_mont, p, pinv),
+        scale=a.scale * pt_scale,
+    )
+
+
+def rescale(ctx: CkksContext, a: Ciphertext) -> tuple["CkksContext", Ciphertext]:
+    """Drop the last RNS limb and divide the plaintext by p_last.
+
+    Standard RNS-CKKS rescale: c'_i = (c_i - [c_last]) * p_last^{-1} mod p_i.
+    Ciphertext limbs live in evaluation domain under *per-prime* twiddles, so
+    the dropped limb must round-trip through the coefficient domain: iNTT
+    under p_last, re-NTT its (canonical, already-reduced — primes descend so
+    p_last is smallest) representative under each head prime, then subtract.
+    Our FedAvg pipeline never strictly needs rescale (one plaintext multiply
+    fits the modulus budget), but it completes the CKKS op surface. Returns
+    the shrunken context alongside the rescaled ciphertext.
+    """
+    num_l = ctx.num_primes
+    if num_l < 2:
+        raise ValueError("cannot rescale at the last level")
+    p_np = np.asarray(ctx.ntt.p)[:, 0]
+    p_last = int(p_np[-1])
+    last_tables = ctx.ntt.slice_limbs(num_l - 1, num_l)
+    head_tables = ctx.ntt.slice_limbs(0, num_l - 1)
+    p_head = jnp.asarray(head_tables.p)
+    pinv_head = jnp.asarray(head_tables.pinv_neg)
+    inv_mont = jnp.asarray(
+        np.array(
+            [[host_to_mont(pow(p_last % int(pi), int(pi) - 2, int(pi)), int(pi))] for pi in p_np[:-1]],
+            dtype=np.uint32,
+        )
+    )
+
+    def _drop(c: jax.Array) -> jax.Array:
+        c_head, c_last = c[..., :-1, :], c[..., -1:, :]
+        last_coeff = ntt_inverse(last_tables, c_last)               # [..., 1, N] < p_last
+        rep_eval = ntt_forward(head_tables, jnp.broadcast_to(last_coeff, c_head.shape))
+        diff = modular.sub_mod(c_head, rep_eval, p_head)
+        return modular.mont_mul(diff, inv_mont, p_head, pinv_head)
+
+    sub_ctx = CkksContext(
+        ntt=head_tables, scale=ctx.scale, sigma=ctx.sigma
+    )
+    return sub_ctx, Ciphertext(
+        c0=_drop(a.c0), c1=_drop(a.c1), scale=a.scale / p_last
+    )

@@ -1,0 +1,21 @@
+"""Test harness config: run on a virtual 8-device CPU mesh.
+
+The reference "tests" multi-client behavior only by sequential in-process
+simulation (SURVEY.md §4). We instead emulate an 8-device TPU topology on CPU
+so the one-client-per-device shard_map paths run in CI without hardware.
+
+The ambient environment preimports JAX with the platform pinned to the single
+real TPU (sitecustomize), so plain env-var edits here are too late for the
+platform choice; `jax.config.update` still works because no backend has been
+initialized at conftest-import time.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,123 @@
+"""Scheme-level CKKS property tests (the SURVEY.md §4 test pyramid, tier 2):
+
+  decrypt(encrypt(m)) ≈ m                       (roundtrip within noise)
+  decrypt(ct_a + ct_b) ≈ a + b                  (homomorphic add — FLPyfhelin.py:381 analog)
+  decrypt((ct_a + ct_b) * k) / (k*N) ≈ mean     (the encrypted-FedAvg algebra — :385 analog)
+  rescale correctness within its rounding bound
+  wrong secret key decrypts to garbage          (sanity on the trust split)
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.ckks import encoding, ops
+from hefl_tpu.ckks.keys import CkksContext, SecretKey, keygen
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return CkksContext.create()
+
+
+@pytest.fixture(scope="module")
+def keys(ctx):
+    return keygen(ctx, jax.random.key(42))
+
+
+def _weights(seed, shape=(4096,), scale=0.1):
+    return np.random.default_rng(seed).normal(0, scale, size=shape).astype(np.float32)
+
+
+def test_encode_decode_exact_roundtrip(ctx):
+    w = _weights(0)
+    m = encoding.encode(ctx.ntt, jnp.asarray(w), ctx.scale)
+    back = encoding.decode_exact(ctx.ntt, np.asarray(m), ctx.scale)
+    # Only encode rounding: half an lsb of the scale.
+    assert np.max(np.abs(back - w)) <= 0.5 / ctx.scale + 1e-12
+
+
+def test_device_decode_matches_exact(ctx, keys):
+    sk, pk = keys
+    w = _weights(1)
+    ct = ops.encrypt(ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(w), ctx.scale), jax.random.key(0))
+    res = np.asarray(ops.decrypt(ctx, sk, ct))
+    exact = encoding.decode_exact(ctx.ntt, res, ct.scale)
+    dev = np.asarray(encoding.decode(ctx.ntt, jnp.asarray(res), ct.scale))
+    np.testing.assert_allclose(dev, exact, atol=2e-6)
+
+
+def test_encrypt_decrypt_roundtrip(ctx, keys):
+    sk, pk = keys
+    w = _weights(2, shape=(3, 4096))     # batched ciphertexts
+    ct = ops.encrypt(ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(w), ctx.scale), jax.random.key(1))
+    got = np.asarray(encoding.decode(ctx.ntt, ops.decrypt(ctx, sk, ct), ct.scale))
+    assert np.max(np.abs(got - w)) < 5e-6
+
+
+def test_homomorphic_add_and_fedavg_scalar(ctx, keys):
+    sk, pk = keys
+    n_clients = 4
+    ws = [_weights(10 + i) for i in range(n_clients)]
+    cts = [
+        ops.encrypt(ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(w), ctx.scale), jax.random.key(100 + i))
+        for i, w in enumerate(ws)
+    ]
+    acc = cts[0]
+    for ct in cts[1:]:
+        acc = ops.ct_add(ctx, acc, ct)
+    k = 2**15 // n_clients
+    avg_ct = ops.ct_mul_scalar(ctx, acc, k)
+    # decode dividing by scale * n_clients => the mean; k is tracked exactly.
+    got = np.asarray(
+        encoding.decode(ctx.ntt, ops.decrypt(ctx, sk, avg_ct), avg_ct.scale * n_clients)
+    )
+    want = np.mean(ws, axis=0)
+    assert np.max(np.abs(got - want)) < 5e-6
+
+
+def test_ct_add_rejects_scale_mismatch(ctx, keys):
+    sk, pk = keys
+    w = _weights(3)
+    ct = ops.encrypt(ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(w), ctx.scale), jax.random.key(2))
+    scaled = ops.ct_mul_scalar(ctx, ct, 7)
+    with pytest.raises(ValueError):
+        ops.ct_add(ctx, ct, scaled)
+
+
+def test_rescale(ctx, keys):
+    sk, pk = keys
+    w = _weights(4)
+    ct = ops.encrypt(ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(w), ctx.scale), jax.random.key(3))
+    ct = ops.ct_mul_scalar(ctx, ct, 2**14)
+    sub_ctx, ct_r = ops.rescale(ctx, ct)
+    assert ct_r.c0.shape[-2] == ctx.num_primes - 1
+    sk_sub = SecretKey(s_mont=sk.s_mont[:-1])
+    got = np.asarray(encoding.decode(sub_ctx.ntt, ops.decrypt(sub_ctx, sk_sub, ct_r), ct_r.scale))
+    # rescale rounding noise ~ ||s||_1 / (scale / p_last)
+    p_last = int(np.asarray(ctx.ntt.p)[-1, 0])
+    bound = 4.0 * ctx.n / (ct.scale / p_last)
+    assert np.max(np.abs(got - w)) < bound
+
+
+def test_wrong_key_garbage(ctx, keys):
+    sk, pk = keys
+    w = _weights(5)
+    ct = ops.encrypt(ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(w), ctx.scale), jax.random.key(4))
+    sk2, _ = keygen(ctx, jax.random.key(7))
+    got = np.asarray(encoding.decode(ctx.ntt, ops.decrypt(ctx, sk2, ct), ct.scale))
+    assert np.mean(np.abs(got)) > 1e3
+
+
+def test_ct_mul_plain_poly(ctx, keys):
+    sk, pk = keys
+    w = _weights(6)
+    mask = np.zeros(4096, dtype=np.float32)
+    mask[0] = 1.0                      # multiply by the constant polynomial "1"
+    ct = ops.encrypt(ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(w), ctx.scale), jax.random.key(5))
+    pt_scale = 2.0**14
+    m_res = encoding.encode(ctx.ntt, jnp.asarray(mask), pt_scale)
+    ct2 = ops.ct_mul_plain_poly(ctx, ct, m_res, pt_scale)
+    got = np.asarray(encoding.decode(ctx.ntt, ops.decrypt(ctx, sk, ct2), ct2.scale))
+    assert np.max(np.abs(got - w)) < 5e-5

@@ -1,0 +1,96 @@
+"""Unit tests for the Montgomery modular core against exact Python bignum.
+
+This is the "unit tests for HE kernels against exact reference arithmetic"
+tier of the test pyramid designed in SURVEY.md §4 (the reference itself ships
+no tests).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from hefl_tpu.ckks import modular, primes
+
+
+def _rand_u32(rng, shape, bound):
+    return rng.integers(0, bound, size=shape, dtype=np.uint64).astype(np.uint32)
+
+
+def test_is_prime_small():
+    known = {2, 3, 5, 7, 11, 13, 17, 19, 23, 65537}
+    for n in range(2, 100):
+        assert primes.is_prime(n) == all(n % d for d in range(2, n)), n
+    for n in known:
+        assert primes.is_prime(n)
+    assert not primes.is_prime(65536)
+
+
+def test_find_ntt_primes_properties():
+    two_n = 8192
+    ps = primes.find_ntt_primes(4, 27, two_n)
+    assert len(set(ps)) == 4
+    for p in ps:
+        assert p < 2**27
+        assert p % two_n == 1
+        assert primes.is_prime(p)
+
+
+def test_mul32_wide_exact():
+    rng = np.random.default_rng(0)
+    a = _rand_u32(rng, (1000,), 2**32)
+    b = _rand_u32(rng, (1000,), 2**32)
+    hi, lo = modular.mul32_wide(jnp.asarray(a), jnp.asarray(b))
+    got = np.asarray(hi, dtype=np.uint64) << 32 | np.asarray(lo, dtype=np.uint64)
+    want = a.astype(np.uint64) * b.astype(np.uint64)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_mont_mul_matches_bignum():
+    rng = np.random.default_rng(1)
+    for p in primes.find_ntt_primes(3, 27, 8192) + primes.find_ntt_primes(1, 30, 8192):
+        info = primes.PrimeInfo.build(p, 8)  # n irrelevant for modmul constants
+        a = _rand_u32(rng, (512,), p)
+        b = _rand_u32(rng, (512,), p)
+        b_mont = (b.astype(object) * (1 << 32) % p).astype(np.uint64).astype(np.uint32)
+        got = modular.mont_mul(
+            jnp.asarray(a), jnp.asarray(b_mont),
+            jnp.uint32(p), jnp.uint32(info.pinv_neg),
+        )
+        want = (a.astype(np.uint64) * b.astype(np.uint64)) % p
+        np.testing.assert_array_equal(np.asarray(got, dtype=np.uint64), want)
+
+
+def test_add_sub_neg_mod():
+    rng = np.random.default_rng(2)
+    p = primes.find_ntt_primes(1, 27, 8192)[0]
+    a = _rand_u32(rng, (256,), p)
+    b = _rand_u32(rng, (256,), p)
+    pj = jnp.uint32(p)
+    np.testing.assert_array_equal(
+        np.asarray(modular.add_mod(jnp.asarray(a), jnp.asarray(b), pj)),
+        (a.astype(np.uint64) + b) % p,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(modular.sub_mod(jnp.asarray(a), jnp.asarray(b), pj)),
+        (a.astype(np.int64) - b + p) % p,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(modular.neg_mod(jnp.asarray(a), pj)),
+        (-a.astype(np.int64)) % p,
+    )
+
+
+def test_barrett_mod_small_post_psum_range():
+    rng = np.random.default_rng(3)
+    p = primes.find_ntt_primes(1, 27, 8192)[0]
+    # Sum of 16 canonical residues: the exact post-psum range.
+    x = rng.integers(0, 16 * (p - 1), size=(512,), dtype=np.int64).astype(np.int32)
+    got = modular.barrett_mod_small(jnp.asarray(x), jnp.uint32(p))
+    np.testing.assert_array_equal(np.asarray(got, dtype=np.int64), x.astype(np.int64) % p)
+
+
+def test_to_signed_center():
+    p = primes.find_ntt_primes(1, 27, 8192)[0]
+    x = np.array([0, 1, p // 2, p // 2 + 1, p - 1], dtype=np.uint32)
+    got = np.asarray(modular.to_signed_center(jnp.asarray(x), jnp.uint32(p)))
+    want = np.array([0, 1, p // 2, p // 2 + 1 - p, -1], dtype=np.int64)
+    np.testing.assert_array_equal(got.astype(np.int64), want)

@@ -1,0 +1,74 @@
+"""NTT correctness vs an exact bignum negacyclic-convolution model (SURVEY §4)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from hefl_tpu.ckks import modular, primes
+from hefl_tpu.ckks.ntt import NTTContext, negacyclic_poly_mul, ntt_forward, ntt_inverse
+
+
+def _ctx(n, n_primes=2, bits=27):
+    return NTTContext.build(primes.find_ntt_primes(n_primes, bits, 2 * n), n)
+
+
+def _rand_poly(rng, ctx, batch=()):
+    l = ctx.p.shape[0]
+    out = np.empty(batch + (l, ctx.n), dtype=np.uint32)
+    for i in range(l):
+        out[..., i, :] = rng.integers(0, int(ctx.p[i, 0]), size=batch + (ctx.n,), dtype=np.uint64)
+    return out
+
+
+def _naive_negacyclic(a, b, p):
+    """Exact negacyclic convolution mod p over Python ints."""
+    n = len(a)
+    out = [0] * n
+    for i in range(n):
+        for j in range(n):
+            k = i + j
+            term = int(a[i]) * int(b[j])
+            if k >= n:
+                out[k - n] = (out[k - n] - term) % p
+            else:
+                out[k] = (out[k] + term) % p
+    return np.array(out, dtype=np.uint64)
+
+
+def test_roundtrip_small():
+    ctx = _ctx(16)
+    rng = np.random.default_rng(0)
+    a = _rand_poly(rng, ctx)
+    back = ntt_inverse(ctx, ntt_forward(ctx, jnp.asarray(a)))
+    np.testing.assert_array_equal(np.asarray(back), a)
+
+
+def test_roundtrip_full_size_batched():
+    ctx = _ctx(4096, n_primes=3)
+    rng = np.random.default_rng(1)
+    a = _rand_poly(rng, ctx, batch=(3,))
+    back = ntt_inverse(ctx, ntt_forward(ctx, jnp.asarray(a)))
+    np.testing.assert_array_equal(np.asarray(back), a)
+
+
+def test_pointwise_mul_is_negacyclic_convolution():
+    n = 32
+    ctx = _ctx(n, n_primes=2)
+    rng = np.random.default_rng(2)
+    a = _rand_poly(rng, ctx)
+    b = _rand_poly(rng, ctx)
+    got = np.asarray(negacyclic_poly_mul(ctx, jnp.asarray(a), jnp.asarray(b)))
+    for i in range(2):
+        p = int(ctx.p[i, 0])
+        want = _naive_negacyclic(a[i], b[i], p)
+        np.testing.assert_array_equal(got[i].astype(np.uint64), want)
+
+
+def test_ntt_is_linear_mod_p():
+    ctx = _ctx(64)
+    rng = np.random.default_rng(3)
+    a = _rand_poly(rng, ctx)
+    b = _rand_poly(rng, ctx)
+    p = jnp.asarray(ctx.p)
+    lhs = ntt_forward(ctx, modular.add_mod(jnp.asarray(a), jnp.asarray(b), p))
+    rhs = modular.add_mod(ntt_forward(ctx, jnp.asarray(a)), ntt_forward(ctx, jnp.asarray(b)), p)
+    np.testing.assert_array_equal(np.asarray(lhs), np.asarray(rhs))
