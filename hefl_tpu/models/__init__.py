@@ -1,0 +1,55 @@
+"""Model zoo — TPU-first flax.linen modules.
+
+The reference has exactly one model: a Sequential Keras 6-conv CNN built by
+`create_model` (/root/reference/FLPyfhelin.py:118-146, SURVEY.md §2.3). We
+reproduce it bit-for-bit in architecture (`MedCNN`: 222,722 params at
+256x256x3) and add the two models the baseline configs call for
+(BASELINE.json): `SmallCNN` (2-conv MNIST) and `ResNet20` (CIFAR-10).
+
+All models are pure functions of (params, batch) under jit; compute runs in
+bfloat16 on the MXU with float32 parameters/accumulation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hefl_tpu.models.cnn import MedCNN, SmallCNN, count_params
+from hefl_tpu.models.resnet import ResNet20
+
+MODEL_REGISTRY = {
+    "medcnn": MedCNN,
+    "smallcnn": SmallCNN,
+    "resnet20": ResNet20,
+}
+
+
+def create_model(
+    name: str = "medcnn",
+    num_classes: int = 2,
+    input_shape: tuple[int, int, int] = (256, 256, 3),
+    rng: jax.Array | None = None,
+):
+    """Build (module, params) — the analog of `create_model()` at
+    FLPyfhelin.py:118 (minus the load-path branch, which lives in
+    utils.checkpoint where loading belongs).
+    """
+    if name not in MODEL_REGISTRY:
+        raise ValueError(f"unknown model {name!r}; available: {sorted(MODEL_REGISTRY)}")
+    module = MODEL_REGISTRY[name](num_classes=num_classes)
+    if rng is None:
+        rng = jax.random.key(0)
+    dummy = jnp.zeros((1, *input_shape), jnp.float32)
+    params = module.init(rng, dummy)["params"]
+    return module, params
+
+
+__all__ = [
+    "MedCNN",
+    "SmallCNN",
+    "ResNet20",
+    "create_model",
+    "count_params",
+    "MODEL_REGISTRY",
+]

@@ -1,0 +1,74 @@
+"""CNN models matching the reference architecture exactly.
+
+`MedCNN` reproduces `create_model` (/root/reference/FLPyfhelin.py:118-146):
+six [Conv2D 3x3 VALID -> ReLU -> MaxPool 2x2] stages with filters
+(32, 32, 32, 64, 64, 128), then Flatten -> Dense 128 ReLU -> Dense 64 ReLU
+-> Dense num_classes softmax. At 256x256x3 input the feature maps run
+254->127, 125->62, 60->30, 28->14, 12->6, 4->2 so flatten = 2*2*128 = 512
+and the parameter count is exactly 222,722 in 18 weight tensors
+(SURVEY.md §2.3) — the HE sizing contract for the encrypted FedAvg path.
+
+TPU notes: convolutions and matmuls run in bfloat16 (MXU-native) with
+float32 params and float32 accumulation; shapes are static so XLA tiles
+everything onto the systolic array. The softmax is NOT part of the model by
+default (we return logits and fold it into the loss, the numerically-stable
+JAX idiom); `apply_softmax=True` recovers the Keras probs-output behavior
+for prediction parity.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MedCNN(nn.Module):
+    """The reference's medical-image CNN (FLPyfhelin.py:118-141), 222,722
+    params at 256x256x3 with the default fields.
+
+    Fully parameterized: `features` sets the conv stack, `dense` the ReLU
+    head widths — smaller variants (e.g. the 2-conv MNIST model) are just
+    different field values.
+    """
+
+    num_classes: int = 2
+    features: Sequence[int] = (32, 32, 32, 64, 64, 128)
+    dense: Sequence[int] = (128, 64)
+    apply_softmax: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        for f in self.features:
+            x = nn.Conv(
+                f,
+                (3, 3),
+                padding="VALID",
+                dtype=jnp.bfloat16,
+                param_dtype=jnp.float32,
+            )(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for d in self.dense:
+            x = nn.Dense(d, dtype=jnp.bfloat16, param_dtype=jnp.float32)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.bfloat16, param_dtype=jnp.float32)(x)
+        x = x.astype(jnp.float32)
+        return nn.softmax(x) if self.apply_softmax else x
+
+
+class SmallCNN(MedCNN):
+    """2-conv CNN for the MNIST baseline configs (BASELINE.json configs 1-2):
+    MedCNN's architecture vocabulary scaled to 28x28x1."""
+
+    num_classes: int = 10
+    features: Sequence[int] = (32, 64)
+    dense: Sequence[int] = (128,)
+
+
+def count_params(params) -> int:
+    """Total scalar parameter count of a pytree (222,722 for MedCNN@256)."""
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

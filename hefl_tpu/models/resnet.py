@@ -1,0 +1,71 @@
+"""ResNet-20 (CIFAR-10 variant) for the 16-client baseline config.
+
+BASELINE.json config 5: "16-client encrypted FedAvg of ResNet-20 on
+CIFAR-10 (one client per TPU core)". The reference repo contains no ResNet;
+this is the standard He et al. CIFAR depth-20 network: 3 stages of 3 basic
+blocks with widths (16, 32, 64), stride-2 downsampling between stages,
+global average pool, linear head — 0.27M params.
+
+FL-specific design choice: normalization is GroupNorm, not BatchNorm.
+BatchNorm's running statistics are client-local state that poisons FedAvg
+(the classic non-IID failure mode) and adds non-parameter state to the
+encrypted aggregation payload; GroupNorm keeps every learnable a plain
+weight so the ciphertext packing covers the whole model.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(
+            self.features, (3, 3), strides=(self.stride, self.stride),
+            padding="SAME", use_bias=False,
+            dtype=jnp.bfloat16, param_dtype=jnp.float32,
+        )(x)
+        y = nn.GroupNorm(num_groups=8, dtype=jnp.float32)(y)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.features, (3, 3), padding="SAME", use_bias=False,
+            dtype=jnp.bfloat16, param_dtype=jnp.float32,
+        )(y)
+        y = nn.GroupNorm(num_groups=8, dtype=jnp.float32)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.features, (1, 1), strides=(self.stride, self.stride),
+                use_bias=False, dtype=jnp.bfloat16, param_dtype=jnp.float32,
+            )(residual)
+            residual = nn.GroupNorm(num_groups=8, dtype=jnp.float32)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet20(nn.Module):
+    num_classes: int = 10
+    stage_sizes: tuple[int, ...] = (3, 3, 3)
+    widths: tuple[int, ...] = (16, 32, 64)
+    apply_softmax: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(
+            self.widths[0], (3, 3), padding="SAME", use_bias=False,
+            dtype=jnp.bfloat16, param_dtype=jnp.float32,
+        )(x)
+        x = nn.GroupNorm(num_groups=8, dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        for stage, (blocks, width) in enumerate(zip(self.stage_sizes, self.widths)):
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = BasicBlock(width, stride)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.bfloat16, param_dtype=jnp.float32)(x)
+        x = x.astype(jnp.float32)
+        return nn.softmax(x) if self.apply_softmax else x
