@@ -1,0 +1,48 @@
+"""Data pipeline — the TPU-first analog of SURVEY.md §2.2.
+
+The reference's pipeline is pandas + Keras `ImageDataGenerator`
+(/root/reference/FLPyfhelin.py:38-114): scan class folders into a
+(Path, Label) DataFrame, shuffle once, slice contiguously per client, and
+stream augmented 256x256 batches. Here:
+
+    reference                      here
+    -------------------------      ------------------------------------
+    prep_df                        folder.scan_image_folder
+    ImageDataGenerator(rescale)    whole-dataset uint8 arrays + augment.*
+    get_train_data slicing         partition.iid_contiguous (same
+                                   remainder-drop semantics) and
+                                   partition.label_skew (non-IID, new)
+    flow_from_dataframe batches    batches.Batcher — static-shape,
+                                   drop-remainder, device-resident
+
+Datasets are materialized as uint8 host arrays once, then live on device;
+batches have static shapes so everything downstream jits. Synthetic
+generators (data.synthetic) stand in for MNIST/CIFAR/medical images in a
+zero-egress environment while keeping the exact shapes/cardinalities of
+BASELINE.json's configs.
+"""
+
+from hefl_tpu.data.batches import Batcher, one_hot
+from hefl_tpu.data.folder import load_image_dataset, scan_image_folder
+from hefl_tpu.data.partition import (
+    client_slice,
+    iid_contiguous,
+    label_skew,
+    stack_federated,
+    train_val_split,
+)
+from hefl_tpu.data.synthetic import DATASETS, make_dataset
+
+__all__ = [
+    "Batcher",
+    "one_hot",
+    "scan_image_folder",
+    "load_image_dataset",
+    "iid_contiguous",
+    "label_skew",
+    "client_slice",
+    "train_val_split",
+    "stack_federated",
+    "make_dataset",
+    "DATASETS",
+]

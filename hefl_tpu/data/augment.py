@@ -1,0 +1,78 @@
+"""Jittable image augmentation — the `ImageDataGenerator` analog.
+
+The reference's training generator (/root/reference/FLPyfhelin.py:81-88)
+applies rescale=1/255, shear_range=0.2, zoom_range=0.2,
+horizontal_flip=True. Keras does this per-image on the host with PIL-style
+affine warps; here the whole batch is warped on device inside the jitted
+train step: one random affine (shear ∘ zoom ∘ flip) per image, applied via
+bilinear `map_coordinates` — so augmentation rides the TPU's vector units
+and the input pipeline never returns to the host.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _affine_grid(h: int, w: int, mat: jnp.ndarray) -> jnp.ndarray:
+    """Sample coordinates for a 2x2 center-anchored affine `mat` -> [2, H, W]."""
+    yy, xx = jnp.mgrid[0:h, 0:w]
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    y = yy.astype(jnp.float32) - cy
+    x = xx.astype(jnp.float32) - cx
+    src_y = mat[0, 0] * y + mat[0, 1] * x + cy
+    src_x = mat[1, 0] * y + mat[1, 1] * x + cx
+    return jnp.stack([src_y, src_x])
+
+
+def _warp_one(img: jnp.ndarray, mat: jnp.ndarray) -> jnp.ndarray:
+    """Bilinear warp of one HWC image by the inverse-map matrix `mat`."""
+    h, w = img.shape[0], img.shape[1]
+    grid = _affine_grid(h, w, mat)
+    warp = lambda ch: jax.scipy.ndimage.map_coordinates(  # noqa: E731
+        ch, [grid[0], grid[1]], order=1, mode="nearest"
+    )
+    return jax.vmap(warp, in_axes=2, out_axes=2)(img)
+
+
+@partial(jax.jit, static_argnames=("shear", "zoom", "flip"))
+def random_augment(
+    key: jax.Array,
+    images: jnp.ndarray,
+    shear: float = 0.2,
+    zoom: float = 0.2,
+    flip: bool = True,
+) -> jnp.ndarray:
+    """Batch [B, H, W, C] float images -> augmented batch, one random
+    (shear, zoom, horizontal-flip) affine per image.
+
+    Ranges follow Keras semantics: shear angle ~ U(-shear, shear) radians,
+    zoom factor ~ U(1-zoom, 1+zoom) per axis, flip with prob 0.5.
+    """
+    b = images.shape[0]
+    k_shear, k_zx, k_zy, k_flip = jax.random.split(key, 4)
+    s = jax.random.uniform(k_shear, (b,), minval=-shear, maxval=shear)
+    zx = jax.random.uniform(k_zx, (b,), minval=1.0 - zoom, maxval=1.0 + zoom)
+    zy = jax.random.uniform(k_zy, (b,), minval=1.0 - zoom, maxval=1.0 + zoom)
+    f = jnp.where(
+        flip, jnp.sign(jax.random.uniform(k_flip, (b,)) - 0.5), jnp.ones((b,))
+    )
+    # inverse map: dest -> src.  zoom z means sampling at 1/z; flip negates x;
+    # shear tilts x as a function of y (Keras-style shear about the center).
+    zeros = jnp.zeros((b,))
+    mat = jnp.stack(
+        [
+            jnp.stack([1.0 / zy, zeros], axis=-1),
+            jnp.stack([jnp.tan(s) / zx, f / zx], axis=-1),
+        ],
+        axis=-2,
+    )  # [B, 2, 2]
+    return jax.vmap(_warp_one)(images, mat)
+
+
+def rescale(images: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [0,255] -> float32 [0,1] (the reference's rescale=1/255)."""
+    return images.astype(jnp.float32) / 255.0

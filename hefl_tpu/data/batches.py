@@ -1,0 +1,46 @@
+"""Static-shape batching for jitted training loops.
+
+The reference streams batches through Keras generator objects
+(/root/reference/FLPyfhelin.py:62-70). Under XLA everything must have a
+static shape, so instead the whole (small) dataset lives on device and an
+epoch is a gather by a [steps, batch] index matrix built per epoch from a
+PRNG key — reshuffled every epoch like Keras `shuffle=True`, with the tail
+partial batch dropped so every step has the same shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def one_hot(labels: jnp.ndarray, num_classes: int) -> jnp.ndarray:
+    """Categorical targets, matching the reference's class_mode='categorical'."""
+    return jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class Batcher:
+    """Epoch index-plan factory over n samples with a fixed batch size."""
+
+    n: int
+    batch_size: int
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return max(self.n // self.batch_size, 1)
+
+    def epoch_indices(self, key: jax.Array) -> jnp.ndarray:
+        """-> int32[steps, batch] shuffled index plan (jit-friendly)."""
+        perm = jax.random.permutation(key, self.n)
+        usable = self.steps_per_epoch * min(self.batch_size, self.n)
+        return perm[:usable].reshape(self.steps_per_epoch, -1)
+
+    def epoch_indices_eval(self) -> np.ndarray:
+        """Deterministic, unshuffled plan (test/val: shuffle=False in the
+        reference's `get_test_data`, FLPyfhelin.py:63-70)."""
+        usable = self.steps_per_epoch * min(self.batch_size, self.n)
+        return np.arange(usable).reshape(self.steps_per_epoch, -1)

@@ -1,0 +1,63 @@
+"""Image-folder scanning and loading — the `prep_df` analog.
+
+The reference scans `image/Train`/`image/Test` where each subdirectory is a
+class, building a pandas DataFrame of (Path, Label)
+(/root/reference/FLPyfhelin.py:38-55), then lets Keras decode/resize. Here
+the scan returns plain lists (no pandas needed on the hot path) and loading
+decodes with PIL into one dense uint8 array — images are decoded once,
+up-front, not per epoch, because the downstream pipeline is device-resident.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def scan_image_folder(folder: str, shuffle: bool = True, seed: int = 42):
+    """-> (paths: list[str], labels: int32[n], class_names: list[str]).
+
+    Mirrors `prep_df(folder, shuffle=True)` (FLPyfhelin.py:38-55): one
+    subdirectory per class, optional single global shuffle.
+    """
+    class_names = sorted(
+        d for d in os.listdir(folder) if os.path.isdir(os.path.join(folder, d))
+    )
+    paths: list[str] = []
+    labels: list[int] = []
+    for ci, cname in enumerate(class_names):
+        cdir = os.path.join(folder, cname)
+        for fname in sorted(os.listdir(cdir)):
+            if fname.lower().endswith((".png", ".jpg", ".jpeg", ".bmp", ".gif")):
+                paths.append(os.path.join(cdir, fname))
+                labels.append(ci)
+    labels_arr = np.asarray(labels, np.int32)
+    if shuffle:
+        perm = np.random.default_rng(seed).permutation(len(paths))
+        paths = [paths[i] for i in perm]
+        labels_arr = labels_arr[perm]
+    return paths, labels_arr, class_names
+
+
+def load_image_dataset(
+    folder: str,
+    image_size: tuple[int, int] = (256, 256),
+    shuffle: bool = True,
+    seed: int = 42,
+):
+    """Scan + decode a class-per-subdir image folder.
+
+    -> (images uint8[n, H, W, 3], labels int32[n], class_names). The decode
+    target is always RGB at `image_size`, matching the reference's
+    `target_size=image_size` generators (FLPyfhelin.py:63-70).
+    """
+    from PIL import Image
+
+    paths, labels, class_names = scan_image_folder(folder, shuffle, seed)
+    h, w = image_size
+    out = np.empty((len(paths), h, w, 3), np.uint8)
+    for i, p in enumerate(paths):
+        with Image.open(p) as im:
+            out[i] = np.asarray(im.convert("RGB").resize((w, h)), np.uint8)
+    return out, labels, class_names

@@ -18,29 +18,34 @@ import jax.numpy as jnp
 from hefl_tpu.models.cnn import MedCNN, SmallCNN, count_params
 from hefl_tpu.models.resnet import ResNet20
 
-MODEL_REGISTRY = {
-    "medcnn": MedCNN,
-    "smallcnn": SmallCNN,
-    "resnet20": ResNet20,
+# name -> (module class, default num_classes, default input shape): each
+# model's defaults are the dataset it was designed for, so
+# create_model("smallcnn") alone builds the right MNIST-shaped network.
+MODEL_REGISTRY: dict[str, tuple[type, int, tuple[int, int, int]]] = {
+    "medcnn": (MedCNN, 2, (256, 256, 3)),
+    "smallcnn": (SmallCNN, 10, (28, 28, 1)),
+    "resnet20": (ResNet20, 10, (32, 32, 3)),
 }
 
 
 def create_model(
     name: str = "medcnn",
-    num_classes: int = 2,
-    input_shape: tuple[int, int, int] = (256, 256, 3),
+    num_classes: int | None = None,
+    input_shape: tuple[int, int, int] | None = None,
     rng: jax.Array | None = None,
 ):
     """Build (module, params) — the analog of `create_model()` at
     FLPyfhelin.py:118 (minus the load-path branch, which lives in
-    utils.checkpoint where loading belongs).
+    utils.checkpoint where loading belongs). num_classes/input_shape
+    default per model from MODEL_REGISTRY.
     """
     if name not in MODEL_REGISTRY:
         raise ValueError(f"unknown model {name!r}; available: {sorted(MODEL_REGISTRY)}")
-    module = MODEL_REGISTRY[name](num_classes=num_classes)
+    cls, default_classes, default_shape = MODEL_REGISTRY[name]
+    module = cls(num_classes=num_classes if num_classes is not None else default_classes)
     if rng is None:
         rng = jax.random.key(0)
-    dummy = jnp.zeros((1, *input_shape), jnp.float32)
+    dummy = jnp.zeros((1, *(input_shape or default_shape)), jnp.float32)
     params = module.init(rng, dummy)["params"]
     return module, params
 
