@@ -56,10 +56,15 @@ def label_skew(
         cuts = (np.cumsum(p)[:-1] * len(idx)).astype(int)
         for client, part in enumerate(np.split(idx, cuts)):
             per_client[client].extend(part.tolist())
-    # guarantee non-empty: steal one sample for any empty client
+    # guarantee non-empty: steal one sample for any empty client (the donor
+    # must keep at least one — fewer samples than clients can't be repaired)
     for i, lst in enumerate(per_client):
         if not lst:
             donor = max(range(num_clients), key=lambda j: len(per_client[j]))
+            if len(per_client[donor]) < 2:
+                raise ValueError(
+                    f"cannot partition {len(labels)} samples over {num_clients} clients"
+                )
             lst.append(per_client[donor].pop())
     size = max(len(lst) for lst in per_client)
     out = []
@@ -73,12 +78,12 @@ def label_skew(
 
 
 def train_val_split(idx: np.ndarray, val_fraction: float = 0.1):
-    """Tail-held-out validation split, mirroring Keras
-    `validation_split=0.1` (FLPyfhelin.py:97-109): last fraction = val."""
+    """Head-held-out validation split, mirroring Keras
+    `validation_split=0.1` (FLPyfhelin.py:97-109): Keras's DataFrameIterator
+    assigns the FIRST `val_fraction` of rows to subset='validation' and the
+    rest to training, so val = idx[:n_val]."""
     n_val = int(len(idx) * val_fraction)
-    if n_val == 0:
-        return idx, idx[:0]
-    return idx[:-n_val], idx[-n_val:]
+    return idx[n_val:], idx[:n_val]
 
 
 def stack_federated(

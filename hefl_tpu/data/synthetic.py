@@ -110,20 +110,30 @@ def _background(rng: np.random.Generator, n: int, spec: DatasetSpec) -> np.ndarr
 
 
 def make_split(spec: DatasetSpec, n: int, seed: int) -> tuple[np.ndarray, np.ndarray]:
-    """-> (images uint8[n, H, W, C], labels int32[n]), balanced classes."""
+    """-> (images uint8[n, H, W, C], labels int32[n]), balanced classes.
+
+    Generated in chunks so peak host memory stays ~chunk-sized float32
+    intermediates instead of six full-dataset arrays (matters at the
+    medical spec: 1600 x 256 x 256).
+    """
     rng = np.random.default_rng(seed)
     labels = rng.permutation(np.arange(n) % spec.num_classes).astype(np.int32)
-    sig = _class_signal(rng, spec, labels)
-    tmpl = _class_template(spec, labels)
-    tmpl_amp = rng.uniform(0.6, 1.0, size=n).astype(np.float32)[:, None, None]
-    bg = _background(rng, n, spec)
-    noise = rng.normal(0, 0.25, size=sig.shape).astype(np.float32)
-    base = 0.4 * sig + 0.5 * tmpl_amp * tmpl + 0.3 * bg + noise
-    imgs = np.empty((n, spec.height, spec.width, spec.channels), np.float32)
-    for c in range(spec.channels):
-        # slight per-channel gain so channels are informative but correlated
-        imgs[..., c] = base * (1.0 - 0.12 * c)
-    imgs = np.clip((imgs * 0.5 + 0.5) * 255.0, 0, 255).astype(np.uint8)
+    imgs = np.empty((n, spec.height, spec.width, spec.channels), np.uint8)
+    chunk = max(1, min(n, (1 << 24) // (spec.height * spec.width)))
+    for lo in range(0, n, chunk):
+        lab = labels[lo : lo + chunk]
+        k = len(lab)
+        sig = _class_signal(rng, spec, lab)
+        tmpl = _class_template(spec, lab)
+        tmpl_amp = rng.uniform(0.6, 1.0, size=k).astype(np.float32)[:, None, None]
+        bg = _background(rng, k, spec)
+        noise = rng.normal(0, 0.25, size=sig.shape).astype(np.float32)
+        base = 0.4 * sig + 0.5 * tmpl_amp * tmpl + 0.3 * bg + noise
+        for c in range(spec.channels):
+            # slight per-channel gain so channels are informative but correlated
+            imgs[lo : lo + chunk, ..., c] = np.clip(
+                (base * (1.0 - 0.12 * c) * 0.5 + 0.5) * 255.0, 0, 255
+            ).astype(np.uint8)
     return imgs, labels
 
 

@@ -1,0 +1,27 @@
+"""Federated-learning core: local training, FedAvg, encrypted FedAvg.
+
+Reference counterparts (SURVEY.md §2.5, §2.10):
+
+    train_clients        FLPyfhelin.py:179   -> fl.fedavg.fedavg_round
+    model.fit callbacks  FLPyfhelin.py:184-196 -> fl.client functional
+                         (EarlyStopping / ReduceLROnPlateau / best-ckpt)
+    aggregate_encrypted_weights :366         -> fl.secure (CKKS + psum)
+
+The reference simulates clients sequentially in one process; here each
+round is ONE jit-compiled program over the client mesh: every client's
+local epochs run simultaneously (vmap within a device, shard_map across
+devices) and aggregation is a collective.
+"""
+
+from hefl_tpu.fl.config import TrainConfig
+from hefl_tpu.fl.client import local_train
+from hefl_tpu.fl.fedavg import evaluate, fedavg_round
+from hefl_tpu.fl.metrics import classification_metrics
+
+__all__ = [
+    "TrainConfig",
+    "local_train",
+    "fedavg_round",
+    "evaluate",
+    "classification_metrics",
+]

@@ -1,0 +1,165 @@
+"""Functional client-local training with Keras-callback semantics.
+
+The reference's client loop is `model.fit(..., callbacks=[ModelCheckpoint,
+EarlyStopping(patience=5, restore_best_weights=True),
+ReduceLROnPlateau(patience=2, factor=0.3, min_lr=1e-6)])`
+(/root/reference/FLPyfhelin.py:184-196). Keras callbacks are host-side
+mutable objects; here the whole local-training run — SGD steps, validation,
+early stopping, LR plateau, best-weight restore — is ONE pure function
+`local_train` built from `lax.scan`s, so it jits, vmaps across clients on a
+device, and shard_maps across the mesh. Early stopping becomes masking
+(a stopped client's state is frozen through remaining epochs — lockstep
+cost, functional semantics), which is what lets 16 clients with different
+stopping epochs share one compiled program.
+
+Also fixes (knowingly — SURVEY.md §2.5) the reference's quirk of carrying
+one model object across clients: every client here starts exactly from the
+round's global weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from hefl_tpu.data.augment import random_augment, rescale
+from hefl_tpu.fl.config import TrainConfig
+from hefl_tpu.fl.loss import accuracy, cross_entropy, loss_fn
+from hefl_tpu.fl.optimizer import AdamState, adam_init, adam_update
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ClientState:
+    params: object
+    opt: AdamState
+    lr_scale: jax.Array          # f32: ReduceLROnPlateau multiplier
+    best_params: object          # ModelCheckpoint best-by-accuracy
+    best_val_acc: jax.Array
+    best_val_loss: jax.Array
+    wait_es: jax.Array           # epochs since val-loss improvement (early stop)
+    wait_plateau: jax.Array      # epochs since val-loss improvement (LR plateau)
+    stopped: jax.Array           # bool
+
+
+def _eval_metrics(module, params, x_u8, y_onehot):
+    logits = module.apply({"params": params}, rescale(x_u8))
+    return cross_entropy(logits, y_onehot), accuracy(logits, y_onehot)
+
+
+def local_train(
+    module,
+    cfg: TrainConfig,
+    global_params,
+    x: jax.Array,
+    y: jax.Array,
+    key: jax.Array,
+):
+    """Train one client from the global weights.
+
+    x: uint8[m, H, W, C]; y: int32[m]; -> (best_params, metrics f32[E, 4])
+    with metrics columns (val_loss, val_acc, lr_scale, stopped).
+    """
+    m = int(x.shape[0])
+    n_val = max(int(m * cfg.val_fraction), 1) if cfg.val_fraction > 0 else 0
+    n_tr = m - n_val
+    # Keras validation_split semantics: HEAD fraction is validation
+    # (data.partition.train_val_split documents the same convention).
+    x_tr, y_tr = x[n_val:], y[n_val:]
+    if n_val:
+        x_va, y_va = x[:n_val], y[:n_val]
+    else:  # degenerate config: validate on the train slice
+        x_va, y_va = x_tr, y_tr
+    onehot_va = jax.nn.one_hot(y_va, cfg.num_classes, dtype=jnp.float32)
+    bs = min(cfg.batch_size, n_tr)
+    steps = max(n_tr // bs, 1)
+
+    def train_step(carry, inp):
+        params, opt, lr_scale = carry
+        idx, k_aug = inp
+        xb = rescale(x_tr[idx])
+        if cfg.augment:
+            xb = random_augment(
+                k_aug, xb, shear=cfg.aug_shear, zoom=cfg.aug_zoom, flip=cfg.aug_flip
+            )
+        oh = jax.nn.one_hot(y_tr[idx], cfg.num_classes, dtype=jnp.float32)
+        grads, (ce, acc) = jax.grad(
+            lambda p: loss_fn(module, p, xb, oh, global_params, cfg.prox_mu),
+            has_aux=True,
+        )(params)
+        params, opt = adam_update(grads, opt, params, cfg.lr, cfg.lr_decay, lr_scale)
+        return (params, opt, lr_scale), (ce, acc)
+
+    def epoch_step(state: ClientState, k_epoch):
+        k_perm, k_aug = jax.random.split(k_epoch)
+        perm = jax.random.permutation(k_perm, n_tr)[: steps * bs].reshape(steps, bs)
+        aug_keys = jax.random.split(k_aug, steps)
+        (params, opt, _), _ = jax.lax.scan(
+            train_step, (state.params, state.opt, state.lr_scale), (perm, aug_keys)
+        )
+        val_loss, val_acc = _eval_metrics(module, params, x_va, onehot_va)
+
+        # --- callback logic (pure) ---
+        loss_improved = val_loss < state.best_val_loss - cfg.min_delta
+        acc_improved = val_acc > state.best_val_acc
+        wait_es = jnp.where(loss_improved, 0, state.wait_es + 1)
+        wait_pl = jnp.where(loss_improved, 0, state.wait_plateau + 1)
+        plateau = wait_pl >= cfg.plateau_patience
+        lr_floor = cfg.min_lr / cfg.lr if cfg.lr > 0 else 0.0
+        lr_scale = jnp.where(
+            plateau,
+            jnp.maximum(state.lr_scale * cfg.plateau_factor, lr_floor),
+            state.lr_scale,
+        )
+        wait_pl = jnp.where(plateau, 0, wait_pl)
+        stopped_now = wait_es >= cfg.es_patience
+
+        frozen = state.stopped  # already stopped before this epoch
+        pick = lambda new, old: jax.tree_util.tree_map(  # noqa: E731
+            lambda a, b: jnp.where(frozen, b, a), new, old
+        )
+        sel = lambda new, old: jnp.where(frozen, old, new)  # noqa: E731
+        take_best = jnp.logical_and(acc_improved, jnp.logical_not(frozen))
+        new_state = ClientState(
+            params=pick(params, state.params),
+            opt=pick(opt, state.opt),
+            lr_scale=sel(lr_scale, state.lr_scale),
+            best_params=jax.tree_util.tree_map(
+                lambda a, b: jnp.where(take_best, a, b), params, state.best_params
+            ),
+            best_val_acc=sel(jnp.maximum(val_acc, state.best_val_acc), state.best_val_acc),
+            best_val_loss=sel(
+                jnp.minimum(val_loss, state.best_val_loss), state.best_val_loss
+            ),
+            wait_es=sel(wait_es, state.wait_es),
+            wait_plateau=sel(wait_pl, state.wait_plateau),
+            stopped=jnp.logical_or(frozen, stopped_now),
+        )
+        metrics = jnp.stack(
+            [val_loss, val_acc, new_state.lr_scale, new_state.stopped.astype(jnp.float32)]
+        )
+        return new_state, metrics
+
+    state0 = ClientState(
+        params=global_params,
+        opt=adam_init(global_params),
+        lr_scale=jnp.float32(1.0),
+        best_params=global_params,
+        best_val_acc=jnp.float32(-jnp.inf),
+        best_val_loss=jnp.float32(jnp.inf),
+        wait_es=jnp.int32(0),
+        wait_plateau=jnp.int32(0),
+        stopped=jnp.bool_(False),
+    )
+    epoch_keys = jax.random.split(key, cfg.epochs)
+    final, metrics = jax.lax.scan(epoch_step, state0, epoch_keys)
+    # EarlyStopping(restore_best_weights=True): ship the best checkpoint.
+    return final.best_params, metrics
+
+
+# Convenience jitted entry for single-client use (tests, centralized baseline
+# — the analog of `train_server`, FLPyfhelin.py:161).
+local_train_jit = partial(jax.jit, static_argnums=(0, 1))(local_train)

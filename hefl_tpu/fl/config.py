@@ -1,0 +1,33 @@
+"""Training hyperparameter config.
+
+Defaults reproduce the reference exactly (SURVEY.md §2.1, §2.5):
+Adam(lr=1e-3, decay=1e-4) + categorical CE (FLPyfhelin.py:140-141), 10
+local epochs, batch 32, EarlyStopping(patience=5, restore_best_weights)
+(:186), ReduceLROnPlateau(patience=2, factor=0.3, min_lr=1e-6) (:167,188),
+best-checkpoint by accuracy (:169), validation_split=0.1 (:97).
+`prox_mu > 0` enables the FedProx proximal term (BASELINE.json config 4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    epochs: int = 10
+    batch_size: int = 32
+    lr: float = 1e-3
+    lr_decay: float = 1e-4          # Keras-style: lr_t = lr / (1 + decay*step)
+    val_fraction: float = 0.1
+    es_patience: int = 5            # early stopping on val loss
+    plateau_patience: int = 2       # ReduceLROnPlateau on val loss
+    plateau_factor: float = 0.3
+    min_lr: float = 1e-6
+    min_delta: float = 0.0
+    prox_mu: float = 0.0            # FedProx; 0 = plain FedAvg
+    augment: bool = True
+    aug_shear: float = 0.2
+    aug_zoom: float = 0.2
+    aug_flip: bool = True
+    num_classes: int = 2

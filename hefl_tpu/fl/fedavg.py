@@ -1,0 +1,104 @@
+"""Plaintext FedAvg over the client mesh.
+
+Reference flow (one round): `train_clients` sequentially fits each client
+(/root/reference/FLPyfhelin.py:179-198), then the server averages uploads
+(:366-390). Here the entire round — every client's local epochs AND the
+aggregation — is one jit-compiled SPMD program: clients are laid out on the
+``"clients"`` mesh axis (vmap simulates multiple clients per device when
+num_clients > mesh size), and FedAvg is `pmean` over ICI.
+
+The encrypted variant (fl.secure) swaps the pmean for CKKS
+encrypt -> psum-of-limbs -> decrypt without touching this file's training
+path — the two aggregators are drop-in alternatives, which is the
+plaintext-vs-encrypted comparison the reference ships as notebook cell 6.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from hefl_tpu.data.augment import rescale
+from hefl_tpu.fl.client import local_train
+from hefl_tpu.fl.config import TrainConfig
+from hefl_tpu.parallel import CLIENT_AXIS, pmean_tree
+
+
+def fedavg_round(
+    module,
+    cfg: TrainConfig,
+    mesh,
+    global_params,
+    xs: jax.Array,
+    ys: jax.Array,
+    key: jax.Array,
+):
+    """One synchronous FedAvg round.
+
+    xs: uint8[C, m, H, W, ch], ys: int32[C, m] federated arrays (C clients,
+    axis 0 sharded over the mesh). -> (new_global_params, metrics[C, E, 4]).
+    """
+    num_clients = int(xs.shape[0])
+    n_dev = mesh.shape[CLIENT_AXIS]
+    if num_clients % n_dev != 0:
+        raise ValueError(f"{num_clients} clients on {n_dev} devices: must divide")
+    client_keys = jax.random.split(key, num_clients)
+
+    def body(gp, x_blk, y_blk, k_blk):
+        # x_blk: [cpd, m, ...] — this device's clients; vmap trains them
+        # "concurrently" (XLA interleaves), shard_map spans the mesh.
+        train_one = lambda x, y, k: local_train(module, cfg, gp, x, y, k)  # noqa: E731
+        p_out, mets = jax.vmap(train_one)(x_blk, y_blk, k_blk)
+        local_mean = jax.tree_util.tree_map(lambda t: jnp.mean(t, axis=0), p_out)
+        return pmean_tree(local_mean, CLIENT_AXIS), mets
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS)),
+        out_specs=(P(), P(CLIENT_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(fn)(global_params, xs, ys, client_keys)
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def _predict_chunk(module, params, x_u8, apply_softmax: bool):
+    logits = module.apply({"params": params}, rescale(x_u8))
+    return jax.nn.softmax(logits) if apply_softmax else logits
+
+
+def evaluate(
+    module,
+    params,
+    x: np.ndarray,
+    y: np.ndarray,
+    batch_size: int = 32,
+    return_probs: bool = False,
+):
+    """Full-dataset inference + metrics — the `agg_model.predict(test_ds)`
+    + sklearn step of notebook cell 3. Handles the ragged final batch by
+    padding to the chunk size (static shapes for jit) and masking.
+
+    -> dict with accuracy / weighted precision / recall / f1 (+ probs).
+    """
+    from hefl_tpu.fl.metrics import classification_metrics
+
+    n = len(x)
+    pad = (-n) % batch_size
+    x_pad = np.concatenate([x, np.repeat(x[:1], pad, axis=0)]) if pad else x
+    chunks = []
+    for i in range(0, len(x_pad), batch_size):
+        chunks.append(
+            np.asarray(_predict_chunk(module, params, jnp.asarray(x_pad[i : i + batch_size]), True))
+        )
+    probs = np.concatenate(chunks)[:n]
+    out = classification_metrics(y, probs.argmax(-1))
+    if return_probs:
+        out["probs"] = probs
+    return out

@@ -1,0 +1,58 @@
+"""Adam with Keras-style time decay, as a pure pytree transform.
+
+The reference compiles with `Adam(learning_rate=1e-3, decay=1e-4)`
+(/root/reference/FLPyfhelin.py:140): the legacy Keras schedule
+``lr_t = lr / (1 + decay * iterations)`` with standard bias-corrected
+moments. Implemented directly (rather than via optax.adam) because the
+effective learning rate must additionally be scaled at runtime by the
+ReduceLROnPlateau state carried in the client loop — a data-dependent
+multiplier that composes naturally here as one extra operand.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class AdamState:
+    mu: object          # first-moment pytree
+    nu: object          # second-moment pytree
+    step: jax.Array     # int32 scalar
+
+
+def adam_init(params) -> AdamState:
+    zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)  # noqa: E731
+    return AdamState(mu=zeros(), nu=zeros(), step=jnp.int32(0))
+
+
+def adam_update(
+    grads,
+    state: AdamState,
+    params,
+    lr: float,
+    decay: float,
+    lr_scale: jax.Array,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-7,   # Keras default epsilon
+):
+    """-> (new_params, new_state). `lr_scale` is the plateau multiplier."""
+    step = state.step + 1
+    t = step.astype(jnp.float32)
+    lr_t = lr / (1.0 + decay * t) * lr_scale
+    bc1 = 1.0 - b1**t
+    bc2 = 1.0 - b2**t
+    mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads)
+    nu = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * g * g, state.nu, grads)
+    new_params = jax.tree_util.tree_map(
+        lambda p, m, v: p - lr_t * (m / bc1) / (jnp.sqrt(v / bc2) + eps),
+        params,
+        mu,
+        nu,
+    )
+    return new_params, AdamState(mu=mu, nu=nu, step=step)

@@ -45,7 +45,9 @@ def create_model(
     module = cls(num_classes=num_classes if num_classes is not None else default_classes)
     if rng is None:
         rng = jax.random.key(0)
-    dummy = jnp.zeros((1, *(input_shape or default_shape)), jnp.float32)
+    dummy = jnp.zeros(
+        (1, *(input_shape if input_shape is not None else default_shape)), jnp.float32
+    )
     params = module.init(rng, dummy)["params"]
     return module, params
 

@@ -1,0 +1,16 @@
+"""Device-mesh parallelism for federated learning.
+
+The reference "parallelizes" clients by a sequential Python loop in one
+process (SURVEY.md §2.13) and moves bytes between parties as pickle files.
+Here federated data parallelism is real hardware parallelism: a 1-D
+`jax.sharding.Mesh` over the axis ``"clients"``, one (or more) FL clients
+per TPU device under `shard_map`, and the cross-client exchange is an XLA
+collective over ICI — `pmean` of weight pytrees for plaintext FedAvg,
+`psum` of ciphertext RNS limbs (with lazy modular reduction) for the
+encrypted path.
+"""
+
+from hefl_tpu.parallel.mesh import CLIENT_AXIS, local_client_count, make_mesh
+from hefl_tpu.parallel.collectives import psum_mod, pmean_tree
+
+__all__ = ["CLIENT_AXIS", "make_mesh", "local_client_count", "psum_mod", "pmean_tree"]

@@ -1,0 +1,37 @@
+"""Cross-client collectives — the wire layer of the federated system.
+
+Reference equivalent: `export_weights` / `import_encrypted_weights`
+(/root/reference/FLPyfhelin.py:230-240, :303-328) — pickle files standing in
+for a network. Here the "network" is the TPU interconnect and the transfer
+IS the aggregation: one XLA collective per round.
+
+`psum_mod` is the homomorphic-aggregation primitive (SURVEY.md §5,
+"distributed communication backend"): a psum of uint32 RNS residues
+followed by one modular reduction. Residues are < p < 2**27 and the psum
+adds at most 32 of them, so the sum stays < 2**32 with no wraparound —
+lazy reduction, one `%` per round instead of one per pairwise add.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# p < 2**27 (keys.DEFAULT_PRIME_BITS) and sums must stay < 2**32.
+MAX_PSUM_CLIENTS = 32
+
+
+def psum_mod(residues: jax.Array, p: jax.Array, axis_name: str) -> jax.Array:
+    """Modular all-reduce: (Σ_clients residues) mod p, residues uint32[..., L, N].
+
+    The homomorphic FedAvg sum: psum of ciphertext limbs over ICI = ct+ct
+    for every client simultaneously (the reference's loop at
+    FLPyfhelin.py:378-381 collapsed into one collective).
+    """
+    total = jax.lax.psum(residues, axis_name)
+    return jax.lax.rem(total, jnp.broadcast_to(p, total.shape))
+
+
+def pmean_tree(tree, axis_name: str):
+    """Plaintext FedAvg: pmean of a parameter pytree over the client axis."""
+    return jax.tree_util.tree_map(lambda x: jax.lax.pmean(x, axis_name), tree)

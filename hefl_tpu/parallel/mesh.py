@@ -1,0 +1,31 @@
+"""Mesh construction for the one-client-per-device FL topology."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+CLIENT_AXIS = "clients"
+
+
+def make_mesh(num_clients: int, devices: list | None = None) -> Mesh:
+    """1-D mesh over min(num_clients, n_devices) devices, axis "clients".
+
+    When num_clients exceeds the device count (e.g. 16 clients on a v4-8),
+    the client axis of the federated arrays is still sharded over this mesh
+    and each device sequentially simulates `num_clients / n_devices` clients
+    via an inner vmap — see fl.fedavg. num_clients must then divide evenly.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = min(num_clients, len(devs))
+    if num_clients % n != 0:
+        raise ValueError(
+            f"num_clients={num_clients} must be a multiple of mesh size {n}"
+        )
+    return Mesh(np.array(devs[:n]), (CLIENT_AXIS,))
+
+
+def local_client_count(mesh: Mesh, num_clients: int) -> int:
+    """Clients simulated per device (>=1)."""
+    return num_clients // mesh.shape[CLIENT_AXIS]

@@ -34,7 +34,9 @@ def test_train_val_split_matches_keras_validation_split():
     idx = np.arange(800)
     tr, va = train_val_split(idx, 0.1)
     assert len(tr) == 720 and len(va) == 80   # the reference's 720/80
-    assert va.tolist() == list(range(720, 800))
+    # Keras DataFrameIterator: subset='validation' takes the HEAD fraction
+    assert va.tolist() == list(range(0, 80))
+    assert tr.tolist() == list(range(80, 800))
 
 
 def test_label_skew_is_skewed_rectangular_and_lossless():
