@@ -14,6 +14,7 @@ Module map:
     encoding — coefficient + canonical-slot encode/decode (the `encryptFrac` analog)
     keys     — keygen, public/secret/relinearization key material (SURVEY §2.6)
     ops      — encrypt / decrypt / ct+ct / ct×pt / rescale (SURVEY §2.7, §2.8, §2.10)
+    packing  — model-pytree <-> [n_ct, N] plaintext block layout
 """
 
-from hefl_tpu.ckks import primes, modular, ntt, encoding, keys, ops  # noqa: F401
+from hefl_tpu.ckks import primes, modular, ntt, encoding, keys, ops, packing  # noqa: F401

@@ -1,0 +1,59 @@
+"""Pack model parameter pytrees into CKKS plaintext coefficient blocks.
+
+The reference encrypts weights one SCALAR per ciphertext — 222,722 Pyfhel
+calls per client (/root/reference/FLPyfhelin.py:211-221 and SURVEY.md §2.7).
+Here the whole parameter pytree is raveled into one flat vector, padded to a
+multiple of the ring degree N, and reshaped to `[n_ct, N]` — so the MedCNN's
+222,722 parameters fit in ceil(222722/4096) = 55 ciphertexts, and every
+CKKS op is batched over the `n_ct` leading axis.
+
+Shape bookkeeping (which tensor lives where in the flat vector — the
+reference's `'c_{layer}_{j}'` dict keys, FLPyfhelin.py:221) is carried by
+the `unravel` closure from `jax.flatten_util.ravel_pytree`, captured once
+per model template in :class:`PackSpec`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+@dataclasses.dataclass(frozen=True)
+class PackSpec:
+    """Static packing geometry for one model template + ring degree."""
+
+    n: int                                   # ring degree (coeffs per ct)
+    total: int                               # true parameter count
+    n_ct: int                                # ciphertexts per model
+    unravel: Callable[[jax.Array], Any]      # flat[total] -> pytree
+
+    @classmethod
+    def for_params(cls, template_params: Any, n: int) -> "PackSpec":
+        flat, unravel = ravel_pytree(template_params)
+        total = int(flat.size)
+        return cls(n=n, total=total, n_ct=-(-total // n), unravel=unravel)
+
+
+def pack_flat(flat: jax.Array, n: int) -> jax.Array:
+    """float[total] -> float[n_ct, n], zero-padded tail."""
+    total = flat.shape[0]
+    pad = (-total) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype=flat.dtype)])
+    return flat.reshape(-1, n)
+
+
+def pack_pytree(params: Any, n: int) -> jax.Array:
+    """Parameter pytree -> coefficient blocks float32[n_ct, n] (jit-safe)."""
+    flat, _ = ravel_pytree(params)
+    return pack_flat(flat.astype(jnp.float32), n)
+
+
+def unpack_blocks(blocks: jax.Array, spec: PackSpec) -> Any:
+    """float[n_ct, n] -> parameter pytree (drops the zero padding)."""
+    return spec.unravel(blocks.reshape(-1)[: spec.total])

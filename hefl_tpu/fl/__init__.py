@@ -17,6 +17,12 @@ from hefl_tpu.fl.config import TrainConfig
 from hefl_tpu.fl.client import local_train
 from hefl_tpu.fl.fedavg import evaluate, fedavg_round
 from hefl_tpu.fl.metrics import classification_metrics
+from hefl_tpu.fl.secure import (
+    aggregate_encrypted,
+    decrypt_average,
+    encrypt_params,
+    secure_fedavg_round,
+)
 
 __all__ = [
     "TrainConfig",
@@ -24,4 +30,8 @@ __all__ = [
     "fedavg_round",
     "evaluate",
     "classification_metrics",
+    "encrypt_params",
+    "aggregate_encrypted",
+    "decrypt_average",
+    "secure_fedavg_round",
 ]

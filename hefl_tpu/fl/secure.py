@@ -1,0 +1,182 @@
+"""Encrypted FedAvg: the reference's HE pipeline as one SPMD program.
+
+Reference flow (SURVEY.md §3.3-§3.5), all through pickle files:
+
+    export_encrypted_clients_weights  FLPyfhelin.py:242  per-scalar encryptFrac
+    aggregate_encrypted_weights       FLPyfhelin.py:366  per-scalar ct+ct, ct*1/N
+    decrypt_import_weights            FLPyfhelin.py:263  per-scalar decryptFrac
+
+Here each client's trained weights are packed into [n_ct, N] CKKS coefficient
+blocks, encrypted on-device, and the server aggregation is a single
+`psum` of ciphertext RNS limbs over ICI — homomorphic addition of every
+client's every ciphertext in one collective. The 1/N FedAvg scaling costs
+nothing: the decoder divides by `scale * num_clients` (the reference's
+ct × plaintext-1/N step, FLPyfhelin.py:385, exists as `ops.ct_mul_scalar`
+for API parity but the round path never needs the extra multiply).
+
+Trust split preserved (SURVEY.md §2.6): the training/aggregation program
+touches only `PublicKey`; `SecretKey` appears exclusively in
+`decrypt_average`, the model-owner step.
+"""
+
+from __future__ import annotations
+
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from hefl_tpu.ckks import encoding, ops
+from hefl_tpu.ckks.keys import CkksContext, PublicKey, SecretKey
+from hefl_tpu.ckks.ops import Ciphertext
+from hefl_tpu.ckks.packing import PackSpec, pack_pytree, unpack_blocks
+from hefl_tpu.fl.client import local_train
+from hefl_tpu.fl.config import TrainConfig
+from hefl_tpu.parallel import CLIENT_AXIS
+from hefl_tpu.parallel.collectives import MAX_PSUM_CLIENTS, psum_mod
+
+
+@partial(jax.jit, static_argnums=0)
+def encrypt_params(
+    ctx: CkksContext, pk: PublicKey, params, key: jax.Array
+) -> Ciphertext:
+    """Encrypt one client's parameter pytree -> batched Ciphertext [n_ct, L, N].
+
+    The analog of `encrypt_export_weights` (FLPyfhelin.py:200-228), minus the
+    export: 55 batched ciphertexts instead of 222,722 scalar Pyfhel calls.
+    """
+    blocks = pack_pytree(params, ctx.n)
+    m_res = encoding.encode(ctx.ntt, blocks, ctx.scale)
+    return ops.encrypt(ctx, pk, m_res, key)
+
+
+def _lazy_sum_mod(x: jax.Array, p: jax.Array) -> jax.Array:
+    """Sum uint32 residues over axis 0, one reduction at the end.
+
+    Safe for up to MAX_PSUM_CLIENTS summands of <2**27 each (no uint32
+    wraparound) — same lazy-reduction argument as `psum_mod`.
+    """
+    total = jnp.sum(x, axis=0, dtype=jnp.uint32)
+    return jax.lax.rem(total, jnp.broadcast_to(p, total.shape))
+
+
+def aggregate_encrypted(ctx: CkksContext, cts: Ciphertext) -> Ciphertext:
+    """Homomorphic sum of a [C, n_ct, L, N]-batched ciphertext stack.
+
+    The server loop of `aggregate_encrypted_weights` (FLPyfhelin.py:378-381)
+    as one vectorized reduction; works on any host/device, no mesh needed.
+    """
+    num = int(cts.c0.shape[0])
+    if num > MAX_PSUM_CLIENTS:
+        raise ValueError(
+            f"{num} ciphertext stacks exceeds lazy-reduction bound {MAX_PSUM_CLIENTS}"
+        )
+    p = jnp.asarray(ctx.ntt.p)
+    return Ciphertext(
+        c0=_lazy_sum_mod(cts.c0, p),
+        c1=_lazy_sum_mod(cts.c1, p),
+        scale=cts.scale,
+    )
+
+
+def decrypt_average(
+    ctx: CkksContext,
+    sk: SecretKey,
+    ct_sum: Ciphertext,
+    num_clients: int,
+    spec: PackSpec,
+    exact: bool = False,
+):
+    """Owner-side decrypt of the aggregated sum -> averaged parameter pytree.
+
+    `decrypt_import_weights` (FLPyfhelin.py:263-281). Division by
+    `num_clients` happens in the decode scale — exact, no ciphertext op.
+    `exact=True` routes through the host bignum CRT (the trust-boundary
+    path used for final model export); default is the jittable f32 decode.
+    """
+    res = ops.decrypt(ctx, sk, ct_sum)
+    denom = ct_sum.scale * num_clients
+    if exact:
+        import numpy as np
+
+        blocks = jnp.asarray(
+            encoding.decode_exact(ctx.ntt, np.asarray(res), denom).astype(np.float32)
+        )
+    else:
+        blocks = encoding.decode(ctx.ntt, res, denom)
+    return unpack_blocks(blocks, spec)
+
+
+def secure_fedavg_round(
+    module,
+    cfg: TrainConfig,
+    mesh,
+    ctx: CkksContext,
+    pk: PublicKey,
+    global_params,
+    xs: jax.Array,
+    ys: jax.Array,
+    key: jax.Array,
+) -> tuple[Ciphertext, jax.Array]:
+    """One encrypted FedAvg round: local training + encrypt + psum, jitted.
+
+    Same contract as `fedavg_round` but the output is the *encrypted sum*
+    of client updates — the server (this program) never materializes any
+    client's plaintext weights off its own device, and never holds sk.
+    Follow with `decrypt_average(..., num_clients)` on the owner.
+
+    xs: uint8[C, m, H, W, ch], ys: int32[C, m]. -> (Ciphertext [n_ct, L, N]
+    replicated, metrics f32[C, E, 4]).
+    """
+    num_clients = int(xs.shape[0])
+    if num_clients > MAX_PSUM_CLIENTS:
+        raise ValueError(
+            f"{num_clients} clients exceeds lazy-reduction bound {MAX_PSUM_CLIENTS}"
+        )
+    n_dev = mesh.shape[CLIENT_AXIS]
+    if num_clients % n_dev != 0:
+        raise ValueError(f"{num_clients} clients on {n_dev} devices: must divide")
+    k_train, k_enc = jax.random.split(key)
+    train_keys = jax.random.split(k_train, num_clients)
+    enc_keys = jax.random.split(k_enc, num_clients)
+    return _build_secure_round_fn(module, cfg, mesh, ctx)(
+        global_params, pk, xs, ys, train_keys, enc_keys
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _build_secure_round_fn(module, cfg: TrainConfig, mesh, ctx: CkksContext):
+    """Compile-once factory for the encrypted round program (same rationale
+    as fedavg._build_round_fn: one trace/compile per configuration, reused
+    across all rounds). `pk` is a traced, mesh-replicated argument so key
+    rotation does not retrigger compilation."""
+
+    def body(gp, pk, x_blk, y_blk, kt_blk, ke_blk):
+        train_one = lambda x, y, k: local_train(module, cfg, gp, x, y, k)  # noqa: E731
+        p_out, mets = jax.vmap(train_one)(x_blk, y_blk, kt_blk)
+        enc_one = lambda prm, k: encrypt_params(ctx, pk, prm, k)  # noqa: E731
+        cts = jax.vmap(enc_one)(p_out, ke_blk)        # [cpd, n_ct, L, N]
+        local = aggregate_encrypted(ctx, cts)          # this device's clients
+        p = jnp.asarray(ctx.ntt.p)
+        return (
+            Ciphertext(
+                c0=psum_mod(local.c0, p, CLIENT_AXIS),
+                c1=psum_mod(local.c1, p, CLIENT_AXIS),
+                scale=local.scale,
+            ),
+            mets,
+        )
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS),
+        ),
+        out_specs=(P(), P(CLIENT_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(fn)

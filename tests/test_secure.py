@@ -1,0 +1,141 @@
+"""Encrypted-FedAvg tests (SURVEY.md §4 property tests):
+
+  * pack/unpack round-trip
+  * decrypt(Σ enc(wᵢ)) / N  ≈  mean(wᵢ)   — the core HE-FedAvg property
+  * secure round ≈ plaintext round        — encrypted path is a drop-in
+  * trust split: aggregation output is not decodable without sk
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.ckks import encoding, ops
+from hefl_tpu.ckks.keys import CkksContext, keygen
+from hefl_tpu.ckks.packing import PackSpec, pack_pytree, unpack_blocks
+from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+from hefl_tpu.fl import (
+    TrainConfig,
+    aggregate_encrypted,
+    decrypt_average,
+    encrypt_params,
+    fedavg_round,
+    secure_fedavg_round,
+)
+from hefl_tpu.models import SmallCNN
+from hefl_tpu.parallel import make_mesh
+
+
+@pytest.fixture(scope="module")
+def ctx_keys():
+    ctx = CkksContext.create(n=256)  # small ring: fast CI, same code path
+    sk, pk = keygen(ctx, jax.random.key(42))
+    return ctx, sk, pk
+
+
+def _rand_pytree(key, scale=0.5):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "conv": {"kernel": jax.random.normal(k1, (3, 3, 4, 8)) * scale,
+                 "bias": jax.random.normal(k2, (8,)) * scale},
+        "dense": {"kernel": jax.random.normal(k3, (32, 10)) * scale},
+    }
+
+
+def test_pack_unpack_roundtrip():
+    params = _rand_pytree(jax.random.key(0))
+    spec = PackSpec.for_params(params, 256)
+    total = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+    assert spec.total == total
+    assert spec.n_ct == -(-total // 256)
+    blocks = pack_pytree(params, 256)
+    assert blocks.shape == (spec.n_ct, 256)
+    back = unpack_blocks(blocks, spec)
+    for a, b in zip(jax.tree_util.tree_leaves(back), jax.tree_util.tree_leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_encrypted_average_matches_plain_mean(ctx_keys):
+    # decrypt(avg(enc(w_i))) ≈ mean(w_i) within encoder precision — the
+    # property the reference spot-checked by hand (FLPyfhelin.py:382).
+    ctx, sk, pk = ctx_keys
+    num_clients = 4
+    trees = [_rand_pytree(jax.random.key(i + 1)) for i in range(num_clients)]
+    spec = PackSpec.for_params(trees[0], ctx.n)
+    cts = [
+        encrypt_params(ctx, pk, t, jax.random.key(100 + i))
+        for i, t in enumerate(trees)
+    ]
+    stacked = ops.Ciphertext(
+        c0=jnp.stack([c.c0 for c in cts]),
+        c1=jnp.stack([c.c1 for c in cts]),
+        scale=cts[0].scale,
+    )
+    ct_sum = aggregate_encrypted(ctx, stacked)
+    avg = decrypt_average(ctx, sk, ct_sum, num_clients, spec)
+    expected = jax.tree_util.tree_map(lambda *xs: sum(xs) / num_clients, *trees)
+    for a, b in zip(jax.tree_util.tree_leaves(avg), jax.tree_util.tree_leaves(expected)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_exact_decode_path_matches_jit_decode(ctx_keys):
+    ctx, sk, pk = ctx_keys
+    params = _rand_pytree(jax.random.key(7))
+    spec = PackSpec.for_params(params, ctx.n)
+    ct = encrypt_params(ctx, pk, params, jax.random.key(8))
+    fast = decrypt_average(ctx, sk, ct, 1, spec)
+    gold = decrypt_average(ctx, sk, ct, 1, spec, exact=True)
+    for a, b in zip(jax.tree_util.tree_leaves(fast), jax.tree_util.tree_leaves(gold)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_decrypt_without_sk_yields_garbage(ctx_keys):
+    # The psum output must be semantically hidden: decoding c0 alone (what a
+    # server without sk could try) must NOT recover the plaintext.
+    ctx, sk, pk = ctx_keys
+    params = _rand_pytree(jax.random.key(11))
+    spec = PackSpec.for_params(params, ctx.n)
+    ct = encrypt_params(ctx, pk, params, jax.random.key(12))
+    from hefl_tpu.ckks.ntt import ntt_inverse
+
+    res = ntt_inverse(ctx.ntt, ct.c0)
+    leak = encoding.decode(ctx.ntt, res, ct.scale)
+    flat_true = np.asarray(pack_pytree(params, ctx.n))
+    # correlation between "decrypted-without-sk" and truth should be ~0
+    a, b = np.asarray(leak).ravel(), flat_true.ravel()
+    corr = np.corrcoef(a, b)[0, 1]
+    assert abs(corr) < 0.05
+
+
+def test_secure_round_matches_plain_round_end_to_end():
+    # Full SPMD program on the 8-device CPU mesh: train + encrypt + psum +
+    # owner decrypt must equal the plaintext fedavg round (same RNG key) to
+    # within CKKS noise — the notebook cell-6 plain-vs-encrypted comparison.
+    num_clients = 4
+    (x, y), _, _ = make_dataset("mnist", seed=0, n_train=num_clients * 24, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    cfg = TrainConfig(epochs=1, batch_size=8, num_classes=10, augment=False,
+                      val_fraction=0.25)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create()  # full-size ring (4096)
+    sk, pk = keygen(ctx, jax.random.key(99))
+    spec = PackSpec.for_params(params, ctx.n)
+    key = jax.random.key(5)
+
+    ct_sum, metrics = secure_fedavg_round(
+        model, cfg, mesh, ctx, pk, params, jnp.asarray(xs), jnp.asarray(ys), key
+    )
+    assert metrics.shape == (num_clients, 1, 4)
+    enc_avg = decrypt_average(ctx, sk, ct_sum, num_clients, spec)
+
+    k_train, _ = jax.random.split(key)  # plaintext round trains with k_train
+    plain_avg, _ = fedavg_round(
+        model, cfg, mesh, params, jnp.asarray(xs), jnp.asarray(ys), k_train
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(enc_avg), jax.tree_util.tree_leaves(plain_avg)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
