@@ -57,6 +57,19 @@ class CkksContext:
         sigma: float = DEFAULT_SIGMA,
     ) -> "CkksContext":
         prime_list = find_ntt_primes(num_primes, prime_bits, 2 * n)
+        q = 1
+        for p in prime_list:
+            q *= p
+        # Plaintexts live centered mod q: round(w*scale) summed over up to 32
+        # clients with |w| up to ~4 needs q/scale headroom of 2**8, else
+        # encoded weights wrap and decrypt to uncorrelated garbage with no
+        # error anywhere downstream. Fail loudly at construction instead.
+        if q < scale * 256:
+            raise ValueError(
+                f"ciphertext modulus too small: q~2**{q.bit_length()} must exceed "
+                f"256*scale (scale=2**{int(scale).bit_length() - 1}); "
+                "add RNS primes or lower the scale"
+            )
         return cls(ntt=NTTContext.build(prime_list, n), scale=scale, sigma=sigma)
 
     @property

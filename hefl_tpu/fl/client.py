@@ -66,6 +66,11 @@ def local_train(
     m = int(x.shape[0])
     n_val = max(int(m * cfg.val_fraction), 1) if cfg.val_fraction > 0 else 0
     n_tr = m - n_val
+    if n_tr < 1:
+        raise ValueError(
+            f"client has {m} sample(s); needs >= 2 to carve out a validation "
+            "split (set val_fraction=0 to train on everything)"
+        )
     # Keras validation_split semantics: HEAD fraction is validation
     # (data.partition.train_val_split documents the same convention).
     x_tr, y_tr = x[n_val:], y[n_val:]
@@ -100,7 +105,15 @@ def local_train(
         (params, opt, _), _ = jax.lax.scan(
             train_step, (state.params, state.opt, state.lr_scale), (perm, aug_keys)
         )
-        val_loss, val_acc = _eval_metrics(module, params, x_va, onehot_va)
+        frozen = state.stopped  # already stopped before this epoch
+        # Evaluate the params this epoch actually keeps: a stopped client's
+        # phantom-trained weights are discarded below, so its reported val
+        # metrics must come from the frozen weights (they stay constant at
+        # the stop-epoch values, consistent with the lr/stopped columns).
+        eval_params = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(frozen, old, new), params, state.params
+        )
+        val_loss, val_acc = _eval_metrics(module, eval_params, x_va, onehot_va)
 
         # --- callback logic (pure) ---
         loss_improved = val_loss < state.best_val_loss - cfg.min_delta
@@ -117,7 +130,6 @@ def local_train(
         wait_pl = jnp.where(plateau, 0, wait_pl)
         stopped_now = wait_es >= cfg.es_patience
 
-        frozen = state.stopped  # already stopped before this epoch
         pick = lambda new, old: jax.tree_util.tree_map(  # noqa: E731
             lambda a, b: jnp.where(frozen, b, a), new, old
         )

@@ -15,6 +15,7 @@ plaintext-vs-encrypted comparison the reference ships as notebook cell 6.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
@@ -27,6 +28,30 @@ from hefl_tpu.data.augment import rescale
 from hefl_tpu.fl.client import local_train
 from hefl_tpu.fl.config import TrainConfig
 from hefl_tpu.parallel import CLIENT_AXIS, pmean_tree
+
+
+@functools.lru_cache(maxsize=32)
+def _build_round_fn(module, cfg: TrainConfig, mesh):
+    """Compile-once factory: the jitted SPMD round program for one
+    (module, cfg, mesh) triple. Cached so an R-round experiment traces and
+    compiles the program a single time, not once per round."""
+
+    def body(gp, x_blk, y_blk, k_blk):
+        # x_blk: [cpd, m, ...] — this device's clients; vmap trains them
+        # "concurrently" (XLA interleaves), shard_map spans the mesh.
+        train_one = lambda x, y, k: local_train(module, cfg, gp, x, y, k)  # noqa: E731
+        p_out, mets = jax.vmap(train_one)(x_blk, y_blk, k_blk)
+        local_mean = jax.tree_util.tree_map(lambda t: jnp.mean(t, axis=0), p_out)
+        return pmean_tree(local_mean, CLIENT_AXIS), mets
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS)),
+        out_specs=(P(), P(CLIENT_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(fn)
 
 
 def fedavg_round(
@@ -48,23 +73,7 @@ def fedavg_round(
     if num_clients % n_dev != 0:
         raise ValueError(f"{num_clients} clients on {n_dev} devices: must divide")
     client_keys = jax.random.split(key, num_clients)
-
-    def body(gp, x_blk, y_blk, k_blk):
-        # x_blk: [cpd, m, ...] — this device's clients; vmap trains them
-        # "concurrently" (XLA interleaves), shard_map spans the mesh.
-        train_one = lambda x, y, k: local_train(module, cfg, gp, x, y, k)  # noqa: E731
-        p_out, mets = jax.vmap(train_one)(x_blk, y_blk, k_blk)
-        local_mean = jax.tree_util.tree_map(lambda t: jnp.mean(t, axis=0), p_out)
-        return pmean_tree(local_mean, CLIENT_AXIS), mets
-
-    fn = shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS)),
-        out_specs=(P(), P(CLIENT_AXIS)),
-        check_vma=False,
-    )
-    return jax.jit(fn)(global_params, xs, ys, client_keys)
+    return _build_round_fn(module, cfg, mesh)(global_params, xs, ys, client_keys)
 
 
 @partial(jax.jit, static_argnums=(0, 3))

@@ -121,3 +121,13 @@ def test_ct_mul_plain_poly(ctx, keys):
     ct2 = ops.ct_mul_plain_poly(ctx, ct, m_res, pt_scale)
     got = np.asarray(encoding.decode(ctx.ntt, ops.decrypt(ctx, sk, ct2), ct2.scale))
     assert np.max(np.abs(got - w)) < 5e-5
+
+
+def test_undersized_modulus_rejected():
+    # q below 256*scale would let encoded weights wrap mod q and decrypt to
+    # garbage silently; construction must fail instead.
+    import pytest
+    from hefl_tpu.ckks.keys import CkksContext
+
+    with pytest.raises(ValueError, match="modulus too small"):
+        CkksContext.create(n=256, num_primes=1)

@@ -217,3 +217,11 @@ def test_evaluate_handles_ragged_final_batch():
     out = evaluate(model, params, xt[:50], yt[:50], batch_size=32, return_probs=True)
     assert out["probs"].shape == (50, 10)
     assert np.allclose(out["probs"].sum(-1), 1.0, atol=1e-5)
+
+
+def test_single_sample_client_raises_clear_error():
+    model, params, xs, ys, *_ = _setup(1, 48)
+    cfg = TrainConfig(epochs=1, batch_size=8, num_classes=10, val_fraction=0.25)
+    with pytest.raises(ValueError, match="needs >= 2"):
+        local_train(model, cfg, params, jnp.asarray(xs[0][:1]), jnp.asarray(ys[0][:1]),
+                    jax.random.key(0))
