@@ -99,15 +99,27 @@ def decode(ctx: NTTContext, residues: jnp.ndarray, scale: float) -> jnp.ndarray:
     return out
 
 
-def decode_exact(ctx: NTTContext, residues: np.ndarray, scale: float) -> np.ndarray:
-    """Exact host-side decode via Python bignum CRT; float64 output.
+def decode_exact(
+    ctx: NTTContext, residues: np.ndarray, scale: float, prefer_native: bool = True
+) -> np.ndarray:
+    """Exact host-side decode; float64 output.
 
     Used at the trust boundary (owner decrypt -> model export) and as the
     gold reference in tests, mirroring how the reference's final
     `decrypt_import_weights` step is a host operation
-    (/root/reference/FLPyfhelin.py:263-281).
+    (/root/reference/FLPyfhelin.py:263-281). Dispatches to the C++
+    `__int128` Garner CRT (hefl_tpu.native — the SEAL-bignum analog) when
+    available; the Python object-array bignum path below is the
+    always-available fallback and the gold model the native code is tested
+    against (`prefer_native=False` forces it).
     """
     res = np.asarray(residues)
+    if prefer_native:
+        from hefl_tpu import native
+
+        fast = native.crt_decode_center(res, np.asarray(ctx.p)[:, 0], scale)
+        if fast is not None:
+            return fast
     p = [int(x) for x in np.asarray(ctx.p)[:, 0]]
     q = 1
     for pi in p:

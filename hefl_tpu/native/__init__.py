@@ -1,0 +1,111 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's native layer is Microsoft SEAL + the TF kernel runtime
+(SURVEY.md §2.12). Our TPU compute path needs neither — XLA is the C++
+runtime for everything jitted — but the host-side trust-boundary work
+(exact integer CRT at final decode) is genuinely native-worthy: Python
+object-dtype bignum is ~100x slower than __int128 C++.
+
+Build model: `crt.cpp` is compiled on first use with the ambient `g++`
+(`-O3 -fopenmp` when available) into `_hefl_native.so` next to the source,
+then loaded with ctypes. Everything degrades gracefully: if no compiler is
+present or the build fails, callers fall back to the pure-Python bignum
+path (`ckks.encoding.decode_exact`'s object-array branch) — same results,
+slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "crt.cpp")
+_SO = os.path.join(_DIR, "_hefl_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+    for flags in (["-fopenmp"], []):  # prefer parallel; fall back to serial
+        cmd = base[:2] + flags + base[2:]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+        if proc.returncode == 0:
+            return True
+    return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.crt_decode_center.restype = ctypes.c_int
+        lib.crt_decode_center.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32),  # res
+            ctypes.c_int64,                   # outer
+            ctypes.c_int64,                   # L
+            ctypes.c_int64,                   # n
+            ctypes.POINTER(ctypes.c_uint32),  # primes
+            ctypes.c_double,                  # inv_scale
+            ctypes.POINTER(ctypes.c_double),  # out
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the native library is built and loadable."""
+    return _load() is not None
+
+
+def crt_decode_center(
+    residues: np.ndarray, primes: np.ndarray, scale: float
+) -> np.ndarray | None:
+    """Exact centered-CRT decode: uint32[..., L, N] -> float64[..., N].
+
+    Returns None when the native library is unavailable (callers fall back
+    to the Python bignum path). L is capped at 4 (q < 2**108 fits __int128
+    headroom) — matching the framework's parameter space.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    res = np.ascontiguousarray(residues, dtype=np.uint32)
+    L, n = res.shape[-2], res.shape[-1]
+    if L > 4:
+        return None
+    outer = int(np.prod(res.shape[:-2], dtype=np.int64)) if res.ndim > 2 else 1
+    flat = res.reshape(outer, L, n)
+    out = np.empty((outer, n), dtype=np.float64)
+    p_arr = np.ascontiguousarray(primes, dtype=np.uint32)
+    rc = lib.crt_decode_center(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        outer,
+        L,
+        n,
+        p_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        1.0 / float(scale),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    if rc != 0:
+        return None
+    return out.reshape(res.shape[:-2] + (n,))

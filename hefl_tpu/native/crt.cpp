@@ -1,0 +1,88 @@
+// Exact CRT decode for RNS-CKKS residues — the native bignum core.
+//
+// Role: the reference delegates all exact modular arithmetic to Microsoft
+// SEAL (C++ via Pyfhel; /root/reference/FLPyfhelin.py:27, SURVEY.md §2.12).
+// Our on-device decode is float32 mixed-radix (ckks/encoding.py:decode),
+// which is plenty for the FL loop; the TRUST-BOUNDARY decode (owner-side
+// final model export, tests' gold path) wants exact integer CRT. In Python
+// that is object-dtype bignum — hundreds of ms for a model; here it is
+// Garner's algorithm in unsigned __int128 (q < 2**108 for L<=4 primes of
+// <=27 bits), parallelized over coefficients.
+//
+// Layout contract (matches ckks/encoding.py): residues are uint32[outer, L, n]
+// C-contiguous, canonical (< p_l); output is double[outer, n] =
+// centered_CRT(residues) * inv_scale.
+
+#include <cstdint>
+
+using u32 = uint32_t;
+using u64 = uint64_t;
+using u128 = unsigned __int128;
+using i128 = __int128;
+
+namespace {
+
+u64 modpow(u64 base, u64 exp, u64 mod) {
+  u64 acc = 1 % mod;
+  base %= mod;
+  while (exp) {
+    if (exp & 1) acc = (u128)acc * base % mod;
+    base = (u128)base * base % mod;
+    exp >>= 1;
+  }
+  return acc;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success, nonzero on invalid parameters.
+int crt_decode_center(const u32* res, int64_t outer, int64_t L, int64_t n,
+                      const u32* primes, double inv_scale, double* out) {
+  if (L < 1 || L > 4 || outer < 0 || n < 0) return 1;
+  u64 p[4];
+  u64 garner_inv[4];  // inv[l] = (p0*...*p_{l-1})^{-1} mod p_l
+  u128 q = 1;
+  for (int64_t l = 0; l < L; ++l) {
+    p[l] = primes[l];
+    if (p[l] == 0 || p[l] >= (1u << 31)) return 2;
+    q *= p[l];
+  }
+  for (int64_t l = 1; l < L; ++l) {
+    u64 prefix_mod = 1;
+    for (int64_t j = 0; j < l; ++j) prefix_mod = (u128)prefix_mod * p[j] % p[l];
+    garner_inv[l] = modpow(prefix_mod, p[l] - 2, p[l]);  // p prime: Fermat
+  }
+  const i128 half = (i128)(q >> 1);
+
+#pragma omp parallel for schedule(static)
+  for (int64_t b = 0; b < outer; ++b) {
+    const u32* rb = res + b * L * n;
+    double* ob = out + b * n;
+    for (int64_t j = 0; j < n; ++j) {
+      u128 v = rb[j];  // limb 0
+      u128 prefix = 1;
+      for (int64_t l = 1; l < L; ++l) {
+        prefix *= p[l - 1];
+        const u64 vl = (u64)(v % p[l]);
+        const u64 rl = rb[l * n + j];
+        const u64 diff = (rl + p[l] - vl) % p[l];
+        const u64 t = (u128)diff * garner_inv[l] % p[l];
+        v += (u128)t * prefix;
+      }
+      i128 sv = (i128)v;
+      if (sv > half) sv -= (i128)q;
+      // |sv| < q < 2**108: split into high/low 64-bit halves for an exact
+      // double conversion path (no i128->double support needed).
+      const bool neg = sv < 0;
+      const u128 mag = neg ? (u128)(-sv) : (u128)sv;
+      const double d =
+          (double)(u64)(mag >> 64) * 18446744073709551616.0 + (double)(u64)mag;
+      ob[j] = (neg ? -d : d) * inv_scale;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

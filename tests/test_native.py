@@ -1,0 +1,57 @@
+"""Native C++ CRT decoder vs the Python bignum gold model (SURVEY.md §2.12:
+the SEAL-replacement native layer must agree exactly with the host model)."""
+
+import numpy as np
+import pytest
+
+from hefl_tpu import native
+from hefl_tpu.ckks.encoding import decode_exact, encode
+from hefl_tpu.ckks.keys import CkksContext
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++?)"
+)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return CkksContext.create(n=128)
+
+
+def test_native_matches_python_bignum_random_residues(ctx):
+    rng = np.random.default_rng(0)
+    p = np.asarray(ctx.ntt.p)[:, 0]
+    res = np.stack(
+        [rng.integers(0, int(pi), size=(7, 128), dtype=np.uint32) for pi in p],
+        axis=-2,
+    )  # [7, L, 128]
+    gold = decode_exact(ctx.ntt, res, ctx.scale, prefer_native=False)
+    fast = decode_exact(ctx.ntt, res, ctx.scale, prefer_native=True)
+    np.testing.assert_array_equal(fast, gold)  # bit-exact: both are exact CRT
+
+
+def test_native_roundtrip_through_encode(ctx):
+    import jax.numpy as jnp
+
+    vals = np.linspace(-1.0, 1.0, 128, dtype=np.float32)
+    res = np.asarray(encode(ctx.ntt, jnp.asarray(vals), ctx.scale))
+    out = native.crt_decode_center(res, np.asarray(ctx.ntt.p)[:, 0], ctx.scale)
+    np.testing.assert_allclose(out, vals, atol=2e-9)
+
+
+def test_native_handles_large_centered_values(ctx):
+    # values near ±q/2 exercise the __int128 high-half double conversion
+    p = [int(x) for x in np.asarray(ctx.ntt.p)[:, 0]]
+    q = p[0] * p[1] * p[2]
+    for target in (q // 2 - 5, -(q // 2) + 5, 0, 1, -1):
+        t = target % q
+        res = np.array([[[t % pi] for pi in p]], dtype=np.uint32)  # [1, L, 1]
+        out = native.crt_decode_center(res, np.asarray(p, np.uint32), 1.0)
+        expected = t - q if t > q // 2 else t
+        assert out.shape == (1, 1)
+        np.testing.assert_allclose(out[0, 0], float(expected), rtol=1e-15)
+
+
+def test_native_rejects_too_many_limbs(ctx):
+    res = np.zeros((1, 5, 8), dtype=np.uint32)
+    assert native.crt_decode_center(res, np.full(5, 97, np.uint32), 1.0) is None
