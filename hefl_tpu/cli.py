@@ -1,0 +1,92 @@
+"""Command-line entry: `python -m hefl_tpu.cli [flags]`.
+
+The reference's "CLI" is running the notebook top-to-bottom with constants
+edited in source (SURVEY.md §2.1, §2.11). Every knob the notebook hard-codes
+is a flag here; defaults reproduce the reference experiment (2 clients,
+1 round, 10 local epochs, medical dataset, encrypted aggregation).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
+from hefl_tpu.fl import TrainConfig
+from hefl_tpu.models import MODEL_REGISTRY
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hefl_tpu",
+        description="TPU-native homomorphic-encryption federated learning",
+    )
+    p.add_argument("--model", default="medcnn", choices=sorted(MODEL_REGISTRY))
+    p.add_argument("--dataset", default="medical",
+                   choices=["medical", "mnist", "cifar10"])
+    p.add_argument("--num-clients", type=int, default=2)
+    p.add_argument("--rounds", type=int, default=1)
+    p.add_argument("--epochs", type=int, default=10, help="local epochs per round")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--num-classes", type=int, default=None,
+                   help="default: the model's registry default")
+    p.add_argument("--plaintext", action="store_true",
+                   help="plain FedAvg (no HE) — the cell-6 comparison path")
+    p.add_argument("--partition", default="iid", choices=["iid", "label_skew"])
+    p.add_argument("--skew-alpha", type=float, default=0.5)
+    p.add_argument("--prox-mu", type=float, default=0.0, help="FedProx strength")
+    p.add_argument("--no-augment", action="store_true")
+    p.add_argument("--he-n", type=int, default=4096, help="CKKS ring degree")
+    p.add_argument("--he-primes", type=int, default=3, help="RNS limb count")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--n-train", type=int, default=None)
+    p.add_argument("--n-test", type=int, default=None)
+    p.add_argument("--checkpoint", default=None, help="checkpoint path (.npz)")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--json", action="store_true", help="emit history as JSON lines")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
+    num_classes = (
+        args.num_classes
+        if args.num_classes is not None
+        else MODEL_REGISTRY[args.model][1]
+    )
+    return ExperimentConfig(
+        model=args.model,
+        dataset=args.dataset,
+        num_clients=args.num_clients,
+        rounds=args.rounds,
+        encrypted=not args.plaintext,
+        partition=args.partition,
+        skew_alpha=args.skew_alpha,
+        train=TrainConfig(
+            epochs=args.epochs,
+            batch_size=args.batch_size,
+            lr=args.lr,
+            prox_mu=args.prox_mu,
+            augment=not args.no_augment,
+            num_classes=num_classes,
+        ),
+        he=HEConfig(n=args.he_n, num_primes=args.he_primes),
+        seed=args.seed,
+        n_train=args.n_train,
+        n_test=args.n_test,
+        checkpoint_path=args.checkpoint,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    out = run_experiment(cfg, resume=args.resume, verbose=not args.json)
+    if args.json:
+        for rec in out["history"]:
+            print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

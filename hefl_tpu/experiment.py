@@ -1,0 +1,170 @@
+"""Experiment orchestration: the multi-round federated training loop.
+
+The reference's driver is notebook cell 3 (SURVEY.md §2.11): keygen, build
+global model, train clients, encrypt+export, aggregate under encryption,
+decrypt, evaluate — exactly ONE communication round, with wall-clock and
+sklearn metrics collected by hand. `run_experiment` generalizes that to R
+rounds with the same phase structure, per-phase timing matching BASELINE.md's
+schema, label-skew/FedProx options (BASELINE.json configs 4-5), an optional
+plaintext-aggregation mode (the notebook's cell-6 comparison path), and
+checkpoint/resume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hefl_tpu.ckks.keys import CkksContext, keygen
+from hefl_tpu.ckks.packing import PackSpec
+from hefl_tpu.data import iid_contiguous, label_skew, make_dataset, stack_federated
+from hefl_tpu.fl import (
+    TrainConfig,
+    decrypt_average,
+    evaluate,
+    fedavg_round,
+    secure_fedavg_round,
+)
+from hefl_tpu.models import count_params, create_model
+from hefl_tpu.parallel import make_mesh
+from hefl_tpu.utils import PhaseTimer, load_checkpoint, save_checkpoint
+
+
+@dataclasses.dataclass(frozen=True)
+class HEConfig:
+    """CKKS parameters (the reference's `gen_pk(s=128, m=1024)` knobs,
+    /root/reference/FLPyfhelin.py:330-344, modernized)."""
+
+    n: int = 4096
+    num_primes: int = 3
+    prime_bits: int = 27
+    scale: float = 2.0**30
+    sigma: float = 3.2
+
+    def build(self) -> CkksContext:
+        return CkksContext.create(
+            n=self.n,
+            num_primes=self.num_primes,
+            prime_bits=self.prime_bits,
+            scale=self.scale,
+            sigma=self.sigma,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    """Everything notebook cells 0-3 hard-code, as one declarative config."""
+
+    model: str = "medcnn"
+    dataset: str = "medical"
+    num_clients: int = 2
+    rounds: int = 1
+    encrypted: bool = True
+    partition: str = "iid"            # "iid" (reference) | "label_skew"
+    skew_alpha: float = 0.5
+    train: TrainConfig = TrainConfig()
+    he: HEConfig = HEConfig()
+    seed: int = 0
+    n_train: int | None = None        # dataset-size overrides (None = spec default)
+    n_test: int | None = None
+    checkpoint_path: str | None = None
+    exact_final_decode: bool = False  # bignum CRT decode on the last round
+
+
+def _partition(cfg: ExperimentConfig, y: np.ndarray) -> list[np.ndarray]:
+    if cfg.partition == "iid":
+        return iid_contiguous(len(y), cfg.num_clients)
+    if cfg.partition == "label_skew":
+        return label_skew(y, cfg.num_clients, alpha=cfg.skew_alpha, seed=cfg.seed)
+    raise ValueError(f"unknown partition {cfg.partition!r}")
+
+
+def run_experiment(
+    cfg: ExperimentConfig, resume: bool = False, verbose: bool = True
+) -> dict[str, Any]:
+    """Run R federated rounds; -> {history, final_metrics, params, timers}.
+
+    `history[r]` = {round, phases (seconds per phase), accuracy, precision,
+    recall, f1, val_acc (per-client)} — the reference's cell-4/cell-5
+    DataFrames as one record per round.
+    """
+    say = print if verbose else (lambda *_: None)
+    (x, y), (xt, yt), _ = make_dataset(
+        cfg.dataset, seed=cfg.seed, n_train=cfg.n_train, n_test=cfg.n_test
+    )
+    xs, ys = stack_federated(x, y, _partition(cfg, y))
+    xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
+
+    module, params = create_model(cfg.model, num_classes=cfg.train.num_classes)
+    mesh = make_mesh(cfg.num_clients)
+    key = jax.random.key(cfg.seed)
+
+    ctx = sk = pk = spec = None
+    if cfg.encrypted:
+        ctx = cfg.he.build()
+        key, k_he = jax.random.split(key)
+        sk, pk = keygen(ctx, k_he)
+        spec = PackSpec.for_params(params, ctx.n)
+        say(
+            f"CKKS context: N={ctx.n} L={ctx.num_primes} "
+            f"-> {spec.n_ct} ciphertexts for {count_params(params):,} params"
+        )
+
+    start_round = 0
+    if resume:
+        if not cfg.checkpoint_path:
+            raise ValueError("resume=True requires checkpoint_path")
+        params, start_round, key, _ = load_checkpoint(cfg.checkpoint_path, params)
+        say(f"resumed from {cfg.checkpoint_path} at round {start_round}")
+
+    history: list[dict[str, Any]] = []
+    for r in range(start_round, cfg.rounds):
+        timer = PhaseTimer()
+        key, k_round = jax.random.split(key)
+        if cfg.encrypted:
+            with timer.phase("train+encrypt+aggregate"):
+                ct_sum, metrics = secure_fedavg_round(
+                    module, cfg.train, mesh, ctx, pk, params, xs_d, ys_d, k_round
+                )
+                jax.block_until_ready((ct_sum.c0, ct_sum.c1, metrics))
+            with timer.phase("decrypt"):
+                exact = cfg.exact_final_decode and r == cfg.rounds - 1
+                params = decrypt_average(
+                    ctx, sk, ct_sum, cfg.num_clients, spec, exact=exact
+                )
+                jax.block_until_ready(params)
+        else:
+            with timer.phase("train+aggregate"):
+                params, metrics = fedavg_round(
+                    module, cfg.train, mesh, params, xs_d, ys_d, k_round
+                )
+                jax.block_until_ready((params, metrics))
+        with timer.phase("evaluate"):
+            results = evaluate(module, params, xt, yt)
+        record = {
+            "round": r,
+            "phases": timer.summary(),
+            "val_acc": np.asarray(metrics)[:, -1, 1].tolist(),
+            **{k: float(results[k]) for k in ("accuracy", "precision", "recall", "f1")},
+        }
+        history.append(record)
+        say(
+            f"round {r}: acc {record['accuracy']:.4f} f1 {record['f1']:.4f} "
+            f"({timer})"
+        )
+        if cfg.checkpoint_path:
+            save_checkpoint(
+                cfg.checkpoint_path, params, r + 1, key,
+                meta={"model": cfg.model, "dataset": cfg.dataset,
+                      "num_clients": cfg.num_clients},
+            )
+
+    return {
+        "history": history,
+        "final_metrics": history[-1] if history else None,
+        "params": params,
+    }

@@ -1,0 +1,37 @@
+"""Runtime utilities: phase timing, wire serialization, checkpoint/resume.
+
+The reference's equivalents (SURVEY.md §5): `time.time()` print brackets for
+tracing, pickled live Pyfhel objects for the wire, and four ad-hoc
+checkpoint formats (Keras ckpt, HDF5, object-npy, pickle). Here each is one
+explicit subsystem with a single format.
+"""
+
+from hefl_tpu.utils.timers import PhaseTimer
+from hefl_tpu.utils.serialization import (
+    load_ciphertext,
+    load_public_material,
+    load_secret_key,
+    save_ciphertext,
+    save_public_material,
+    save_secret_key,
+)
+from hefl_tpu.utils.checkpoint import (
+    load_checkpoint,
+    load_params,
+    save_checkpoint,
+    save_params,
+)
+
+__all__ = [
+    "PhaseTimer",
+    "save_public_material",
+    "load_public_material",
+    "save_secret_key",
+    "load_secret_key",
+    "save_ciphertext",
+    "load_ciphertext",
+    "save_checkpoint",
+    "load_checkpoint",
+    "save_params",
+    "load_params",
+]

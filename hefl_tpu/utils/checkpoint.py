@@ -1,0 +1,93 @@
+"""Checkpoint / resume: one format for the whole framework.
+
+The reference juggles four (SURVEY.md §5): Keras `ModelCheckpoint` files,
+full HDF5 models (`main_model.hdf5` / `agg_model.hdf5`), object-dtype npy
+weight dumps (`weights/weightsN.npy`), and pickled key/ciphertext bundles.
+Here there are two artifacts, both plain `.npz`:
+
+  * params file  — a parameter pytree, keyed by its flattened path (the
+    `save_weights`/`load_weights` + HDF5-model analog, FLPyfhelin.py:149-159).
+  * round checkpoint — params + round index + PRNG key + config echo: enough
+    to resume a multi-round FL run exactly (the capability the reference only
+    has for key material, notebook cell 2).
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import numpy as np
+
+
+def _npz_path(path: str) -> str:
+    """np.savez appends '.npz' to extensionless paths on write; normalize so
+    save and load agree on the filename either way."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def _flatten_named(params) -> dict[str, np.ndarray]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    out = {}
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        out[name] = np.asarray(leaf)
+    return out
+
+
+def save_params(path: str, params) -> None:
+    """Parameter pytree -> npz keyed by `scope/subscope/name` paths."""
+    named = _flatten_named(params)
+    np.savez_compressed(_npz_path(path), **{f"param:{k}": v for k, v in named.items()})
+
+
+def load_params(path: str, template):
+    """Restore a pytree saved by `save_params` into `template`'s structure."""
+    with np.load(_npz_path(path)) as z:
+        named = {k[len("param:"):]: z[k] for k in z.files if k.startswith("param:")}
+    return _restore_into(template, named)
+
+
+def _restore_into(template, named: dict[str, np.ndarray]):
+    import jax.numpy as jnp
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        if name not in named:
+            raise KeyError(f"checkpoint missing parameter {name!r}")
+        arr = named[name]
+        if arr.shape != leaf.shape:
+            raise ValueError(
+                f"shape mismatch for {name!r}: checkpoint {arr.shape} vs model {leaf.shape}"
+            )
+        leaves.append(jnp.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_checkpoint(
+    path: str, params, round_index: int, rng_key: jax.Array, meta: dict | None = None
+) -> None:
+    """Full resumable FL state: (global params, round, RNG key, metadata)."""
+    header = json.dumps(
+        {"round": int(round_index), "meta": meta or {}, "version": 1}
+    )
+    np.savez_compressed(
+        _npz_path(path),
+        header=np.frombuffer(header.encode(), dtype=np.uint8),
+        rng_key=np.asarray(jax.random.key_data(rng_key)),
+        **{f"param:{k}": v for k, v in _flatten_named(params).items()},
+    )
+
+
+def load_checkpoint(path: str, template):
+    """-> (params, round_index, rng_key, meta)."""
+    import jax.numpy as jnp
+
+    with np.load(_npz_path(path)) as z:
+        header = json.loads(bytes(z["header"]).decode())
+        named = {k[len("param:"):]: z[k] for k in z.files if k.startswith("param:")}
+        rng_key = jax.random.wrap_key_data(jnp.asarray(z["rng_key"]))
+    params = _restore_into(template, named)
+    return params, int(header["round"]), rng_key, header.get("meta", {})

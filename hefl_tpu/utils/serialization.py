@@ -1,0 +1,136 @@
+"""Wire formats for keys and ciphertexts at the trust boundaries.
+
+The reference pickles live Pyfhel objects — including shipping whatever keys
+the `HE` object holds alongside every ciphertext bundle
+(/root/reference/FLPyfhelin.py:232-237, the wart called out in SURVEY.md §5)
+— and re-attaches contexts on import (`weight[l]._pyfhel = HE2`, :321).
+
+Here every artifact is a plain `.npz` of integer arrays + a JSON header:
+
+  * public material  — context tables + public key. What clients and the
+    aggregating server receive (`publickey.pickle` analog, FLPyfhelin.py:340).
+  * secret key       — sk alone, a separate file that never travels with
+    ciphertexts (`privatekey.pickle` analog, :253).
+  * ciphertext       — c0/c1 RNS limbs + scale. Carries NO key material.
+
+The full NTT twiddle tables are serialized with the public material so a
+deserialized context is bit-identical to the originating one regardless of
+primitive-root search order.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from hefl_tpu.ckks.keys import CkksContext, PublicKey, SecretKey
+from hefl_tpu.ckks.ntt import NTTContext
+from hefl_tpu.ckks.ops import Ciphertext
+
+_MAGIC = "hefl-tpu-wire-v1"
+
+
+def _ntt_arrays(ntt: NTTContext) -> dict[str, np.ndarray]:
+    return {
+        "p": np.asarray(ntt.p),
+        "pinv_neg": np.asarray(ntt.pinv_neg),
+        "r2": np.asarray(ntt.r2),
+        "psi_rev": np.asarray(ntt.psi_rev),
+        "psi_inv_rev": np.asarray(ntt.psi_inv_rev),
+        "n_inv_mont": np.asarray(ntt.n_inv_mont),
+    }
+
+
+def _ntt_from_arrays(d, n: int) -> NTTContext:
+    return NTTContext(
+        n=n,
+        logn=n.bit_length() - 1,
+        p=np.asarray(d["p"]),
+        pinv_neg=np.asarray(d["pinv_neg"]),
+        r2=np.asarray(d["r2"]),
+        psi_rev=np.asarray(d["psi_rev"]),
+        psi_inv_rev=np.asarray(d["psi_inv_rev"]),
+        n_inv_mont=np.asarray(d["n_inv_mont"]),
+    )
+
+
+def save_public_material(path: str, ctx: CkksContext, pk: PublicKey) -> None:
+    """Write (context, pk) — the broadcast to every client and the server."""
+    header = json.dumps(
+        {"magic": _MAGIC, "kind": "public", "n": ctx.n, "scale": ctx.scale,
+         "sigma": ctx.sigma}
+    )
+    np.savez_compressed(
+        path,
+        header=np.frombuffer(header.encode(), dtype=np.uint8),
+        b_mont=np.asarray(pk.b_mont),
+        a_mont=np.asarray(pk.a_mont),
+        **_ntt_arrays(ctx.ntt),
+    )
+
+
+def _read_header(z, expected_kind: str) -> dict:
+    header = json.loads(bytes(z["header"]).decode())
+    if header.get("magic") != _MAGIC:
+        raise ValueError(f"not a {_MAGIC} file")
+    if header.get("kind") != expected_kind:
+        raise ValueError(f"expected kind={expected_kind!r}, got {header.get('kind')!r}")
+    return header
+
+
+def load_public_material(path: str) -> tuple[CkksContext, PublicKey]:
+    import jax.numpy as jnp
+
+    with np.load(path) as z:
+        header = _read_header(z, "public")
+        ctx = CkksContext(
+            ntt=_ntt_from_arrays(z, int(header["n"])),
+            scale=float(header["scale"]),
+            sigma=float(header["sigma"]),
+        )
+        pk = PublicKey(b_mont=jnp.asarray(z["b_mont"]), a_mont=jnp.asarray(z["a_mont"]))
+    return ctx, pk
+
+
+def save_secret_key(path: str, sk: SecretKey) -> None:
+    """sk in its own file, owner-only (FLPyfhelin.py:253 semantics — but
+    unlike the reference, nothing else is ever bundled with it)."""
+    header = json.dumps({"magic": _MAGIC, "kind": "secret"})
+    np.savez_compressed(
+        path,
+        header=np.frombuffer(header.encode(), dtype=np.uint8),
+        s_mont=np.asarray(sk.s_mont),
+    )
+
+
+def load_secret_key(path: str) -> SecretKey:
+    import jax.numpy as jnp
+
+    with np.load(path) as z:
+        _read_header(z, "secret")
+        return SecretKey(s_mont=jnp.asarray(z["s_mont"]))
+
+
+def save_ciphertext(path: str, ct: Ciphertext) -> None:
+    """Ciphertext limbs only — the client-upload / aggregated-download wire
+    (`weights/client_N.pickle` / `weights/aggregated.pickle` analogs)."""
+    header = json.dumps({"magic": _MAGIC, "kind": "ciphertext", "scale": ct.scale})
+    np.savez_compressed(
+        path,
+        header=np.frombuffer(header.encode(), dtype=np.uint8),
+        c0=np.asarray(ct.c0),
+        c1=np.asarray(ct.c1),
+    )
+
+
+def load_ciphertext(path: str) -> Ciphertext:
+    import jax.numpy as jnp
+
+    with np.load(path) as z:
+        header = _read_header(z, "ciphertext")
+        return Ciphertext(
+            c0=jnp.asarray(z["c0"]),
+            c1=jnp.asarray(z["c1"]),
+            scale=float(header["scale"]),
+        )

@@ -1,0 +1,101 @@
+"""Orchestration tests: multi-round loop, CLI config plumbing, resume."""
+
+import json
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.cli import build_parser, config_from_args
+from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
+from hefl_tpu.fl import TrainConfig
+
+
+TINY_TRAIN = TrainConfig(
+    epochs=1, batch_size=8, num_classes=10, augment=False, val_fraction=0.25
+)
+
+
+def _tiny_cfg(**kw) -> ExperimentConfig:
+    base = dict(
+        model="smallcnn",
+        dataset="mnist",
+        num_clients=2,
+        rounds=2,
+        train=TINY_TRAIN,
+        he=HEConfig(n=256),
+        n_train=64,
+        n_test=32,
+        seed=3,
+    )
+    base.update(kw)
+    return ExperimentConfig(**base)
+
+
+def test_encrypted_experiment_two_rounds():
+    out = run_experiment(_tiny_cfg(), verbose=False)
+    assert len(out["history"]) == 2
+    for rec in out["history"]:
+        assert {"train+encrypt+aggregate", "decrypt", "evaluate", "total"} <= set(
+            rec["phases"]
+        )
+        assert 0.0 <= rec["accuracy"] <= 1.0
+        assert len(rec["val_acc"]) == 2
+    for leaf in np.asarray(out["params"]["Conv_0"]["kernel"]).ravel()[:5]:
+        assert np.isfinite(leaf)
+
+
+def test_plaintext_experiment_and_label_skew():
+    out = run_experiment(
+        _tiny_cfg(encrypted=False, partition="label_skew", rounds=1), verbose=False
+    )
+    assert len(out["history"]) == 1
+    assert "train+aggregate" in out["history"][0]["phases"]
+
+
+def test_checkpoint_resume_continues_rounds(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    cfg = _tiny_cfg(rounds=1, checkpoint_path=path)
+    out1 = run_experiment(cfg, verbose=False)
+    # bump rounds to 2 and resume: only round 1 should run
+    cfg2 = _tiny_cfg(rounds=2, checkpoint_path=path)
+    out2 = run_experiment(cfg2, resume=True, verbose=False)
+    assert [r["round"] for r in out2["history"]] == [1]
+    # resumed params start from the round-0 result, not from init
+    a = np.asarray(out1["params"]["Dense_0"]["kernel"])
+    b = np.asarray(out2["params"]["Dense_0"]["kernel"])
+    assert a.shape == b.shape and not np.allclose(a, b)
+
+
+def test_cli_flags_map_to_config():
+    args = build_parser().parse_args(
+        [
+            "--model", "resnet20", "--dataset", "cifar10", "--num-clients", "8",
+            "--rounds", "3", "--plaintext", "--partition", "label_skew",
+            "--prox-mu", "0.1", "--he-n", "2048", "--no-augment",
+        ]
+    )
+    cfg = config_from_args(args)
+    assert cfg.model == "resnet20" and cfg.dataset == "cifar10"
+    assert cfg.num_clients == 8 and cfg.rounds == 3
+    assert cfg.encrypted is False and cfg.partition == "label_skew"
+    assert cfg.train.prox_mu == 0.1 and cfg.train.augment is False
+    assert cfg.train.num_classes == 10  # resnet20 registry default
+    assert cfg.he.n == 2048
+
+
+def test_cli_main_json_output(capsys):
+    from hefl_tpu.cli import main
+
+    rc = main(
+        [
+            "--model", "smallcnn", "--dataset", "mnist", "--num-clients", "2",
+            "--rounds", "1", "--epochs", "1", "--batch-size", "8",
+            "--n-train", "64", "--n-test", "32", "--he-n", "256",
+            "--no-augment", "--json",
+        ]
+    )
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.strip().splitlines() if l.startswith("{")]
+    rec = json.loads(lines[-1])
+    assert rec["round"] == 0 and "accuracy" in rec

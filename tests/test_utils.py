@@ -1,0 +1,134 @@
+"""Serialization, checkpoint, and timer tests (SURVEY.md §5 subsystems)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.ckks import ops
+from hefl_tpu.ckks.encoding import encode
+from hefl_tpu.ckks.keys import CkksContext, keygen
+from hefl_tpu.utils import (
+    PhaseTimer,
+    load_checkpoint,
+    load_ciphertext,
+    load_params,
+    load_public_material,
+    load_secret_key,
+    save_checkpoint,
+    save_ciphertext,
+    save_params,
+    save_public_material,
+    save_secret_key,
+)
+
+
+@pytest.fixture(scope="module")
+def ctx_keys():
+    ctx = CkksContext.create(n=128)
+    sk, pk = keygen(ctx, jax.random.key(0))
+    return ctx, sk, pk
+
+
+def test_public_material_roundtrip(tmp_path, ctx_keys):
+    ctx, sk, pk = ctx_keys
+    path = str(tmp_path / "public.npz")
+    save_public_material(path, ctx, pk)
+    ctx2, pk2 = load_public_material(path)
+    assert ctx2 == ctx  # bit-identical context (twiddles travel on the wire)
+    np.testing.assert_array_equal(np.asarray(pk2.b_mont), np.asarray(pk.b_mont))
+    # ciphertext made with the restored material decrypts under the original sk
+    vals = jnp.linspace(-1, 1, ctx.n, dtype=jnp.float32)
+    ct = ops.encrypt(ctx2, pk2, encode(ctx2.ntt, vals, ctx2.scale), jax.random.key(1))
+    from hefl_tpu.ckks.encoding import decode
+
+    out = decode(ctx.ntt, ops.decrypt(ctx, sk, ct), ctx.scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(vals), atol=1e-3)
+
+
+def test_secret_key_file_contains_no_public_material(tmp_path, ctx_keys):
+    ctx, sk, _ = ctx_keys
+    path = str(tmp_path / "secret.npz")
+    save_secret_key(path, sk)
+    with np.load(path) as z:
+        assert set(z.files) == {"header", "s_mont"}
+    sk2 = load_secret_key(path)
+    np.testing.assert_array_equal(np.asarray(sk2.s_mont), np.asarray(sk.s_mont))
+
+
+def test_ciphertext_wire_carries_no_keys(tmp_path, ctx_keys):
+    ctx, sk, pk = ctx_keys
+    vals = jnp.full((ctx.n,), 0.25, jnp.float32)
+    ct = ops.encrypt(ctx, pk, encode(ctx.ntt, vals, ctx.scale), jax.random.key(2))
+    path = str(tmp_path / "ct.npz")
+    save_ciphertext(path, ct)
+    with np.load(path) as z:
+        # the wart the reference had (pickling HE object with keys,
+        # FLPyfhelin.py:232-234) must be structurally impossible here
+        assert set(z.files) == {"header", "c0", "c1"}
+    ct2 = load_ciphertext(path)
+    assert ct2.scale == ct.scale
+    np.testing.assert_array_equal(np.asarray(ct2.c0), np.asarray(ct.c0))
+
+
+def test_kind_mismatch_rejected(tmp_path, ctx_keys):
+    ctx, sk, _ = ctx_keys
+    path = str(tmp_path / "secret.npz")
+    save_secret_key(path, sk)
+    with pytest.raises(ValueError, match="expected kind"):
+        load_ciphertext(path)
+
+
+def test_params_roundtrip(tmp_path):
+    params = {"dense": {"kernel": jnp.arange(6.0).reshape(2, 3), "bias": jnp.ones(3)}}
+    path = str(tmp_path / "params.npz")
+    save_params(path, params)
+    out = load_params(path, jax.tree_util.tree_map(jnp.zeros_like, params))
+    for a, b in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_params_shape_mismatch_rejected(tmp_path):
+    params = {"w": jnp.ones((2, 3))}
+    path = str(tmp_path / "p.npz")
+    save_params(path, params)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        load_params(path, {"w": jnp.ones((3, 2))})
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    params = {"w": jnp.float32(3.5), "b": jnp.arange(4.0)}
+    key = jax.random.key(7)
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(path, params, 5, key, meta={"model": "smallcnn"})
+    p2, rnd, key2, meta = load_checkpoint(path, params)
+    assert rnd == 5
+    assert meta["model"] == "smallcnn"
+    np.testing.assert_array_equal(
+        jax.random.key_data(key2), jax.random.key_data(key)
+    )
+    np.testing.assert_array_equal(np.asarray(p2["b"]), np.asarray(params["b"]))
+
+
+def test_phase_timer_accumulates():
+    t = PhaseTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("b"):
+        pass
+    with t.phase("a"):
+        pass
+    s = t.summary()
+    assert list(s) == ["a", "b", "total"]
+    assert s["total"] >= s["a"] + s["b"] - 1e-6
+    t.record("decrypt", 1.5)
+    assert t.summary()["decrypt"] == 1.5
+
+
+def test_checkpoint_extensionless_path_roundtrips(tmp_path):
+    # np.savez appends .npz to bare paths; load must still find the file
+    params = {"w": jnp.ones(3)}
+    path = str(tmp_path / "ck")  # no extension
+    save_checkpoint(path, params, 1, jax.random.key(0))
+    p2, rnd, _, _ = load_checkpoint(path, params)
+    assert rnd == 1
