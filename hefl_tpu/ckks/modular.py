@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-_MASK16 = jnp.uint32(0xFFFF)
+# Python-int literal (not a jnp scalar): keeps the helpers usable inside
+# Pallas kernel bodies, which reject captured device constants.
+_MASK16 = 0xFFFF
 
 
 def mul32_wide(a: jnp.ndarray, b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

