@@ -13,7 +13,9 @@ Module map:
     ntt      — negacyclic number-theoretic transform (merged Cooley-Tukey / Gentleman-Sande)
     encoding — coefficient + canonical-slot encode/decode (the `encryptFrac` analog)
     keys     — keygen, public/secret/relinearization key material (SURVEY §2.6)
-    ops      — encrypt / decrypt / ct+ct / ct×pt / rescale (SURVEY §2.7, §2.8, §2.10)
+    ops      — encrypt / decrypt / ct+ct / ct×pt / ct×ct+relin / rescale
+               (SURVEY §2.7, §2.8, §2.10 — and beyond: the reference's relin
+               path is dead code, FLPyfhelin.py:357-364)
     packing  — model-pytree <-> [n_ct, N] plaintext block layout
 """
 

@@ -136,3 +136,46 @@ def decode_exact(
     # center mod q
     v = np.where(v > q // 2, v - q, v)
     return (v / float(scale)).astype(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# Slot (canonical-embedding) packing — host-side float64.
+#
+# Coefficient packing (above) is the FedAvg wire format: ct+ct and ct x
+# scalar act coefficient-wise. Slot packing evaluates the plaintext
+# polynomial at N/2 conjugate-paired primitive 2N-th roots of unity, so
+# ct_mul (ops.ct_mul) acts ELEMENTWISE on slots — the semantics needed for
+# encrypted inner products / inference. Slot k's root is e^{i*pi*(2k+1)/N}
+# (natural odd-power order, not the 5^k Galois orbit: we implement no
+# rotation keys, so orbit ordering would buy nothing). Host-side float64
+# like `decode_exact`: packing choice is a trust-boundary encode step, not
+# an inner-loop op.
+# ---------------------------------------------------------------------------
+
+
+def num_slots(ctx: NTTContext) -> int:
+    return ctx.n // 2
+
+
+def encode_slots(ctx: NTTContext, z: np.ndarray, scale: float) -> np.ndarray:
+    """complex (or real) [..., N/2] slot values -> residues uint32[..., L, N]."""
+    n = ctx.n
+    z = np.asarray(z, dtype=np.complex128)
+    if z.shape[-1] != n // 2:
+        raise ValueError(f"expected {n // 2} slots, got {z.shape[-1]}")
+    ev = np.concatenate([z, np.conj(z[..., ::-1])], axis=-1)   # conj-symmetric
+    tw = np.exp(-1j * np.pi * np.arange(n) / n)                # zeta^{-n}
+    a = np.real(np.fft.fft(ev, axis=-1) / n * tw)
+    coeffs = np.round(a * scale).astype(np.int64)
+    p = np.asarray(ctx.p)[:, 0].astype(np.int64)               # [L]
+    res = np.mod(coeffs[..., None, :], p[:, None])
+    return res.astype(np.uint32)
+
+
+def decode_slots(ctx: NTTContext, residues: np.ndarray, scale: float) -> np.ndarray:
+    """Residues uint32[..., L, N] -> complex128 slot values [..., N/2]."""
+    n = ctx.n
+    coeffs = decode_exact(ctx, residues, 1.0)                  # exact integers
+    tw = np.exp(1j * np.pi * np.arange(n) / n)                 # zeta^{n}
+    ev = np.fft.ifft(coeffs * tw, axis=-1) * n
+    return ev[..., : n // 2] / float(scale)

@@ -112,6 +112,24 @@ class PublicKey:
     a_mont: jax.Array          # uint32[L, N]: uniform a, eval/Montgomery
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class RelinKey:
+    """Relinearization (key-switching) key: s^2 -> s.
+
+    The reference carries a dead `gen_rekey` stub (never called — its
+    pipeline has no ct x ct, /root/reference/FLPyfhelin.py:357-364); here
+    relinearization is implemented for real so the CKKS layer supports
+    ciphertext-ciphertext multiplication. RNS gadget = the CRT basis
+    decomposition: component i encrypts q~_i * s^2 where
+    q~_i = (q/p_i) * [(q/p_i)^-1]_{p_i}, so for any d2 with per-prime
+    residues [d2]_{p_i}:  sum_i [d2]_{p_i} * (q~_i s^2) = d2 * s^2 (mod q).
+    """
+
+    b_mont: jax.Array          # uint32[L, L, N]: -(a_i s) + e_i + q~_i s^2
+    a_mont: jax.Array          # uint32[L, L, N]: uniform, eval/Montgomery
+
+
 def sample_ternary_residues(ctx: CkksContext, key: jax.Array, batch=()) -> jnp.ndarray:
     """Uniform ternary polynomial {-1,0,1}^N as canonical residues [..., L, N]."""
     coeffs = jax.random.randint(key, batch + (ctx.n,), -1, 2, dtype=jnp.int32)
@@ -162,3 +180,43 @@ def keygen(ctx: CkksContext, key: jax.Array) -> tuple[SecretKey, PublicKey]:
     return SecretKey(s_mont=s_mont), PublicKey(
         b_mont=to_mont(ntt, b), a_mont=to_mont(ntt, a_eval)
     )
+
+
+def _crt_gadget_residues(ctx: CkksContext) -> np.ndarray:
+    """q~_i mod p_j as uint32[L, L, 1] (host-side exact bignum, like SEAL's
+    base-converter precomputation)."""
+    p = [int(x) for x in np.asarray(ctx.ntt.p)[:, 0]]
+    q = ctx.modulus
+    out = np.empty((len(p), len(p), 1), dtype=np.uint32)
+    for i, pi in enumerate(p):
+        qi_hat = q // pi
+        q_tilde = (qi_hat * pow(qi_hat % pi, pi - 2, pi)) % q
+        for j, pj in enumerate(p):
+            out[i, j, 0] = q_tilde % pj
+    return out
+
+
+@partial(jax.jit, static_argnums=0)
+def gen_relin_key(ctx: CkksContext, sk: SecretKey, key: jax.Array) -> RelinKey:
+    """Generate the s^2 -> s key-switching key (see :class:`RelinKey`).
+
+    One RLWE sample per RNS component i: (b_i, a_i) with
+    b_i = -(a_i s) + e_i + q~_i s^2, everything eval-domain. Products of two
+    Montgomery-form polynomials land back in Montgomery form, so
+    s^2_mont = mont_mul(s_mont, s_mont) needs no extra lift.
+    """
+    ntt = ctx.ntt
+    num_l = ctx.num_primes
+    p = jnp.asarray(ntt.p)
+    pinv = jnp.asarray(ntt.pinv_neg)
+    k_a, k_e = jax.random.split(key)
+    s2_mont = modular.mont_mul(sk.s_mont, sk.s_mont, p, pinv)
+    gadget = jnp.asarray(_crt_gadget_residues(ctx))              # [L, L, 1]
+    ts2 = modular.mont_mul(gadget, s2_mont, p, pinv)             # plain q~_i s^2
+    a_eval = sample_uniform_eval(ctx, k_a, (num_l,))             # [L, L, N]
+    e_eval = ntt_forward(ntt, sample_gaussian_residues(ctx, k_e, (num_l,)))
+    a_s = modular.mont_mul(a_eval, sk.s_mont, p, pinv)
+    b = modular.add_mod(
+        modular.add_mod(modular.neg_mod(a_s, p), e_eval, p), ts2, p
+    )
+    return RelinKey(b_mont=to_mont(ntt, b), a_mont=to_mont(ntt, a_eval))

@@ -9,7 +9,9 @@ Covers the full homomorphic op surface the reference exercises
     HE.decryptFrac(ct)        :295              decode(decrypt(ctx, sk, ct))
     PyCtxt + PyCtxt           :381              ct_add
     PyCtxt * plaintext denom  :385              ct_mul_scalar (exact tracked scale)
-    (relin keygen — dead code :357)             not needed: no ct x ct anywhere
+    (relin keygen — dead code :357)             gen_relin_key + ct_mul, for real
+                                                (beyond parity: the reference
+                                                never multiplies ciphertexts)
 
 Ciphertexts are `Ciphertext(c0, c1, scale)` with components
 `uint32[..., L, N]` living permanently in evaluation (NTT) domain — addition,
@@ -30,6 +32,7 @@ from hefl_tpu.ckks import modular
 from hefl_tpu.ckks.keys import (
     CkksContext,
     PublicKey,
+    RelinKey,
     SecretKey,
     sample_gaussian_residues,
     sample_ternary_residues,
@@ -148,6 +151,72 @@ def ct_mul_plain_poly(ctx: CkksContext, a: Ciphertext, m_res: jax.Array, pt_scal
         c0=modular.mont_mul(a.c0, m_mont, p, pinv),
         c1=modular.mont_mul(a.c1, m_mont, p, pinv),
         scale=a.scale * pt_scale,
+    )
+
+
+def _keyswitch_d2(ctx: CkksContext, d2: jax.Array, rlk: RelinKey) -> tuple[jax.Array, jax.Array]:
+    """Key-switch the degree-2 component: d2*s^2 -> ct under s.
+
+    RNS-decompose d2 in the CRT gadget base: iNTT to coefficients, take each
+    limb's canonical representative (< p_i < 2**27, so it reduces mod every
+    p_j with one remainder), re-NTT the lifted copies, and inner-product with
+    the relin key components. Returns the (c0, c1) correction pair.
+    """
+    ntt = ctx.ntt
+    p = jnp.asarray(ntt.p)
+    pinv = jnp.asarray(ntt.pinv_neg)
+    coeff = ntt_inverse(ntt, d2)                                  # [..., L, N]
+    rep = coeff[..., :, None, :]                                  # [..., L, 1, N]
+    lifted = jnp.remainder(rep, p)                                # [..., L, L, N]
+    d_eval = ntt_forward(ntt, lifted)
+    t0 = modular.mont_mul(d_eval, rlk.b_mont, p, pinv)            # [..., L, L, N]
+    t1 = modular.mont_mul(d_eval, rlk.a_mont, p, pinv)
+    num_l = coeff.shape[-2]
+    c0, c1 = t0[..., 0, :, :], t1[..., 0, :, :]
+    for i in range(1, num_l):                                     # modular tree-sum
+        c0 = modular.add_mod(c0, t0[..., i, :, :], p)
+        c1 = modular.add_mod(c1, t1[..., i, :, :], p)
+    return c0, c1
+
+
+def ct_mul(ctx: CkksContext, a: Ciphertext, b: Ciphertext, rlk: RelinKey) -> Ciphertext:
+    """Ciphertext x ciphertext multiply with relinearization.
+
+    Beyond reference parity: the reference's pipeline never multiplies two
+    ciphertexts and its relin keygen is dead code (FLPyfhelin.py:357-364,
+    SURVEY.md §2.6); implemented here so the HE layer is a complete CKKS
+    library. Under coefficient packing the product is the NEGACYCLIC
+    CONVOLUTION of the packed vectors (elementwise products need slot
+    packing); the result scale is the exact product of input scales —
+    `rescale` afterwards to shed a limb and renormalize.
+    """
+    # Fail loudly before the plaintext wraps mod q (the same philosophy as
+    # the q < scale*256 guard in CkksContext.create): the product's scaled
+    # message needs headroom for |w| up to ~16 plus noise.
+    out_scale = a.scale * b.scale
+    if out_scale * 16 >= ctx.modulus:
+        raise ValueError(
+            f"ct_mul result scale 2**{int(out_scale).bit_length() - 1} leaves no "
+            f"headroom under q~2**{ctx.modulus.bit_length()}; rescale between "
+            "multiplies or add RNS primes"
+        )
+    ntt = ctx.ntt
+    p = jnp.asarray(ntt.p)
+    pinv = jnp.asarray(ntt.pinv_neg)
+    b0m = to_mont(ntt, b.c0)
+    b1m = to_mont(ntt, b.c1)
+    d0 = modular.mont_mul(a.c0, b0m, p, pinv)
+    d1 = modular.add_mod(
+        modular.mont_mul(a.c0, b1m, p, pinv),
+        modular.mont_mul(a.c1, b0m, p, pinv),
+        p,
+    )
+    d2 = modular.mont_mul(a.c1, b1m, p, pinv)
+    k0, k1 = _keyswitch_d2(ctx, d2, rlk)
+    return Ciphertext(
+        c0=modular.add_mod(d0, k0, p),
+        c1=modular.add_mod(d1, k1, p),
+        scale=out_scale,
     )
 
 

@@ -14,7 +14,7 @@ devices) and aggregation is a collective.
 """
 
 from hefl_tpu.fl.config import TrainConfig
-from hefl_tpu.fl.client import local_train
+from hefl_tpu.fl.client import local_train, train_centralized
 from hefl_tpu.fl.fedavg import evaluate, fedavg_round
 from hefl_tpu.fl.metrics import classification_metrics
 from hefl_tpu.fl.secure import (
@@ -27,6 +27,7 @@ from hefl_tpu.fl.secure import (
 __all__ = [
     "TrainConfig",
     "local_train",
+    "train_centralized",
     "fedavg_round",
     "evaluate",
     "classification_metrics",

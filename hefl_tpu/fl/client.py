@@ -175,3 +175,15 @@ def local_train(
 # Convenience jitted entry for single-client use (tests, centralized baseline
 # — the analog of `train_server`, FLPyfhelin.py:161).
 local_train_jit = partial(jax.jit, static_argnums=(0, 1))(local_train)
+
+
+def train_centralized(module, cfg: TrainConfig, params, x, y, key):
+    """Centralized (non-federated) baseline trainer — `train_server`
+    (FLPyfhelin.py:161-177): the whole dataset, one model, the same
+    callback semantics (EarlyStopping / ReduceLROnPlateau / best-checkpoint
+    restore). The reference defines it but its notebook never calls it; it
+    exists to measure what federation costs in accuracy.
+
+    -> (best_params, metrics f32[E, 4]) like `local_train`.
+    """
+    return local_train_jit(module, cfg, params, x, y, key)

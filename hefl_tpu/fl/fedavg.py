@@ -77,9 +77,18 @@ def fedavg_round(
 
 
 @partial(jax.jit, static_argnums=(0, 3))
-def _predict_chunk(module, params, x_u8, apply_softmax: bool):
-    logits = module.apply({"params": params}, rescale(x_u8))
-    return jax.nn.softmax(logits) if apply_softmax else logits
+def _predict_all(module, params, x_u8, batch_size: int):
+    """Whole-dataset inference as ONE device program: a lax.scan over fixed
+    batches, so a remote/tunneled device pays a single dispatch + transfer
+    instead of one host round-trip per batch."""
+    nb = x_u8.shape[0] // batch_size
+    xb = x_u8.reshape(nb, batch_size, *x_u8.shape[1:])
+
+    def step(_, xc):
+        return None, jax.nn.softmax(module.apply({"params": params}, rescale(xc)))
+
+    _, probs = jax.lax.scan(step, None, xb)
+    return probs.reshape(nb * batch_size, probs.shape[-1])
 
 
 def evaluate(
@@ -101,12 +110,7 @@ def evaluate(
     n = len(x)
     pad = (-n) % batch_size
     x_pad = np.concatenate([x, np.repeat(x[:1], pad, axis=0)]) if pad else x
-    chunks = []
-    for i in range(0, len(x_pad), batch_size):
-        chunks.append(
-            np.asarray(_predict_chunk(module, params, jnp.asarray(x_pad[i : i + batch_size]), True))
-        )
-    probs = np.concatenate(chunks)[:n]
+    probs = np.asarray(_predict_all(module, params, jnp.asarray(x_pad), batch_size))[:n]
     out = classification_metrics(y, probs.argmax(-1))
     if return_probs:
         out["probs"] = probs

@@ -24,7 +24,7 @@ import json
 
 import numpy as np
 
-from hefl_tpu.ckks.keys import CkksContext, PublicKey, SecretKey
+from hefl_tpu.ckks.keys import CkksContext, PublicKey, RelinKey, SecretKey
 from hefl_tpu.ckks.ntt import NTTContext
 from hefl_tpu.ckks.ops import Ciphertext
 
@@ -110,6 +110,26 @@ def load_secret_key(path: str) -> SecretKey:
     with np.load(path) as z:
         _read_header(z, "secret")
         return SecretKey(s_mont=jnp.asarray(z["s_mont"]))
+
+
+def save_relin_key(path: str, rlk: RelinKey) -> None:
+    """Evaluation key: safe to hand to the (honest-but-curious) server —
+    it enables ct x ct but not decryption."""
+    header = json.dumps({"magic": _MAGIC, "kind": "relin"})
+    np.savez_compressed(
+        path,
+        header=np.frombuffer(header.encode(), dtype=np.uint8),
+        b_mont=np.asarray(rlk.b_mont),
+        a_mont=np.asarray(rlk.a_mont),
+    )
+
+
+def load_relin_key(path: str) -> RelinKey:
+    import jax.numpy as jnp
+
+    with np.load(path) as z:
+        _read_header(z, "relin")
+        return RelinKey(b_mont=jnp.asarray(z["b_mont"]), a_mont=jnp.asarray(z["a_mont"]))
 
 
 def save_ciphertext(path: str, ct: Ciphertext) -> None:

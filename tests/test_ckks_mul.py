@@ -1,0 +1,122 @@
+"""Ciphertext x ciphertext multiplication with relinearization.
+
+Beyond-parity surface: the reference never multiplies ciphertexts (its relin
+keygen is dead code, /root/reference/FLPyfhelin.py:357-364). Under
+coefficient packing ct_mul computes the negacyclic convolution of the packed
+vectors; the gold model is a float64 numpy convolution.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.ckks import encoding, ops
+from hefl_tpu.ckks.keys import CkksContext, SecretKey, gen_relin_key, keygen
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return CkksContext.create(n=512)
+
+
+@pytest.fixture(scope="module")
+def material(ctx):
+    sk, pk = keygen(ctx, jax.random.key(7))
+    rlk = gen_relin_key(ctx, sk, jax.random.key(8))
+    return sk, pk, rlk
+
+
+def _negacyclic_conv(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    full = np.convolve(a.astype(np.float64), b.astype(np.float64))
+    n = a.shape[0]
+    out = full[:n].copy()
+    out[: n - 1] -= full[n:]
+    return out
+
+
+def _vec(ctx, seed, scale=0.05):
+    rng = np.random.default_rng(seed)
+    return rng.normal(0, scale, ctx.n).astype(np.float32)
+
+
+def test_ct_mul_matches_convolution(ctx, material):
+    sk, pk, rlk = material
+    w1, w2 = _vec(ctx, 0), _vec(ctx, 1)
+    e1 = encoding.encode(ctx.ntt, jnp.asarray(w1), ctx.scale)
+    e2 = encoding.encode(ctx.ntt, jnp.asarray(w2), ctx.scale)
+    ct1 = ops.encrypt(ctx, pk, e1, jax.random.key(2))
+    ct2 = ops.encrypt(ctx, pk, e2, jax.random.key(3))
+    prod = ops.ct_mul(ctx, ct1, ct2, rlk)
+    assert prod.scale == ctx.scale * ctx.scale
+    got = encoding.decode_exact(
+        ctx.ntt, np.asarray(ops.decrypt(ctx, sk, prod)), prod.scale, prefer_native=False
+    )
+    want = _negacyclic_conv(w1, w2)
+    assert np.max(np.abs(got - want)) < 1e-4
+
+
+def test_ct_mul_plaintext_parity_with_plain_poly(ctx, material):
+    """ct x ct must agree with the (already-tested) ct x plaintext-poly path."""
+    sk, pk, rlk = material
+    w1, w2 = _vec(ctx, 4), _vec(ctx, 5)
+    e1 = encoding.encode(ctx.ntt, jnp.asarray(w1), ctx.scale)
+    e2 = encoding.encode(ctx.ntt, jnp.asarray(w2), ctx.scale)
+    ct1 = ops.encrypt(ctx, pk, e1, jax.random.key(6))
+    enc_enc = ops.ct_mul(ctx, ct1, ops.encrypt(ctx, pk, e2, jax.random.key(7)), rlk)
+    enc_plain = ops.ct_mul_plain_poly(ctx, ct1, e2, ctx.scale)
+    a = encoding.decode_exact(
+        ctx.ntt, np.asarray(ops.decrypt(ctx, sk, enc_enc)), enc_enc.scale, prefer_native=False
+    )
+    b = encoding.decode_exact(
+        ctx.ntt, np.asarray(ops.decrypt(ctx, sk, enc_plain)), enc_plain.scale, prefer_native=False
+    )
+    assert np.max(np.abs(a - b)) < 1e-4
+
+
+def test_ct_mul_then_rescale(ctx, material):
+    sk, pk, rlk = material
+    w1, w2 = _vec(ctx, 8), _vec(ctx, 9)
+    ct1 = ops.encrypt(ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(w1), ctx.scale), jax.random.key(10))
+    ct2 = ops.encrypt(ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(w2), ctx.scale), jax.random.key(11))
+    prod = ops.ct_mul(ctx, ct1, ct2, rlk)
+    sub_ctx, ct_r = ops.rescale(ctx, prod)
+    assert ct_r.c0.shape[-2] == ctx.num_primes - 1
+    sk_sub = SecretKey(s_mont=sk.s_mont[:-1])
+    got = encoding.decode_exact(
+        sub_ctx.ntt, np.asarray(ops.decrypt(sub_ctx, sk_sub, ct_r)), ct_r.scale, prefer_native=False
+    )
+    want = _negacyclic_conv(w1, w2)
+    p_last = int(np.asarray(ctx.ntt.p)[-1, 0])
+    bound = 4.0 * ctx.n * p_last / prod.scale + 1e-4
+    assert np.max(np.abs(got - want)) < bound
+
+
+def test_relin_key_serialization_roundtrip(ctx, material, tmp_path):
+    from hefl_tpu.utils.serialization import load_relin_key, save_relin_key
+
+    sk, pk, rlk = material
+    path = str(tmp_path / "rlk.npz")
+    save_relin_key(path, rlk)
+    rlk2 = load_relin_key(path)
+    w1, w2 = _vec(ctx, 20), _vec(ctx, 21)
+    ct1 = ops.encrypt(ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(w1), ctx.scale), jax.random.key(22))
+    ct2 = ops.encrypt(ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(w2), ctx.scale), jax.random.key(23))
+    a = np.asarray(ops.ct_mul(ctx, ct1, ct2, rlk).c0)
+    b = np.asarray(ops.ct_mul(ctx, ct1, ct2, rlk2).c0)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_ct_mul_batched(ctx, material):
+    sk, pk, rlk = material
+    rng = np.random.default_rng(12)
+    w = rng.normal(0, 0.05, (3, ctx.n)).astype(np.float32)
+    v = rng.normal(0, 0.05, (3, ctx.n)).astype(np.float32)
+    ct_w = ops.encrypt(ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(w), ctx.scale), jax.random.key(13))
+    ct_v = ops.encrypt(ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(v), ctx.scale), jax.random.key(14))
+    prod = ops.ct_mul(ctx, ct_w, ct_v, rlk)
+    got = encoding.decode_exact(
+        ctx.ntt, np.asarray(ops.decrypt(ctx, sk, prod)), prod.scale, prefer_native=False
+    )
+    for k in range(3):
+        assert np.max(np.abs(got[k] - _negacyclic_conv(w[k], v[k]))) < 1e-4

@@ -225,3 +225,21 @@ def test_single_sample_client_raises_clear_error():
     with pytest.raises(ValueError, match="needs >= 2"):
         local_train(model, cfg, params, jnp.asarray(xs[0][:1]), jnp.asarray(ys[0][:1]),
                     jax.random.key(0))
+
+
+def test_train_centralized_smoke():
+    """`train_server` analog (FLPyfhelin.py:161-177): trains on the whole set."""
+    import jax
+    import numpy as np
+    from hefl_tpu.fl import TrainConfig, evaluate, train_centralized
+    from hefl_tpu.models import create_model
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, (64, 16, 16, 1), dtype=np.uint8)
+    y = (x.reshape(64, -1).mean(axis=1) > 127).astype(np.int32)
+    module, params = create_model("smallcnn", input_shape=(16, 16, 1), num_classes=2)
+    cfg = TrainConfig(epochs=3, batch_size=16, augment=False)
+    best, metrics = train_centralized(module, cfg, params, x, y, jax.random.key(0))
+    assert metrics.shape == (3, 4)
+    out = evaluate(module, best, x, y, batch_size=16)
+    assert out["accuracy"] >= 0.5
