@@ -145,16 +145,27 @@ def decode_exact(
 # scalar act coefficient-wise. Slot packing evaluates the plaintext
 # polynomial at N/2 conjugate-paired primitive 2N-th roots of unity, so
 # ct_mul (ops.ct_mul) acts ELEMENTWISE on slots — the semantics needed for
-# encrypted inner products / inference. Slot k's root is e^{i*pi*(2k+1)/N}
-# (natural odd-power order, not the 5^k Galois orbit: we implement no
-# rotation keys, so orbit ordering would buy nothing). Host-side float64
-# like `decode_exact`: packing choice is a trust-boundary encode step, not
-# an inner-loop op.
+# encrypted inner products / inference. Slot j's root is zeta^{5^j mod 2N}
+# (the standard Galois-orbit ordering: the automorphism X -> X^5 then
+# cyclically shifts slots, which is what makes ops.ct_rotate a rotation;
+# X -> X^{-1} is slot conjugation). Host-side float64 like `decode_exact`:
+# packing choice is a trust-boundary encode step, not an inner-loop op.
 # ---------------------------------------------------------------------------
 
 
 def num_slots(ctx: NTTContext) -> int:
     return ctx.n // 2
+
+
+def _orbit_positions(n: int) -> np.ndarray:
+    """pos[j] = (5^j mod 2n - 1) / 2: index of slot j's root within the
+    natural odd-exponent enumeration e^{i*pi*(2t+1)/n}, t = 0..n-1."""
+    g = 1
+    pos = np.empty(n // 2, dtype=np.int64)
+    for j in range(n // 2):
+        pos[j] = (g - 1) // 2
+        g = (g * 5) % (2 * n)
+    return pos
 
 
 def encode_slots(ctx: NTTContext, z: np.ndarray, scale: float) -> np.ndarray:
@@ -163,7 +174,10 @@ def encode_slots(ctx: NTTContext, z: np.ndarray, scale: float) -> np.ndarray:
     z = np.asarray(z, dtype=np.complex128)
     if z.shape[-1] != n // 2:
         raise ValueError(f"expected {n // 2} slots, got {z.shape[-1]}")
-    ev = np.concatenate([z, np.conj(z[..., ::-1])], axis=-1)   # conj-symmetric
+    pos = _orbit_positions(n)
+    ev = np.zeros(z.shape[:-1] + (n,), dtype=np.complex128)
+    ev[..., pos] = z                                           # root 5^j
+    ev[..., n - 1 - pos] = np.conj(z)                          # root -5^j (conjugate)
     tw = np.exp(-1j * np.pi * np.arange(n) / n)                # zeta^{-n}
     a = np.real(np.fft.fft(ev, axis=-1) / n * tw)
     coeffs = np.round(a * scale).astype(np.int64)
@@ -178,4 +192,4 @@ def decode_slots(ctx: NTTContext, residues: np.ndarray, scale: float) -> np.ndar
     coeffs = decode_exact(ctx, residues, 1.0)                  # exact integers
     tw = np.exp(1j * np.pi * np.arange(n) / n)                 # zeta^{n}
     ev = np.fft.ifft(coeffs * tw, axis=-1) * n
-    return ev[..., : n // 2] / float(scale)
+    return ev[..., _orbit_positions(n)] / float(scale)

@@ -1,0 +1,59 @@
+"""Galois automorphisms X -> X^g of R_q = Z_q[x]/(x^N+1), batched for TPU.
+
+With the orbit slot ordering (encoding.encode_slots), the automorphism with
+g = 5^k cyclically LEFT-rotates the slot vector by k, and g = 2N-1 (X ->
+X^{-1}) conjugates every slot — the two primitives that, with a key-switch
+back to s (ops.ct_rotate / ops.ct_conjugate), give encrypted rotations.
+Beyond reference parity: the reference has no rotations at all (its only
+HE ops are add and plain-scalar multiply, SURVEY.md §2.10).
+
+The automorphism itself is a signed permutation of coefficients: X^n maps
+to X^{ng mod 2N} = (-1)^{(ng div N)} X^{ng mod N}. Tables are host-built
+per (n, g) and applied as one gather + conditional negate on device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+from hefl_tpu.ckks.modular import neg_mod
+
+
+def galois_elt_rotation(n: int, steps: int) -> int:
+    """Galois element whose automorphism left-rotates slots by `steps`."""
+    return pow(5, steps % (n // 2), 2 * n)
+
+
+def galois_elt_conjugation(n: int) -> int:
+    """Galois element (X -> X^{-1}) that conjugates every slot."""
+    return 2 * n - 1
+
+
+@functools.lru_cache(maxsize=64)
+def automorphism_tables(n: int, g: int) -> tuple[np.ndarray, np.ndarray]:
+    """-> (src int32[N], flip bool[N]) such that
+    phi_g(a)[m] = (-1)^{flip[m]} * a[src[m]].
+
+    Gather form: output coefficient m pulls from n0 = m * g^{-1} mod 2N;
+    when that lands in [N, 2N) the true source is n0 - N with a sign flip
+    (X^{n0} = -X^{n0-N} in the negacyclic ring).
+    """
+    if g % 2 == 0 or not (0 < g < 2 * n):
+        raise ValueError(f"galois element must be odd in (0, 2N); got {g}")
+    ginv = pow(g, -1, 2 * n)
+    m = np.arange(n, dtype=np.int64)
+    n0 = (m * ginv) % (2 * n)
+    flip = n0 >= n
+    src = np.where(flip, n0 - n, n0).astype(np.int32)
+    return src, flip
+
+
+def apply_automorphism(
+    residues: jnp.ndarray, p: jnp.ndarray, src: np.ndarray, flip: np.ndarray
+) -> jnp.ndarray:
+    """Signed coefficient permutation on canonical residues [..., L, N]."""
+    gathered = jnp.take(residues, jnp.asarray(src), axis=-1)
+    return jnp.where(jnp.asarray(flip), neg_mod(gathered, p), gathered)

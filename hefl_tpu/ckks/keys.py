@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from hefl_tpu.ckks import modular
-from hefl_tpu.ckks.ntt import NTTContext, ntt_forward, to_mont
+from hefl_tpu.ckks.ntt import NTTContext, ntt_forward, ntt_inverse, to_mont
 from hefl_tpu.ckks.primes import find_ntt_primes
 
 DEFAULT_N = 4096
@@ -46,6 +46,13 @@ class CkksContext:
     ntt: NTTContext
     scale: float = DEFAULT_SCALE
     sigma: float = DEFAULT_SIGMA
+    # Key-switching gadget digit width: each RNS limb residue is split into
+    # base-2**w digits, so key-switch noise scales with 2**w instead of the
+    # limb size 2**27 (which would swamp a scale-2**30 message entirely).
+    # w=5 puts the measured key-switch error of a rotation on a fresh
+    # ciphertext at ~4e-4 of the signal for ~18 gadget components; raise w
+    # to trade accuracy for key size/compute.
+    ksk_digit_bits: int = 5
 
     @classmethod
     def create(
@@ -87,8 +94,14 @@ class CkksContext:
             q *= int(p)
         return q
 
+    @property
+    def ksk_num_digits(self) -> int:
+        """Digits per RNS limb in the key-switching gadget."""
+        max_bits = max(int(p).bit_length() for p in np.asarray(self.ntt.p)[:, 0])
+        return -(-max_bits // self.ksk_digit_bits)
+
     def __hash__(self):
-        return hash((self.ntt, self.scale, self.sigma))
+        return hash((self.ntt, self.scale, self.sigma, self.ksk_digit_bits))
 
     def __eq__(self, other):
         return (
@@ -96,6 +109,7 @@ class CkksContext:
             and self.ntt == other.ntt
             and self.scale == other.scale
             and self.sigma == other.sigma
+            and self.ksk_digit_bits == other.ksk_digit_bits
         )
 
 
@@ -121,13 +135,29 @@ class RelinKey:
     pipeline has no ct x ct, /root/reference/FLPyfhelin.py:357-364); here
     relinearization is implemented for real so the CKKS layer supports
     ciphertext-ciphertext multiplication. RNS gadget = the CRT basis
-    decomposition: component i encrypts q~_i * s^2 where
-    q~_i = (q/p_i) * [(q/p_i)^-1]_{p_i}, so for any d2 with per-prime
-    residues [d2]_{p_i}:  sum_i [d2]_{p_i} * (q~_i s^2) = d2 * s^2 (mod q).
+    decomposition refined by base-2**w digits: component (i, k) encrypts
+    g_{i,k} * s^2 with g_{i,k} = q~_i * 2**(wk) and
+    q~_i = (q/p_i) * [(q/p_i)^-1]_{p_i}, so for any d2 whose limb residues
+    have digits d2_{i,k}: sum_{i,k} d2_{i,k} * (g_{i,k} s^2) = d2 * s^2
+    (mod q), with every decomposition coefficient < 2**w.
     """
 
-    b_mont: jax.Array          # uint32[L, L, N]: -(a_i s) + e_i + q~_i s^2
-    a_mont: jax.Array          # uint32[L, L, N]: uniform, eval/Montgomery
+    b_mont: jax.Array          # uint32[C, L, N], C = L*digits: -(a_c s) + e_c + g_c s^2
+    a_mont: jax.Array          # uint32[C, L, N]: uniform, eval/Montgomery
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class GaloisKey:
+    """Key-switching key phi_g(s) -> s for the automorphism X -> X^g.
+
+    Same RNS-gadget structure as :class:`RelinKey` but the encrypted target
+    is q~_i * phi_g(s); enables `ops.ct_rotate` / `ops.ct_conjugate`.
+    """
+
+    g: int = dataclasses.field(metadata=dict(static=True))
+    b_mont: jax.Array = None   # uint32[C, L, N]
+    a_mont: jax.Array = None   # uint32[C, L, N]
 
 
 def sample_ternary_residues(ctx: CkksContext, key: jax.Array, batch=()) -> jnp.ndarray:
@@ -183,40 +213,110 @@ def keygen(ctx: CkksContext, key: jax.Array) -> tuple[SecretKey, PublicKey]:
 
 
 def _crt_gadget_residues(ctx: CkksContext) -> np.ndarray:
-    """q~_i mod p_j as uint32[L, L, 1] (host-side exact bignum, like SEAL's
-    base-converter precomputation)."""
+    """Gadget vector g_{i,k} = q~_i * 2**(w*k) mod p_j as uint32[L*d, L, 1]
+    (host-side exact bignum, like SEAL's base-converter precomputation).
+
+    q~_i = (q/p_i) * [(q/p_i)^{-1}]_{p_i} is the CRT reconstruction basis;
+    the 2**(w*k) factors pair with the base-2**w digit split of each limb
+    residue (ops._keyswitch_coeff), so every decomposition coefficient is
+    < 2**w and key-switch noise stays ~2**w rather than ~p_i.
+    """
     p = [int(x) for x in np.asarray(ctx.ntt.p)[:, 0]]
     q = ctx.modulus
-    out = np.empty((len(p), len(p), 1), dtype=np.uint32)
+    w = ctx.ksk_digit_bits
+    d = ctx.ksk_num_digits
+    out = np.empty((len(p) * d, len(p), 1), dtype=np.uint32)
     for i, pi in enumerate(p):
         qi_hat = q // pi
         q_tilde = (qi_hat * pow(qi_hat % pi, pi - 2, pi)) % q
-        for j, pj in enumerate(p):
-            out[i, j, 0] = q_tilde % pj
+        for k in range(d):
+            g_ik = (q_tilde << (w * k)) % q
+            for j, pj in enumerate(p):
+                out[i * d + k, j, 0] = g_ik % pj
     return out
+
+
+def _center_correction_residues(ctx: CkksContext) -> np.ndarray:
+    """Residues of K = 2**(w-1) * sum_k 2**(wk) mod p_j as uint32[L, 1].
+
+    The key-switch decomposition uses CENTERED digits d' = d - 2**(w-1)
+    (zero-mean, so digit-times-noise products cancel instead of adding
+    coherently). Centering every digit of every limb shifts the recombined
+    value by the constant K per coefficient — because sum_i q~_i == 1
+    (mod q), the CRT reconstruction of all-ones — so one extra key row
+    encrypting K*J(X)*target (J = the all-ones polynomial), consumed with
+    digit identically 1, restores exactness.
+    """
+    p = [int(x) for x in np.asarray(ctx.ntt.p)[:, 0]]
+    w = ctx.ksk_digit_bits
+    d = ctx.ksk_num_digits
+    q = ctx.modulus
+    k_const = (sum(1 << (w * k) for k in range(d)) << (w - 1)) % q
+    return np.array([[k_const % pj] for pj in p], dtype=np.uint32)
+
+
+def _gen_ksk(ctx: CkksContext, sk: SecretKey, key: jax.Array, target_mont: jax.Array):
+    """Gadget key-switching key for `target` -> s: per gadget component c,
+    (b_c, a_c) with b_c = -(a_c s) + e_c + g_c * target (eval domain).
+    `target_mont` is the target polynomial in Montgomery form. The final
+    component is the centering correction row (see
+    `_center_correction_residues`); C = L*digits + 1 rows total."""
+    ntt = ctx.ntt
+    num_c = ctx.num_primes * ctx.ksk_num_digits + 1
+    p = jnp.asarray(ntt.p)
+    pinv = jnp.asarray(ntt.pinv_neg)
+    k_a, k_e = jax.random.split(key)
+    gadget = jnp.asarray(_crt_gadget_residues(ctx))              # [C-1, L, 1]
+    tgt = modular.mont_mul(gadget, target_mont, p, pinv)         # plain g_c * target
+    # Correction row: (K*J)(X) has every coefficient K, so its eval form is
+    # the NTT of a constant-K coefficient vector.
+    kj_coeff = jnp.broadcast_to(
+        jnp.asarray(_center_correction_residues(ctx)), (ctx.num_primes, ctx.n)
+    )
+    kj_eval = ntt_forward(ntt, kj_coeff)
+    corr = modular.mont_mul(kj_eval, target_mont, p, pinv)[None]  # [1, L, N]
+    tgt = jnp.concatenate([tgt, corr], axis=0)                   # [C, L, N]
+    a_eval = sample_uniform_eval(ctx, k_a, (num_c,))             # [C, L, N]
+    e_eval = ntt_forward(ntt, sample_gaussian_residues(ctx, k_e, (num_c,)))
+    a_s = modular.mont_mul(a_eval, sk.s_mont, p, pinv)
+    b = modular.add_mod(
+        modular.add_mod(modular.neg_mod(a_s, p), e_eval, p), tgt, p
+    )
+    return to_mont(ntt, b), to_mont(ntt, a_eval)
 
 
 @partial(jax.jit, static_argnums=0)
 def gen_relin_key(ctx: CkksContext, sk: SecretKey, key: jax.Array) -> RelinKey:
     """Generate the s^2 -> s key-switching key (see :class:`RelinKey`).
 
-    One RLWE sample per RNS component i: (b_i, a_i) with
-    b_i = -(a_i s) + e_i + q~_i s^2, everything eval-domain. Products of two
-    Montgomery-form polynomials land back in Montgomery form, so
-    s^2_mont = mont_mul(s_mont, s_mont) needs no extra lift.
+    Products of two Montgomery-form polynomials land back in Montgomery
+    form, so s^2_mont = mont_mul(s_mont, s_mont) needs no extra lift.
     """
+    p = jnp.asarray(ctx.ntt.p)
+    s2_mont = modular.mont_mul(sk.s_mont, sk.s_mont, p, jnp.asarray(ctx.ntt.pinv_neg))
+    b, a = _gen_ksk(ctx, sk, key, s2_mont)
+    return RelinKey(b_mont=b, a_mont=a)
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def gen_galois_key(ctx: CkksContext, sk: SecretKey, key: jax.Array, g: int) -> GaloisKey:
+    """Key-switching key for the automorphism X -> X^g (see :class:`GaloisKey`).
+
+    Use `galois.galois_elt_rotation(n, steps)` for slot rotations and
+    `galois.galois_elt_conjugation(n)` for slot conjugation. The reference
+    has no counterpart — its HE layer cannot rotate (SURVEY.md §2.10).
+    """
+    from hefl_tpu.ckks import galois
+
     ntt = ctx.ntt
-    num_l = ctx.num_primes
     p = jnp.asarray(ntt.p)
     pinv = jnp.asarray(ntt.pinv_neg)
-    k_a, k_e = jax.random.split(key)
-    s2_mont = modular.mont_mul(sk.s_mont, sk.s_mont, p, pinv)
-    gadget = jnp.asarray(_crt_gadget_residues(ctx))              # [L, L, 1]
-    ts2 = modular.mont_mul(gadget, s2_mont, p, pinv)             # plain q~_i s^2
-    a_eval = sample_uniform_eval(ctx, k_a, (num_l,))             # [L, L, N]
-    e_eval = ntt_forward(ntt, sample_gaussian_residues(ctx, k_e, (num_l,)))
-    a_s = modular.mont_mul(a_eval, sk.s_mont, p, pinv)
-    b = modular.add_mod(
-        modular.add_mod(modular.neg_mod(a_s, p), e_eval, p), ts2, p
-    )
-    return RelinKey(b_mont=to_mont(ntt, b), a_mont=to_mont(ntt, a_eval))
+    # s plain eval = s_mont * 1 * R^{-1}; then roundtrip through the
+    # coefficient domain to apply the signed permutation.
+    s_eval = modular.mont_mul(sk.s_mont, jnp.uint32(1), p, pinv)
+    s_coeff = ntt_inverse(ntt, s_eval)
+    src, flip = galois.automorphism_tables(ctx.n, g)
+    ps_coeff = galois.apply_automorphism(s_coeff, p, src, flip)
+    ps_mont = to_mont(ntt, ntt_forward(ntt, ps_coeff))
+    b, a = _gen_ksk(ctx, sk, key, ps_mont)
+    return GaloisKey(g=g, b_mont=b, a_mont=a)

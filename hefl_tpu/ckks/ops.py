@@ -31,6 +31,7 @@ import numpy as np
 from hefl_tpu.ckks import modular
 from hefl_tpu.ckks.keys import (
     CkksContext,
+    GaloisKey,
     PublicKey,
     RelinKey,
     SecretKey,
@@ -154,29 +155,106 @@ def ct_mul_plain_poly(ctx: CkksContext, a: Ciphertext, m_res: jax.Array, pt_scal
     )
 
 
-def _keyswitch_d2(ctx: CkksContext, d2: jax.Array, rlk: RelinKey) -> tuple[jax.Array, jax.Array]:
-    """Key-switch the degree-2 component: d2*s^2 -> ct under s.
+def _keyswitch_coeff(
+    ctx: CkksContext, coeff: jax.Array, b_mont: jax.Array, a_mont: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Gadget key-switch of a COEFFICIENT-domain polynomial.
 
-    RNS-decompose d2 in the CRT gadget base: iNTT to coefficients, take each
-    limb's canonical representative (< p_i < 2**27, so it reduces mod every
-    p_j with one remainder), re-NTT the lifted copies, and inner-product with
-    the relin key components. Returns the (c0, c1) correction pair.
+    Decompose in the digit-refined CRT gadget base: each limb's canonical
+    representative splits into base-2**w digits (w = ctx.ksk_digit_bits),
+    every digit (< 2**w, trivially canonical under every prime) is lifted
+    to all limbs, re-NTT'd, and inner-producted with the key components.
+    Returns the eval-domain (c0, c1) correction pair. Noise ~2**w per
+    component — the digit split is what keeps a key-switch on a fresh
+    scale-2**30 ciphertext (rotations) far below the signal.
     """
     ntt = ctx.ntt
     p = jnp.asarray(ntt.p)
     pinv = jnp.asarray(ntt.pinv_neg)
-    coeff = ntt_inverse(ntt, d2)                                  # [..., L, N]
-    rep = coeff[..., :, None, :]                                  # [..., L, 1, N]
-    lifted = jnp.remainder(rep, p)                                # [..., L, L, N]
-    d_eval = ntt_forward(ntt, lifted)
-    t0 = modular.mont_mul(d_eval, rlk.b_mont, p, pinv)            # [..., L, L, N]
-    t1 = modular.mont_mul(d_eval, rlk.a_mont, p, pinv)
+    w = ctx.ksk_digit_bits
+    d = ctx.ksk_num_digits
+    mask = jnp.uint32((1 << w) - 1)
+    digits = jnp.stack(
+        [(coeff >> jnp.uint32(w * k)) & mask for k in range(d)], axis=-2
+    )                                                             # [..., L, d, N]
     num_l = coeff.shape[-2]
+    n = coeff.shape[-1]
+    num_c = num_l * d + 1
+    comp = digits.reshape(*coeff.shape[:-2], num_l * d, n)
+    lifted = jnp.broadcast_to(
+        comp[..., :, None, :], (*coeff.shape[:-2], num_l * d, num_l, n)
+    )
+    # Centered digits (zero-mean, see keys._center_correction_residues) plus
+    # the constant-1 digit consuming the correction row: its eval-domain
+    # representation is all-ones (a constant polynomial evaluates to itself).
+    lifted = modular.sub_mod(lifted, jnp.uint32(1 << (w - 1)), p)
+    d_eval = jnp.concatenate(
+        [
+            ntt_forward(ntt, lifted),
+            jnp.ones((*coeff.shape[:-2], 1, num_l, n), jnp.uint32),
+        ],
+        axis=-3,
+    )
+    t0 = modular.mont_mul(d_eval, b_mont, p, pinv)                # [..., C, L, N]
+    t1 = modular.mont_mul(d_eval, a_mont, p, pinv)
     c0, c1 = t0[..., 0, :, :], t1[..., 0, :, :]
-    for i in range(1, num_l):                                     # modular tree-sum
+    for i in range(1, num_c):                                     # modular tree-sum
         c0 = modular.add_mod(c0, t0[..., i, :, :], p)
         c1 = modular.add_mod(c1, t1[..., i, :, :], p)
     return c0, c1
+
+
+def _keyswitch_d2(ctx: CkksContext, d2: jax.Array, rlk: RelinKey) -> tuple[jax.Array, jax.Array]:
+    """Key-switch the degree-2 component: d2*s^2 -> ct under s."""
+    return _keyswitch_coeff(ctx, ntt_inverse(ctx.ntt, d2), rlk.b_mont, rlk.a_mont)
+
+
+def ct_apply_galois(ctx: CkksContext, a: Ciphertext, gk: GaloisKey) -> Ciphertext:
+    """Apply the automorphism X -> X^g homomorphically and switch back to s.
+
+    phi_g commutes with decryption up to the key change s -> phi_g(s):
+    phi(c0) + phi(c1)*phi(s) = phi(m + noise). So: automorphism both
+    components in the coefficient domain, then key-switch the phi(c1) part
+    with the Galois key. No counterpart in the reference (SURVEY.md §2.10).
+    """
+    from hefl_tpu.ckks import galois
+
+    ntt = ctx.ntt
+    p = jnp.asarray(ntt.p)
+    src, flip = galois.automorphism_tables(ctx.n, gk.g)
+    pc0 = galois.apply_automorphism(ntt_inverse(ntt, a.c0), p, src, flip)
+    pc1 = galois.apply_automorphism(ntt_inverse(ntt, a.c1), p, src, flip)
+    k0, k1 = _keyswitch_coeff(ctx, pc1, gk.b_mont, gk.a_mont)
+    return Ciphertext(
+        c0=modular.add_mod(ntt_forward(ntt, pc0), k0, p),
+        c1=k1,
+        scale=a.scale,
+    )
+
+
+def ct_rotate(ctx: CkksContext, a: Ciphertext, gk: GaloisKey, steps: int) -> Ciphertext:
+    """Cyclically LEFT-rotate the slot vector by `steps` (slot packing).
+
+    `gk` must be the Galois key for `galois.galois_elt_rotation(n, steps)`;
+    checked here so a mismatched key fails loudly instead of decrypting to
+    a permutation the caller did not ask for.
+    """
+    from hefl_tpu.ckks import galois
+
+    want = galois.galois_elt_rotation(ctx.n, steps)
+    if gk.g != want:
+        raise ValueError(f"galois key has g={gk.g}, rotation by {steps} needs g={want}")
+    return ct_apply_galois(ctx, a, gk)
+
+
+def ct_conjugate(ctx: CkksContext, a: Ciphertext, gk: GaloisKey) -> Ciphertext:
+    """Conjugate every slot (slot packing)."""
+    from hefl_tpu.ckks import galois
+
+    want = galois.galois_elt_conjugation(ctx.n)
+    if gk.g != want:
+        raise ValueError(f"galois key has g={gk.g}, conjugation needs g={want}")
+    return ct_apply_galois(ctx, a, gk)
 
 
 def ct_mul(ctx: CkksContext, a: Ciphertext, b: Ciphertext, rlk: RelinKey) -> Ciphertext:

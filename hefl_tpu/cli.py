@@ -44,6 +44,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-test", type=int, default=None)
     p.add_argument("--checkpoint", default=None, help="checkpoint path (.npz)")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler trace of the first round to DIR")
     p.add_argument("--json", action="store_true", help="emit history as JSON lines")
     return p
 
@@ -75,6 +77,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         n_train=args.n_train,
         n_test=args.n_test,
         checkpoint_path=args.checkpoint,
+        profile_dir=args.profile,
     )
 
 

@@ -1,41 +1,93 @@
-"""Jittable image augmentation — the `ImageDataGenerator` analog.
+"""Jittable image augmentation — the `ImageDataGenerator` analog, MXU-native.
 
 The reference's training generator (/root/reference/FLPyfhelin.py:81-88)
 applies rescale=1/255, shear_range=0.2, zoom_range=0.2,
 horizontal_flip=True. Keras does this per-image on the host with PIL-style
-affine warps; here the whole batch is warped on device inside the jitted
-train step: one random affine (shear ∘ zoom ∘ flip) per image, applied via
-bilinear `map_coordinates` — so augmentation rides the TPU's vector units
-and the input pipeline never returns to the host.
+affine warps. A naive device port (`map_coordinates`) lowers to XLA's
+general 2-D gather — the TPU's slow path, ~6x the cost of the SGD step it
+feeds. Instead the affine warp here is decomposed into gather-free stages
+that all map onto the MXU / VPU:
+
+  1. vertical zoom   — one-hot bilinear interpolation MATRIX per image,
+                       applied as a batched matmul (two nonzeros per row;
+                       building it is a broadcast compare, applying it is
+                       256x256 @ 256x(W*C) on the MXU);
+  2. shear           — a per-row fractional x-shift delta(y) = tan(s)/zx *
+                       (y-c), done as a DFT phase ramp: the forward and
+                       inverse 320-point real DFTs are CONSTANT cos/sin
+                       matrices (shared across batch -> MXU matmuls), and
+                       the shift itself is an elementwise phase rotation.
+                       Edge-padded by 32px so the circular wrap never
+                       touches real pixels (max |delta| < 26 at shear 0.2);
+  3. horizontal zoom + flip — one-hot matrix matmul like stage 1.
+
+The composite inverse map equals the reference's affine exactly
+(src_y = (y-c)/zy + c, src_x = tan(s)/zx*(y-c) + f/zx*(x-c) + c); only the
+x-interpolation kernel differs (bandlimited sinc via the DFT instead of
+bilinear), which is immaterial for augmentation. Randomness semantics
+follow Keras: shear angle ~ U(-s, s) radians, zoom ~ U(1-z, 1+z) per axis,
+flip with probability 0.5.
 """
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+_PAD = 32  # edge padding for the DFT shift; > max shear displacement/2
 
 
-def _affine_grid(h: int, w: int, mat: jnp.ndarray) -> jnp.ndarray:
-    """Sample coordinates for a 2x2 center-anchored affine `mat` -> [2, H, W]."""
-    yy, xx = jnp.mgrid[0:h, 0:w]
-    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
-    y = yy.astype(jnp.float32) - cy
-    x = xx.astype(jnp.float32) - cx
-    src_y = mat[0, 0] * y + mat[0, 1] * x + cy
-    src_x = mat[1, 0] * y + mat[1, 1] * x + cx
-    return jnp.stack([src_y, src_x])
+def _lin_weights(src: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Sample positions [..., M] -> bilinear one-hot matrix [..., M, n]."""
+    f = jnp.clip(jnp.floor(src), 0, n - 1)
+    frac = src - f
+    i0 = f.astype(jnp.int32)
+    i1 = jnp.clip(i0 + 1, 0, n - 1)
+    eye = jnp.arange(n)
+    w0 = (1 - frac)[..., None] * (eye == i0[..., None])
+    w1 = frac[..., None] * (eye == i1[..., None])
+    return (w0 + w1).astype(jnp.float32)
 
 
-def _warp_one(img: jnp.ndarray, mat: jnp.ndarray) -> jnp.ndarray:
-    """Bilinear warp of one HWC image by the inverse-map matrix `mat`."""
-    h, w = img.shape[0], img.shape[1]
-    grid = _affine_grid(h, w, mat)
-    warp = lambda ch: jax.scipy.ndimage.map_coordinates(  # noqa: E731
-        ch, [grid[0], grid[1]], order=1, mode="nearest"
+@functools.lru_cache(maxsize=8)
+def _dft_mats(wp: int):
+    """Real-DFT analysis/synthesis matrices for length wp (host-built)."""
+    f = np.arange(wp // 2 + 1)
+    m = np.arange(wp)
+    ang = 2 * np.pi * np.outer(f, m) / wp
+    wgt = np.full(wp // 2 + 1, 2.0)
+    wgt[0] = 1.0
+    if wp % 2 == 0:
+        wgt[-1] = 1.0
+    return (
+        np.cos(ang).astype(np.float32),
+        np.sin(ang).astype(np.float32),
+        (np.cos(ang) * wgt[:, None] / wp).astype(np.float32),
+        (np.sin(ang) * wgt[:, None] / wp).astype(np.float32),
     )
-    return jax.vmap(warp, in_axes=2, out_axes=2)(img)
+
+
+def _shift_rows_dft(x: jnp.ndarray, delta: jnp.ndarray) -> jnp.ndarray:
+    """x[b, y, n, c] -> x sampled at n + delta[b, y] along axis 2 (sinc
+    interpolation, edge-padded against circular wrap)."""
+    w = x.shape[2]
+    wp = w + 2 * _PAD
+    cm, sm, icm, ism = _dft_mats(wp)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (_PAD, _PAD), (0, 0)), mode="edge")
+    xc = jnp.einsum("fm,bymc->byfc", jnp.asarray(cm), xp, preferred_element_type=jnp.float32)
+    xs = jnp.einsum("fm,bymc->byfc", jnp.asarray(sm), xp, preferred_element_type=jnp.float32)
+    phi = 2 * jnp.pi * jnp.arange(wp // 2 + 1)[None, None, :] * delta[:, :, None] / wp
+    cphi, sphi = jnp.cos(phi)[..., None], jnp.sin(phi)[..., None]
+    yc = xc * cphi + xs * sphi
+    ys = -xc * sphi + xs * cphi
+    out = jnp.einsum(
+        "fn,byfc->bync", jnp.asarray(icm), yc, preferred_element_type=jnp.float32
+    ) + jnp.einsum("fn,byfc->bync", jnp.asarray(ism), ys, preferred_element_type=jnp.float32)
+    return out[:, :, _PAD : _PAD + w, :]
 
 
 @partial(jax.jit, static_argnames=("shear", "zoom", "flip"))
@@ -47,12 +99,9 @@ def random_augment(
     flip: bool = True,
 ) -> jnp.ndarray:
     """Batch [B, H, W, C] float images -> augmented batch, one random
-    (shear, zoom, horizontal-flip) affine per image.
-
-    Ranges follow Keras semantics: shear angle ~ U(-shear, shear) radians,
-    zoom factor ~ U(1-zoom, 1+zoom) per axis, flip with prob 0.5.
-    """
-    b = images.shape[0]
+    (shear, zoom, horizontal-flip) affine per image. Gather-free; see the
+    module docstring for the three-stage decomposition."""
+    b, h, w = images.shape[0], images.shape[1], images.shape[2]
     k_shear, k_zx, k_zy, k_flip = jax.random.split(key, 4)
     s = jax.random.uniform(k_shear, (b,), minval=-shear, maxval=shear)
     zx = jax.random.uniform(k_zx, (b,), minval=1.0 - zoom, maxval=1.0 + zoom)
@@ -60,17 +109,20 @@ def random_augment(
     f = jnp.where(
         flip, jnp.sign(jax.random.uniform(k_flip, (b,)) - 0.5), jnp.ones((b,))
     )
-    # inverse map: dest -> src.  zoom z means sampling at 1/z; flip negates x;
-    # shear tilts x as a function of y (Keras-style shear about the center).
-    zeros = jnp.zeros((b,))
-    mat = jnp.stack(
-        [
-            jnp.stack([1.0 / zy, zeros], axis=-1),
-            jnp.stack([jnp.tan(s) / zx, f / zx], axis=-1),
-        ],
-        axis=-2,
-    )  # [B, 2, 2]
-    return jax.vmap(_warp_one)(images, mat)
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    yv = jnp.arange(h, dtype=jnp.float32)
+    xv = jnp.arange(w, dtype=jnp.float32)
+    # 1) vertical zoom: src_y = (y-cy)/zy + cy
+    src_y = jnp.clip((yv[None, :] - cy) / zy[:, None] + cy, 0, h - 1)
+    wy = _lin_weights(src_y, h)
+    t1 = jnp.einsum("byv,bvwc->bywc", wy, images, preferred_element_type=jnp.float32)
+    # 2) shear: x-shift by delta(y) = tan(s)/zx * (y-cy)
+    delta = (jnp.tan(s) / zx)[:, None] * (yv[None, :] - cy)
+    t2 = _shift_rows_dft(t1, delta)
+    # 3) horizontal zoom + flip: src_x = f/zx*(x-cx) + cx
+    src_x = jnp.clip((f / zx)[:, None] * (xv[None, :] - cx) + cx, 0, w - 1)
+    wx = _lin_weights(src_x, w)
+    return jnp.einsum("bxu,byuc->byxc", wx, t2, preferred_element_type=jnp.float32)
 
 
 def rescale(images: jnp.ndarray) -> jnp.ndarray:

@@ -73,6 +73,7 @@ class ExperimentConfig:
     n_test: int | None = None
     checkpoint_path: str | None = None
     exact_final_decode: bool = False  # bignum CRT decode on the last round
+    profile_dir: str | None = None    # write a jax.profiler trace of round 0
 
 
 def _partition(cfg: ExperimentConfig, y: np.ndarray) -> list[np.ndarray]:
@@ -123,6 +124,12 @@ def run_experiment(
 
     history: list[dict[str, Any]] = []
     for r in range(start_round, cfg.rounds):
+        # Tracing (SURVEY.md §5): the reference brackets phases with
+        # time.time()+print; we keep that (PhaseTimer below) and add a real
+        # profiler trace of the first executed round on request.
+        profiling = cfg.profile_dir is not None and r == start_round
+        if profiling:
+            jax.profiler.start_trace(cfg.profile_dir)
         timer = PhaseTimer()
         key, k_round = jax.random.split(key)
         if cfg.encrypted:
@@ -145,9 +152,13 @@ def run_experiment(
                 jax.block_until_ready((params, metrics))
         with timer.phase("evaluate"):
             results = evaluate(module, params, xt, yt)
+        if profiling:
+            jax.profiler.stop_trace()
+            say(f"profiler trace written to {cfg.profile_dir}")
         record = {
             "round": r,
             "phases": timer.summary(),
+            "val_loss": np.asarray(metrics)[:, -1, 0].tolist(),
             "val_acc": np.asarray(metrics)[:, -1, 1].tolist(),
             **{k: float(results[k]) for k in ("accuracy", "precision", "recall", "f1")},
         }

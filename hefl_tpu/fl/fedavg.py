@@ -109,8 +109,14 @@ def evaluate(
 
     n = len(x)
     pad = (-n) % batch_size
-    x_pad = np.concatenate([x, np.repeat(x[:1], pad, axis=0)]) if pad else x
-    probs = np.asarray(_predict_all(module, params, jnp.asarray(x_pad), batch_size))[:n]
+    if isinstance(x, jax.Array):
+        # Already device-resident (e.g. prefetched during training to hide
+        # the host->device transfer): pad on device, no host round-trip.
+        x_pad = jnp.concatenate([x, jnp.repeat(x[:1], pad, axis=0)]) if pad else x
+    else:
+        x_pad = np.concatenate([x, np.repeat(x[:1], pad, axis=0)]) if pad else x
+        x_pad = jnp.asarray(x_pad)
+    probs = np.asarray(_predict_all(module, params, x_pad, batch_size))[:n]
     out = classification_metrics(y, probs.argmax(-1))
     if return_probs:
         out["probs"] = probs

@@ -35,8 +35,9 @@ from hefl_tpu.ckks.ops import Ciphertext
 from hefl_tpu.ckks.packing import PackSpec, pack_pytree, unpack_blocks
 from hefl_tpu.fl.client import local_train
 from hefl_tpu.fl.config import TrainConfig
+from hefl_tpu.ckks.modular import add_mod as modular_add_mod
 from hefl_tpu.parallel import CLIENT_AXIS
-from hefl_tpu.parallel.collectives import MAX_PSUM_CLIENTS, psum_mod
+from hefl_tpu.parallel.collectives import MAX_PSUM_CLIENTS, psum_mod, ring_psum_mod
 
 
 @partial(jax.jit, static_argnums=0)
@@ -54,13 +55,23 @@ def encrypt_params(
 
 
 def _lazy_sum_mod(x: jax.Array, p: jax.Array) -> jax.Array:
-    """Sum uint32 residues over axis 0, one reduction at the end.
+    """Sum uint32 residues over axis 0 with lazy modular reduction.
 
-    Safe for up to MAX_PSUM_CLIENTS summands of <2**27 each (no uint32
-    wraparound) — same lazy-reduction argument as `psum_mod`.
+    Up to MAX_PSUM_CLIENTS summands of <2**27 each fit uint32 without
+    wraparound (the `psum_mod` argument), so reduction happens once per
+    chunk of 32; chunk results are canonical and fold together with
+    `add_mod` — any client count works, still O(1) `rem`s per ~32 clients.
     """
-    total = jnp.sum(x, axis=0, dtype=jnp.uint32)
-    return jax.lax.rem(total, jnp.broadcast_to(p, total.shape))
+    num = x.shape[0]
+    p_full = jnp.broadcast_to(p, x.shape[1:])
+
+    def chunk_sum(c):
+        return jax.lax.rem(jnp.sum(c, axis=0, dtype=jnp.uint32), p_full)
+
+    acc = chunk_sum(x[:MAX_PSUM_CLIENTS])
+    for lo in range(MAX_PSUM_CLIENTS, num, MAX_PSUM_CLIENTS):
+        acc = modular_add_mod(acc, chunk_sum(x[lo : lo + MAX_PSUM_CLIENTS]), p_full)
+    return acc
 
 
 def aggregate_encrypted(ctx: CkksContext, cts: Ciphertext) -> Ciphertext:
@@ -69,11 +80,6 @@ def aggregate_encrypted(ctx: CkksContext, cts: Ciphertext) -> Ciphertext:
     The server loop of `aggregate_encrypted_weights` (FLPyfhelin.py:378-381)
     as one vectorized reduction; works on any host/device, no mesh needed.
     """
-    num = int(cts.c0.shape[0])
-    if num > MAX_PSUM_CLIENTS:
-        raise ValueError(
-            f"{num} ciphertext stacks exceeds lazy-reduction bound {MAX_PSUM_CLIENTS}"
-        )
     p = jnp.asarray(ctx.ntt.p)
     return Ciphertext(
         c0=_lazy_sum_mod(cts.c0, p),
@@ -132,10 +138,6 @@ def secure_fedavg_round(
     replicated, metrics f32[C, E, 4]).
     """
     num_clients = int(xs.shape[0])
-    if num_clients > MAX_PSUM_CLIENTS:
-        raise ValueError(
-            f"{num_clients} clients exceeds lazy-reduction bound {MAX_PSUM_CLIENTS}"
-        )
     n_dev = mesh.shape[CLIENT_AXIS]
     if num_clients % n_dev != 0:
         raise ValueError(f"{num_clients} clients on {n_dev} devices: must divide")
@@ -161,10 +163,14 @@ def _build_secure_round_fn(module, cfg: TrainConfig, mesh, ctx: CkksContext):
         cts = jax.vmap(enc_one)(p_out, ke_blk)        # [cpd, n_ct, L, N]
         local = aggregate_encrypted(ctx, cts)          # this device's clients
         p = jnp.asarray(ctx.ntt.p)
+        # Per-device partials are canonical (< p < 2**27): the fused XLA
+        # all-reduce's lazy reduction is sound up to MAX_PSUM_CLIENTS
+        # devices; past that, the ppermute ring reduces canonically per hop.
+        reduce = psum_mod if mesh.shape[CLIENT_AXIS] <= MAX_PSUM_CLIENTS else ring_psum_mod
         return (
             Ciphertext(
-                c0=psum_mod(local.c0, p, CLIENT_AXIS),
-                c1=psum_mod(local.c1, p, CLIENT_AXIS),
+                c0=reduce(local.c0, p, CLIENT_AXIS),
+                c1=reduce(local.c1, p, CLIENT_AXIS),
                 scale=local.scale,
             ),
             mets,

@@ -11,6 +11,13 @@ encrypted path.
 """
 
 from hefl_tpu.parallel.mesh import CLIENT_AXIS, local_client_count, make_mesh
-from hefl_tpu.parallel.collectives import psum_mod, pmean_tree
+from hefl_tpu.parallel.collectives import psum_mod, pmean_tree, ring_psum_mod
 
-__all__ = ["CLIENT_AXIS", "make_mesh", "local_client_count", "psum_mod", "pmean_tree"]
+__all__ = [
+    "CLIENT_AXIS",
+    "make_mesh",
+    "local_client_count",
+    "psum_mod",
+    "pmean_tree",
+    "ring_psum_mod",
+]

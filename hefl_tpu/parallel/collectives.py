@@ -35,3 +35,27 @@ def psum_mod(residues: jax.Array, p: jax.Array, axis_name: str) -> jax.Array:
 def pmean_tree(tree, axis_name: str):
     """Plaintext FedAvg: pmean of a parameter pytree over the client axis."""
     return jax.tree_util.tree_map(lambda x: jax.lax.pmean(x, axis_name), tree)
+
+
+def ring_psum_mod(residues: jax.Array, p: jax.Array, axis_name: str) -> jax.Array:
+    """Modular all-reduce as an explicit ppermute ring — no participant cap.
+
+    `psum_mod` rides XLA's fused all-reduce but leans on lazy reduction, so
+    it is only sound for <= MAX_PSUM_CLIENTS participants. Here each of the
+    D-1 ring hops shifts the running buffer one neighbor over (XLA lowers
+    `ppermute` to ICI neighbor exchanges) and folds it in with a CANONICAL
+    modular add, so residues stay < p < 2**31 at every step and any device
+    count works. Tradeoff: D-1 full-tensor hops (bandwidth ~2x the optimal
+    reduce-scatter ring) and a serial chain — the right tool past the lazy
+    bound or when per-hop canonicality is wanted, not a psum replacement.
+    """
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    from hefl_tpu.ckks.modular import add_mod
+
+    acc = residues
+    buf = residues
+    for _ in range(n - 1):
+        buf = jax.lax.ppermute(buf, axis_name, perm)
+        acc = add_mod(acc, buf, jnp.broadcast_to(p, acc.shape))
+    return acc

@@ -1,0 +1,78 @@
+"""Collective backends: XLA psum vs explicit ppermute ring, and the
+chunked lazy modular sum that lifts the 32-summand bound."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hefl_tpu.parallel import CLIENT_AXIS, make_mesh, psum_mod, ring_psum_mod
+
+
+def _mesh8():
+    return make_mesh(8)
+
+
+def _sharded_reduce(fn, mesh, x, p):
+    body = lambda blk: fn(blk[0], p, CLIENT_AXIS)  # noqa: E731
+    return shard_map(
+        body, mesh=mesh, in_specs=P(CLIENT_AXIS), out_specs=P(), check_vma=False
+    )(x)
+
+
+def test_ring_matches_psum():
+    mesh = _mesh8()
+    p = jnp.asarray([[97], [89]], jnp.uint32)
+    rng = np.random.default_rng(0)
+    x = (rng.integers(0, 89, size=(8, 2, 16), dtype=np.int64)).astype(np.uint32)
+    a = np.asarray(_sharded_reduce(psum_mod, mesh, jnp.asarray(x), p))
+    b = np.asarray(_sharded_reduce(ring_psum_mod, mesh, jnp.asarray(x), p))
+    want = x.astype(np.int64).sum(axis=0) % np.array([[97], [89]])
+    np.testing.assert_array_equal(a, want.astype(np.uint32))
+    np.testing.assert_array_equal(b, want.astype(np.uint32))
+
+
+def test_ring_safe_where_lazy_psum_overflows():
+    """With p near 2**31, 8 lazy uint32 adds wrap; the per-hop canonical
+    ring must not."""
+    mesh = _mesh8()
+    big_p = np.uint32(2**31 - 1)                    # prime 2^31-1 (Mersenne)
+    p = jnp.asarray([[big_p]], jnp.uint32)
+    x = np.full((8, 1, 16), big_p - 1, dtype=np.uint32)
+    got = np.asarray(_sharded_reduce(ring_psum_mod, mesh, jnp.asarray(x), p))
+    want = (8 * (int(big_p) - 1)) % int(big_p)
+    np.testing.assert_array_equal(got, np.full((1, 16), want, np.uint32))
+
+
+def test_lazy_sum_mod_chunked_beyond_32():
+    from hefl_tpu.fl.secure import _lazy_sum_mod
+
+    rng = np.random.default_rng(1)
+    p_np = np.array([[134176769], [134111233]], dtype=np.uint32)
+    x = (rng.integers(0, 134111233, size=(70, 2, 64), dtype=np.int64)).astype(np.uint32)
+    got = np.asarray(_lazy_sum_mod(jnp.asarray(x), jnp.asarray(p_np)))
+    want = (x.astype(np.int64).sum(axis=0) % p_np.astype(np.int64)).astype(np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_aggregate_encrypted_beyond_32_stacks():
+    """40 client ciphertext stacks aggregate + decrypt-average correctly."""
+    from hefl_tpu.ckks import encoding, ops
+    from hefl_tpu.ckks.keys import CkksContext, keygen
+    from hefl_tpu.fl.secure import aggregate_encrypted
+
+    ctx = CkksContext.create(n=128)
+    sk, pk = keygen(ctx, jax.random.key(0))
+    num = 40
+    rng = np.random.default_rng(2)
+    w = rng.normal(0, 0.05, (num, ctx.n)).astype(np.float32)
+    cts = ops.encrypt(
+        ctx, pk, encoding.encode(ctx.ntt, jnp.asarray(w), ctx.scale), jax.random.key(1)
+    )
+    total = aggregate_encrypted(ctx, cts)
+    got = np.asarray(
+        encoding.decode(ctx.ntt, ops.decrypt(ctx, sk, total), total.scale * num)
+    )
+    np.testing.assert_allclose(got, w.mean(axis=0), atol=5e-5)

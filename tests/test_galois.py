@@ -1,0 +1,95 @@
+"""Encrypted slot rotations and conjugation via Galois automorphisms.
+
+Beyond-parity surface (the reference's HE layer has only add and
+plain-scalar multiply, SURVEY.md §2.10): with the orbit slot ordering,
+X -> X^{5^k} left-rotates slots by k and X -> X^{-1} conjugates them.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.ckks import encoding, galois, ops
+from hefl_tpu.ckks.keys import CkksContext, gen_galois_key, keygen
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return CkksContext.create(n=512)
+
+
+@pytest.fixture(scope="module")
+def material(ctx):
+    sk, pk = keygen(ctx, jax.random.key(31))
+    return sk, pk
+
+
+def _enc(ctx, pk, z, key):
+    return ops.encrypt(
+        ctx, pk, np.asarray(encoding.encode_slots(ctx.ntt, z, ctx.scale)), key
+    )
+
+
+def _dec(ctx, sk, ct):
+    return encoding.decode_slots(ctx.ntt, np.asarray(ops.decrypt(ctx, sk, ct)), ct.scale)
+
+
+def test_automorphism_tables_involution():
+    n = 64
+    g = galois.galois_elt_conjugation(n)
+    src, flip = galois.automorphism_tables(n, g)
+    # applying X -> X^{-1} twice is the identity
+    src2 = src[src]
+    flip2 = flip ^ flip[src]
+    np.testing.assert_array_equal(src2, np.arange(n))
+    assert not flip2.any()
+
+
+@pytest.mark.parametrize("steps", [1, 2, 7, -1])
+def test_rotate(ctx, material, steps):
+    sk, pk = material
+    rng = np.random.default_rng(steps & 0xFF)
+    z = rng.normal(0, 0.5, encoding.num_slots(ctx.ntt))
+    gk = gen_galois_key(
+        ctx, sk, jax.random.key(100 + steps), galois.galois_elt_rotation(ctx.n, steps)
+    )
+    ct = _enc(ctx, pk, z, jax.random.key(200 + steps))
+    got = _dec(ctx, sk, ops.ct_rotate(ctx, ct, gk, steps))
+    want = np.roll(z, -steps)
+    assert np.max(np.abs(got.real - want)) < 1e-3
+    assert np.max(np.abs(got.imag)) < 1e-3
+
+
+def test_conjugate(ctx, material):
+    sk, pk = material
+    rng = np.random.default_rng(5)
+    half = encoding.num_slots(ctx.ntt)
+    z = rng.normal(0, 0.5, half) + 1j * rng.normal(0, 0.5, half)
+    gk = gen_galois_key(ctx, sk, jax.random.key(300), galois.galois_elt_conjugation(ctx.n))
+    ct = _enc(ctx, pk, z, jax.random.key(301))
+    got = _dec(ctx, sk, ops.ct_conjugate(ctx, ct, gk))
+    assert np.max(np.abs(got - np.conj(z))) < 1e-3
+
+
+def test_wrong_key_raises(ctx, material):
+    sk, pk = material
+    gk1 = gen_galois_key(ctx, sk, jax.random.key(400), galois.galois_elt_rotation(ctx.n, 1))
+    ct = _enc(ctx, pk, np.zeros(encoding.num_slots(ctx.ntt)), jax.random.key(401))
+    with pytest.raises(ValueError):
+        ops.ct_rotate(ctx, ct, gk1, steps=2)
+    with pytest.raises(ValueError):
+        ops.ct_conjugate(ctx, ct, gk1)
+
+
+def test_rotate_then_sum_gives_inner_product_style_shift(ctx, material):
+    """rotate(ct,1) + ct decodes to z + roll(z,-1) — the building block of
+    encrypted reductions/inner products."""
+    sk, pk = material
+    rng = np.random.default_rng(6)
+    z = rng.normal(0, 0.5, encoding.num_slots(ctx.ntt))
+    gk = gen_galois_key(ctx, sk, jax.random.key(500), galois.galois_elt_rotation(ctx.n, 1))
+    ct = _enc(ctx, pk, z, jax.random.key(501))
+    total = ops.ct_add(ctx, ops.ct_rotate(ctx, ct, gk, 1), ct)
+    got = _dec(ctx, sk, total)
+    assert np.max(np.abs(got.real - (z + np.roll(z, -1)))) < 2e-3
