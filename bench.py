@@ -6,41 +6,89 @@ images at 256x256x3, the 222,722-param CNN, HE-encrypted FedAvg — total
 pipeline wall-clock **6583.6 s** on its CPU (train + encrypt + export +
 aggregate + decrypt + evaluate).
 
-Here the same pipeline is: one jit-compiled program for [2-client local
-training (10 epochs each) + CKKS encryption of both updates + homomorphic
-aggregation], then owner decrypt and test-set evaluation. The printed
-wall-clock includes XLA compilation (the reference's number likewise
-includes all one-time overheads).
+What this harness measures (BASELINE.json's north star is FL
+rounds/sec/chip, so cold and warm are reported separately):
 
-Output: ONE JSON line {metric, value, unit, vs_baseline} on stdout;
-phase breakdown on stderr.
+  * round 0  — the reference-equivalent pipeline, COLD: one full encrypted
+    round (2-client 10-epoch training + CKKS encrypt + homomorphic
+    aggregation) + owner decrypt + test-set evaluation, including every
+    one-time cost this process pays (XLA compile or persistent-cache load).
+    This is `value` / `vs_baseline` in the JSON line.
+  * rounds 1..R-1 — the same program WARM (compiled program reuse).
+    `warm_round_s` is their mean; `rounds_per_sec_per_chip` = 1 /
+    warm_round_s on this single chip. `train_mfu` is the analytic CNN
+    fwd+bwd FLOPs over the warm train-phase time vs the chip's bf16 peak.
+  * cell-6 comparison artifact (`Encrypted FL Main-Rel.ipynb:428`): the
+    final round is re-run as *plaintext* FedAvg from the same starting
+    weights with the same client PRNG keys (secure_fedavg_round splits its
+    key into (k_train, k_enc) and uses split(k_train, C) for the clients —
+    passing k_train to fedavg_round reproduces the identical local
+    trainings), so `enc_plain_max_abs_diff` isolates pure CKKS
+    encode/encrypt/aggregate/decrypt error, and `ciphertext_expansion` is
+    wire bytes of the aggregated ciphertexts over float32 weight bytes.
+
+A persistent XLA compilation cache is enabled (standard TPU production
+practice); `compile_cache` in the JSON records whether round 0 found it
+warm, so the cold number is never silently conflated across runs.
+
+Output: ONE JSON line on stdout; phase breakdown on stderr.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-BASELINE_TOTAL_S = 6583.6  # BASELINE.md: total pipeline wall-clock
+BASELINE_TOTAL_S = 6583.6   # BASELINE.md: total pipeline wall-clock
+BASELINE_ACC = 0.8425       # BASELINE.md: reference test accuracy
+
+# bf16 peak FLOP/s by TPU generation (public spec sheets), for the MFU
+# estimate. Unknown device kinds report mfu=null rather than a guess.
+_PEAK_BF16 = {
+    "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+    "v5p": 459e12, "v5": 459e12,
+    "v6e": 918e12, "v6 lite": 918e12, "trillium": 918e12,
+}
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for tag, peak in _PEAK_BF16.items():
+        if tag in kind:
+            return peak
+    return None
+
+
+def _program_flops(fn, *args) -> float | None:
+    """Analytic FLOPs of jit(fn)(*args) via XLA cost analysis."""
+    import jax
+
+    try:
+        cost = jax.jit(fn).lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost["flops"])
+    except Exception as e:  # cost analysis is advisory; never fail the bench
+        log(f"cost_analysis unavailable: {e}")
+        return None
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    # Persistent XLA compilation cache: the reference's 6583.6 s includes no
-    # compilation (TF eager-ish CPU kernels); ours is dominated by one-time
-    # XLA compiles on a cold process. Standard production practice on TPU —
-    # repeat runs skip straight to execution.
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    cache_warm = os.path.isdir(".jax_cache") and len(os.listdir(".jax_cache")) > 0
 
     from hefl_tpu.ckks.keys import CkksContext, keygen
     from hefl_tpu.ckks.packing import PackSpec
@@ -49,22 +97,31 @@ def main() -> None:
         TrainConfig,
         decrypt_average,
         evaluate,
+        fedavg_round,
         secure_fedavg_round,
     )
     from hefl_tpu.models import create_model, count_params
     from hefl_tpu.parallel import make_mesh
 
     num_clients = 2
-    log(f"devices: {jax.devices()}")
+    rounds = max(1, int(os.environ.get("BENCH_ROUNDS", "3")))
+    seed = int(os.environ.get("BENCH_SEED", "0"))
+    dev = jax.devices()[0]
+    log(f"devices: {jax.devices()} (cache_warm={cache_warm})")
 
     # --- data (not timed: the reference reads pre-existing files on disk) ---
-    (x, y), (xt, yt), spec_ds = make_dataset("medical", seed=0)
+    (x, y), (xt, yt), _ = make_dataset("medical", seed=0)
     xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
     log(f"data: train {x.shape} -> {xs.shape} federated, test {xt.shape}")
 
-    module, params = create_model("medcnn")
+    # BENCH_SEED varies model init AND all training/augment/encryption keys,
+    # so a multi-seed sweep is a genuine robustness check.
+    module, params = create_model("medcnn", rng=jax.random.key(seed + 123))
     assert count_params(params) == 222_722
-    cfg = TrainConfig()  # reference defaults: 10 epochs, bs 32, augment, ES/plateau
+    # Reference defaults (10 epochs, bs 32, augment, ES/plateau) plus a
+    # 2-epoch linear lr warmup — stabilizes bf16 training of the deep
+    # 256x256 CNN without touching the reference's lr=1e-3 target.
+    cfg = TrainConfig(warmup_steps=44)
     mesh = make_mesh(num_clients)
     ctx = CkksContext.create()  # N=4096 -> 55 ciphertexts for 222,722 params
     sk, pk = keygen(ctx, jax.random.key(99))
@@ -72,41 +129,140 @@ def main() -> None:
     log(f"CKKS: N={ctx.n}, L={ctx.num_primes}, n_ct={pack.n_ct}")
 
     xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
+    base_key = jax.random.key(seed + 5)
 
-    t0 = time.perf_counter()
-    ct_sum, metrics = secure_fedavg_round(
-        module, cfg, mesh, ctx, pk, params, xs_d, ys_d, jax.random.key(5)
+    # Analytic train FLOPs for the MFU estimate: fwd cost of one batch x 3
+    # (fwd + bwd ~= 3x fwd) x steps/epoch x epochs x clients.
+    n_tr = xs.shape[1] - int(xs.shape[1] * cfg.val_fraction)
+    steps_per_epoch = n_tr // cfg.batch_size
+    fwd_flops = _program_flops(
+        lambda p, xb: module.apply({"params": p}, xb),
+        params,
+        jnp.zeros((cfg.batch_size, 256, 256, 3), jnp.float32),
     )
-    # Prefetch the test set while the training round runs: dispatch is
-    # async, so the host->device copy rides out the training wall-clock
-    # (standard input-pipeline overlap; still inside the timed window).
-    xt_d = jax.device_put(jnp.asarray(xt))
-    jax.block_until_ready((ct_sum.c0, ct_sum.c1, metrics))
-    t1 = time.perf_counter()
-    new_params = decrypt_average(ctx, sk, ct_sum, num_clients, pack)
-    jax.block_until_ready(new_params)
-    t2 = time.perf_counter()
-    results = evaluate(module, new_params, xt_d, yt)
-    t3 = time.perf_counter()
+    train_flops = (
+        3.0 * fwd_flops * steps_per_epoch * cfg.epochs * num_clients
+        if fwd_flops
+        else None
+    )
 
-    total = t3 - t0
+    round_stats = []
+    history = []
+    xt_d = None
+    cur = params
+    for r in range(rounds):
+        k_round = jax.random.fold_in(base_key, r)
+        t0 = time.perf_counter()
+        ct_sum, metrics = secure_fedavg_round(
+            module, cfg, mesh, ctx, pk, cur, xs_d, ys_d, k_round
+        )
+        if xt_d is None:
+            # Prefetch the test set while training runs: dispatch is async,
+            # so the host->device copy rides out the train wall-clock.
+            xt_d = jax.device_put(jnp.asarray(xt))
+        jax.block_until_ready((ct_sum.c0, ct_sum.c1, metrics))
+        t1 = time.perf_counter()
+        new_params = decrypt_average(ctx, sk, ct_sum, num_clients, pack)
+        jax.block_until_ready(new_params)
+        t2 = time.perf_counter()
+        results = evaluate(module, new_params, xt_d, yt)
+        t3 = time.perf_counter()
+        round_stats.append(
+            {"train": t1 - t0, "decrypt": t2 - t1, "evaluate": t3 - t2,
+             "total": t3 - t0}
+        )
+        history.append({k: float(results[k]) for k in ("accuracy", "f1")})
+        log(
+            f"round {r}: train+encrypt+aggregate {t1 - t0:.2f}s | "
+            f"decrypt {t2 - t1:.2f}s | evaluate {t3 - t2:.2f}s | "
+            f"total {t3 - t0:.2f}s | acc {results['accuracy']:.4f} "
+            f"f1 {results['f1']:.4f}"
+        )
+        log(f"  per-client val-acc: {np.asarray(metrics)[:, :, 1].round(3)}")
+        last_ct_sum, last_start, last_key, last_enc = ct_sum, cur, k_round, new_params
+        cur = new_params
+
+    # --- cell-6 comparison artifact: plaintext round, same trainings ------
+    k_train, _ = jax.random.split(last_key)
+    tp0 = time.perf_counter()
+    plain_params, _ = fedavg_round(
+        module, cfg, mesh, last_start, xs_d, ys_d, k_train
+    )
+    jax.block_until_ready(plain_params)
+    plaintext_round_s = time.perf_counter() - tp0
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), last_enc, plain_params
+    )
+    max_diff = max(jax.tree_util.tree_leaves(diffs))
+    # Same comparison through the exact bignum/C++ CRT decode: isolates pure
+    # HE noise (encrypt/aggregate/decrypt) from the jittable f32 decode's
+    # recombination error.
+    enc_exact = decrypt_average(ctx, sk, last_ct_sum, num_clients, pack, exact=True)
+    diffs_exact = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), enc_exact, plain_params
+    )
+    max_diff_exact = max(jax.tree_util.tree_leaves(diffs_exact))
+    ct_bytes = (last_ct_sum.c0.size + last_ct_sum.c1.size) * 4
+    param_bytes = count_params(params) * 4
+    expansion = ct_bytes / param_bytes
     log(
-        f"phases: train+encrypt+aggregate {t1 - t0:.2f}s | decrypt {t2 - t1:.2f}s"
-        f" | evaluate {t3 - t2:.2f}s | total {total:.2f}s"
+        f"cell-6 artifact: plaintext round {plaintext_round_s:.2f}s, "
+        f"max |enc_avg - plain_avg| = {max_diff:.2e} (f32 decode) / "
+        f"{max_diff_exact:.2e} (exact decode), "
+        f"ciphertext {ct_bytes / 1e6:.1f} MB vs plain {param_bytes / 1e6:.1f} MB "
+        f"({expansion:.1f}x expansion)"
+    )
+
+    cold = round_stats[0]
+    warm = round_stats[1:]
+    warm_round_s = float(np.mean([s["total"] for s in warm])) if warm else None
+    # Mean warm time still carries one-time costs trickling into round 1
+    # (tunnel transfers, cache writes); the MIN warm round is the
+    # steady-state an R-round experiment converges to, so the north-star
+    # rate uses it.
+    steady_round_s = float(np.min([s["total"] for s in warm])) if warm else None
+    steady_train_s = float(np.min([s["train"] for s in warm])) if warm else None
+    peak = _peak_flops(dev)
+    mfu = (
+        train_flops / steady_train_s / peak
+        if (train_flops and steady_train_s and peak)
+        else None
     )
     log(
-        "quality: acc {accuracy:.4f} prec {precision:.4f} rec {recall:.4f} "
-        "f1 {f1:.4f}".format(**{k: results[k] for k in ("accuracy", "precision", "recall", "f1")})
+        f"cold round {cold['total']:.2f}s | warm mean "
+        f"{warm_round_s and round(warm_round_s, 2)}s | steady "
+        f"{steady_round_s and round(steady_round_s, 2)}s | "
+        f"rounds/sec/chip {steady_round_s and round(1 / steady_round_s, 4)} | "
+        f"train MFU {mfu and round(mfu, 3)}"
     )
-    log(f"per-client val-acc trajectory:\n{np.asarray(metrics)[:, :, 1]}")
 
     print(
         json.dumps(
             {
                 "metric": "encrypted_fedavg_pipeline_wallclock",
-                "value": round(total, 3),
+                "value": round(cold["total"], 3),
                 "unit": "s",
-                "vs_baseline": round(BASELINE_TOTAL_S / total, 2),
+                "vs_baseline": round(BASELINE_TOTAL_S / cold["total"], 2),
+                "compile_cache": "warm" if cache_warm else "cold",
+                "rounds": rounds,
+                "warm_round_s": warm_round_s and round(warm_round_s, 3),
+                "steady_round_s": steady_round_s and round(steady_round_s, 3),
+                "rounds_per_sec_per_chip": steady_round_s
+                and round(1.0 / steady_round_s, 4),
+                "train_mfu": mfu and round(mfu, 4),
+                "device": getattr(dev, "device_kind", str(dev)),
+                # `accuracy` pairs with `value`: both are the round-0
+                # pipeline (the reference-equivalent single pass). Later
+                # rounds' accuracies are in accuracy_by_round.
+                "accuracy": history[0]["accuracy"],
+                "accuracy_by_round": [h["accuracy"] for h in history],
+                "acc_vs_reference": round(
+                    history[0]["accuracy"] - BASELINE_ACC, 4
+                ),
+                "plaintext_round_s": round(plaintext_round_s, 3),
+                "enc_plain_max_abs_diff": max_diff,
+                "enc_plain_max_abs_diff_exact_decode": max_diff_exact,
+                "ciphertext_expansion": round(expansion, 2),
             }
         )
     )

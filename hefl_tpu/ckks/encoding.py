@@ -30,18 +30,39 @@ from hefl_tpu.ckks.ntt import NTTContext
 from hefl_tpu.ckks.primes import host_to_mont
 
 
+# Largest |round(v*scale)| the encode path handles safely: the hard wall is
+# int32 overflow at 2**31 (the cast wraps to the opposite sign). The float32
+# product's rounding slop at that magnitude is <= 2**31 * 2**-24 = 128, so
+# the bound backs off 256 from the wall. (Sum-across-clients headroom under
+# q ~ 2**81 is budgeted separately by CkksContext.create.)
+ENCODE_BOUND = float(2**31 - 256)
+
+
 def encode(ctx: NTTContext, values: jnp.ndarray, scale: float) -> jnp.ndarray:
     """float[..., N] -> canonical residues uint32[..., L, N] (coefficient domain).
 
-    round(values * scale) must stay well inside +/- 2**30 (int32 exactness of
-    the float32 round); callers choose `scale` accordingly.
+    round(values * scale) must stay within +/- ENCODE_BOUND; a violating
+    value would wrap the int32 cast to the opposite sign and decode to
+    garbage, so it is saturated to the bound instead — overflow then shows
+    up as bounded clipping (like the reference's 64i.32f fixed-point
+    saturation envelope, SURVEY.md §0) rather than sign-flipped weights.
+    Callers choose `scale` so real weights never reach the bound;
+    `encode_overflow_count` reports violations for tests/diagnostics.
     """
-    scaled = jnp.round(values.astype(jnp.float32) * jnp.float32(scale)).astype(jnp.int32)
+    scaled = jnp.round(values.astype(jnp.float32) * jnp.float32(scale))
+    scaled = jnp.clip(scaled, -ENCODE_BOUND, ENCODE_BOUND).astype(jnp.int32)
     p = jnp.asarray(ctx.p)                      # uint32[L, 1]
     p_i32 = p.astype(jnp.int32)
     # numpy-style remainder: sign follows divisor, so result is canonical.
     res = jnp.remainder(scaled[..., None, :], p_i32)
     return res.astype(jnp.uint32)
+
+
+def encode_overflow_count(values: jnp.ndarray, scale: float) -> jnp.ndarray:
+    """How many of `values` would saturate in `encode` at this scale
+    (jittable diagnostic; 0 on a healthy pipeline)."""
+    scaled = jnp.abs(values.astype(jnp.float32)) * jnp.float32(scale)
+    return jnp.sum(scaled > ENCODE_BOUND)
 
 
 def _mixed_radix_digits(ctx: NTTContext, residues: jnp.ndarray):

@@ -155,9 +155,9 @@ class GaloisKey:
     is q~_i * phi_g(s); enables `ops.ct_rotate` / `ops.ct_conjugate`.
     """
 
-    g: int = dataclasses.field(metadata=dict(static=True))
-    b_mont: jax.Array = None   # uint32[C, L, N]
-    a_mont: jax.Array = None   # uint32[C, L, N]
+    b_mont: jax.Array          # uint32[C, L, N]
+    a_mont: jax.Array          # uint32[C, L, N]
+    g: int = dataclasses.field(metadata=dict(static=True), kw_only=True)
 
 
 def sample_ternary_residues(ctx: CkksContext, key: jax.Array, batch=()) -> jnp.ndarray:

@@ -21,14 +21,25 @@ def build_parser() -> argparse.ArgumentParser:
         prog="hefl_tpu",
         description="TPU-native homomorphic-encryption federated learning",
     )
+    p.add_argument("--preset", default=None,
+                   help="run a named BASELINE.json config (see "
+                        "hefl_tpu.presets.PRESETS); other flags are ignored")
     p.add_argument("--model", default="medcnn", choices=sorted(MODEL_REGISTRY))
     p.add_argument("--dataset", default="medical",
                    choices=["medical", "mnist", "cifar10"])
+    p.add_argument("--data-dir", default=None, metavar="DIR",
+                   help="directory of class-subdir images (reference layout: "
+                        "DIR/Train and DIR/Test, or one folder that gets an "
+                        "80/20 split); overrides --dataset")
+    p.add_argument("--image-size", type=int, default=256,
+                   help="decode size for --data-dir images (HxH)")
     p.add_argument("--num-clients", type=int, default=2)
     p.add_argument("--rounds", type=int, default=1)
     p.add_argument("--epochs", type=int, default=10, help="local epochs per round")
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="linear lr warmup steps (0 = reference behavior)")
     p.add_argument("--num-classes", type=int, default=None,
                    help="default: the model's registry default")
     p.add_argument("--plaintext", action="store_true",
@@ -59,6 +70,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
     return ExperimentConfig(
         model=args.model,
         dataset=args.dataset,
+        data_dir=args.data_dir,
+        image_size=(args.image_size, args.image_size),
         num_clients=args.num_clients,
         rounds=args.rounds,
         encrypted=not args.plaintext,
@@ -68,6 +81,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             epochs=args.epochs,
             batch_size=args.batch_size,
             lr=args.lr,
+            warmup_steps=args.warmup_steps,
             prox_mu=args.prox_mu,
             augment=not args.no_augment,
             num_classes=num_classes,
@@ -83,7 +97,16 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    cfg = config_from_args(args)
+    if args.preset is not None:
+        from hefl_tpu.presets import PRESETS
+
+        if args.preset not in PRESETS:
+            raise SystemExit(
+                f"unknown preset {args.preset!r}; available: {sorted(PRESETS)}"
+            )
+        cfg = PRESETS[args.preset]
+    else:
+        cfg = config_from_args(args)
     out = run_experiment(cfg, resume=args.resume, verbose=not args.json)
     if args.json:
         for rec in out["history"]:

@@ -23,7 +23,11 @@ BASELINE.json's configs.
 """
 
 from hefl_tpu.data.batches import Batcher, one_hot
-from hefl_tpu.data.folder import load_image_dataset, scan_image_folder
+from hefl_tpu.data.folder import (
+    load_folder_splits,
+    load_image_dataset,
+    scan_image_folder,
+)
 from hefl_tpu.data.partition import (
     client_slice,
     iid_contiguous,
@@ -38,6 +42,7 @@ __all__ = [
     "one_hot",
     "scan_image_folder",
     "load_image_dataset",
+    "load_folder_splits",
     "iid_contiguous",
     "label_skew",
     "client_slice",

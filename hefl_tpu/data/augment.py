@@ -24,9 +24,12 @@ that all map onto the MXU / VPU:
 The composite inverse map equals the reference's affine exactly
 (src_y = (y-c)/zy + c, src_x = tan(s)/zx*(y-c) + f/zx*(x-c) + c); only the
 x-interpolation kernel differs (bandlimited sinc via the DFT instead of
-bilinear), which is immaterial for augmentation. Randomness semantics
-follow Keras: shear angle ~ U(-s, s) radians, zoom ~ U(1-z, 1+z) per axis,
-flip with probability 0.5.
+bilinear). Sinc interpolation rings (Gibbs overshoot of a few percent at
+sharp edges), so the sheared rows are clamped back to each image's own
+value range — Keras' bilinear warp is range-preserving and ours must be
+too ([0,1] pixels stay [0,1]). Randomness semantics follow Keras: shear
+angle ~ U(-s, s) radians, zoom ~ U(1-z, 1+z) per axis, flip with
+probability 0.5.
 """
 
 from __future__ import annotations
@@ -38,7 +41,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_PAD = 32  # edge padding for the DFT shift; > max shear displacement/2
+# Edge padding for the DFT shift. Must exceed the worst-case shear
+# displacement tan(shear)/zx * (H-1)/2 = tan(0.2)/0.8 * 127.5 = 32.3 px at
+# Keras-default ranges on 256x256, else the circular wrap leaks the opposite
+# edge into corner rows.
+_PAD = 40
 
 
 def _lin_weights(src: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -116,9 +123,13 @@ def random_augment(
     src_y = jnp.clip((yv[None, :] - cy) / zy[:, None] + cy, 0, h - 1)
     wy = _lin_weights(src_y, h)
     t1 = jnp.einsum("byv,bvwc->bywc", wy, images, preferred_element_type=jnp.float32)
-    # 2) shear: x-shift by delta(y) = tan(s)/zx * (y-cy)
+    # 2) shear: x-shift by delta(y) = tan(s)/zx * (y-cy). The sinc kernel
+    # overshoots at edges (Gibbs), so clamp back to the image's own range —
+    # stages 1 and 3 are convex (bilinear) and cannot overshoot.
     delta = (jnp.tan(s) / zx)[:, None] * (yv[None, :] - cy)
-    t2 = _shift_rows_dft(t1, delta)
+    lo = jnp.min(t1, axis=(1, 2), keepdims=True)
+    hi = jnp.max(t1, axis=(1, 2), keepdims=True)
+    t2 = jnp.clip(_shift_rows_dft(t1, delta), lo, hi)
     # 3) horizontal zoom + flip: src_x = f/zx*(x-cx) + cx
     src_x = jnp.clip((f / zx)[:, None] * (xv[None, :] - cx) + cx, 0, w - 1)
     wx = _lin_weights(src_x, w)

@@ -61,3 +61,56 @@ def load_image_dataset(
         with Image.open(p) as im:
             out[i] = np.asarray(im.convert("RGB").resize((w, h)), np.uint8)
     return out, labels, class_names
+
+
+def load_folder_splits(
+    data_dir: str,
+    image_size: tuple[int, int] = (256, 256),
+    seed: int = 42,
+    test_fraction: float = 0.2,
+):
+    """Load a reference-layout dataset directory into train/test arrays.
+
+    The reference's primary input is a directory with `Train/` and `Test/`
+    subfolders, one class per subdirectory under each
+    (/root/reference/FLPyfhelin.py:38-55 plus the notebook's
+    `image/Train` / `image/Test` constants). If `data_dir` has those
+    subfolders they are used verbatim; otherwise `data_dir` itself is
+    scanned as one class-per-subdir folder and split
+    (1-test_fraction)/test_fraction after the deterministic shuffle.
+
+    -> ((x uint8[n,H,W,3], y int32[n]), (xt, yt), class_names)
+    """
+    subdirs = {
+        d.lower(): os.path.join(data_dir, d)
+        for d in os.listdir(data_dir)
+        if os.path.isdir(os.path.join(data_dir, d))
+    }
+    train_dir, test_dir = subdirs.get("train"), subdirs.get("test")
+    if train_dir and test_dir:
+        x, y, names = load_image_dataset(train_dir, image_size, True, seed)
+        xt, yt, names_t = load_image_dataset(test_dir, image_size, False, seed)
+        if names_t != names:
+            raise ValueError(
+                f"Train/Test class mismatch: {names} vs {names_t}"
+            )
+        return (x, y), (xt, yt), names
+    if train_dir or test_dir:
+        raise ValueError(
+            f"{data_dir} has a {'Train' if train_dir else 'Test'} subfolder "
+            "but not its counterpart; provide both Train/ and Test/ (any "
+            "casing) or a flat class-per-subdir folder"
+        )
+    x, y, names = load_image_dataset(data_dir, image_size, True, seed)
+    if len(x) == 0:
+        raise ValueError(
+            f"no images found under {data_dir} (subdirectories scanned as "
+            f"classes: {names}); expected one subdirectory per class "
+            "containing image files"
+        )
+    n_test = int(round(len(x) * test_fraction))
+    if n_test == 0 or n_test == len(x):
+        raise ValueError(
+            f"cannot split {len(x)} images with test_fraction={test_fraction}"
+        )
+    return (x[n_test:], y[n_test:]), (x[:n_test], y[:n_test]), names

@@ -21,7 +21,13 @@ import numpy as np
 
 from hefl_tpu.ckks.keys import CkksContext, keygen
 from hefl_tpu.ckks.packing import PackSpec
-from hefl_tpu.data import iid_contiguous, label_skew, make_dataset, stack_federated
+from hefl_tpu.data import (
+    iid_contiguous,
+    label_skew,
+    load_folder_splits,
+    make_dataset,
+    stack_federated,
+)
 from hefl_tpu.fl import (
     TrainConfig,
     decrypt_average,
@@ -61,6 +67,9 @@ class ExperimentConfig:
 
     model: str = "medcnn"
     dataset: str = "medical"
+    data_dir: str | None = None       # real image folder (reference layout);
+                                      # overrides `dataset` when set
+    image_size: tuple[int, int] = (256, 256)
     num_clients: int = 2
     rounds: int = 1
     encrypted: bool = True
@@ -94,13 +103,31 @@ def run_experiment(
     DataFrames as one record per round.
     """
     say = print if verbose else (lambda *_: None)
-    (x, y), (xt, yt), _ = make_dataset(
-        cfg.dataset, seed=cfg.seed, n_train=cfg.n_train, n_test=cfg.n_test
-    )
+    train_cfg = cfg.train
+    if cfg.data_dir is not None:
+        # The reference's primary workflow: point the tool at a folder of
+        # class-subdir images (FLPyfhelin.py:38-55, notebook `image/Train`).
+        (x, y), (xt, yt), class_names = load_folder_splits(
+            cfg.data_dir, image_size=cfg.image_size, seed=cfg.seed
+        )
+        say(f"data dir {cfg.data_dir}: classes {class_names}, "
+            f"train {x.shape}, test {xt.shape}")
+        if train_cfg.num_classes != len(class_names):
+            train_cfg = dataclasses.replace(
+                train_cfg, num_classes=len(class_names)
+            )
+    else:
+        (x, y), (xt, yt), _ = make_dataset(
+            cfg.dataset, seed=cfg.seed, n_train=cfg.n_train, n_test=cfg.n_test
+        )
     xs, ys = stack_federated(x, y, _partition(cfg, y))
     xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
 
-    module, params = create_model(cfg.model, num_classes=cfg.train.num_classes)
+    module, params = create_model(
+        cfg.model,
+        num_classes=train_cfg.num_classes,
+        input_shape=tuple(int(d) for d in x.shape[1:]),
+    )
     mesh = make_mesh(cfg.num_clients)
     key = jax.random.key(cfg.seed)
 
@@ -135,7 +162,7 @@ def run_experiment(
         if cfg.encrypted:
             with timer.phase("train+encrypt+aggregate"):
                 ct_sum, metrics = secure_fedavg_round(
-                    module, cfg.train, mesh, ctx, pk, params, xs_d, ys_d, k_round
+                    module, train_cfg, mesh, ctx, pk, params, xs_d, ys_d, k_round
                 )
                 jax.block_until_ready((ct_sum.c0, ct_sum.c1, metrics))
             with timer.phase("decrypt"):
@@ -147,7 +174,7 @@ def run_experiment(
         else:
             with timer.phase("train+aggregate"):
                 params, metrics = fedavg_round(
-                    module, cfg.train, mesh, params, xs_d, ys_d, k_round
+                    module, train_cfg, mesh, params, xs_d, ys_d, k_round
                 )
                 jax.block_until_ready((params, metrics))
         with timer.phase("evaluate"):

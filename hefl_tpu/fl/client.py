@@ -95,7 +95,10 @@ def local_train(
             lambda p: loss_fn(module, p, xb, oh, global_params, cfg.prox_mu),
             has_aux=True,
         )(params)
-        params, opt = adam_update(grads, opt, params, cfg.lr, cfg.lr_decay, lr_scale)
+        params, opt = adam_update(
+            grads, opt, params, cfg.lr, cfg.lr_decay, lr_scale,
+            warmup_steps=cfg.warmup_steps,
+        )
         return (params, opt, lr_scale), (ce, acc)
 
     def epoch_step(state: ClientState, k_epoch):

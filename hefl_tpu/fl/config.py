@@ -19,6 +19,7 @@ class TrainConfig:
     batch_size: int = 32
     lr: float = 1e-3
     lr_decay: float = 1e-4          # Keras-style: lr_t = lr / (1 + decay*step)
+    warmup_steps: int = 0           # linear lr ramp (0 = reference behavior)
     val_fraction: float = 0.1
     es_patience: int = 5            # early stopping on val loss
     plateau_patience: int = 2       # ReduceLROnPlateau on val loss

@@ -40,11 +40,20 @@ def adam_update(
     b1: float = 0.9,
     b2: float = 0.999,
     eps: float = 1e-7,   # Keras default epsilon
+    warmup_steps: int = 0,
 ):
-    """-> (new_params, new_state). `lr_scale` is the plateau multiplier."""
+    """-> (new_params, new_state). `lr_scale` is the plateau multiplier.
+
+    `warmup_steps > 0` ramps the lr linearly from ~0 over that many steps
+    (applied before the Keras decay). The reference has no warmup; it is an
+    opt-in stabilizer for bf16 training of the deep 256x256 MedCNN, where a
+    full-lr first step from random init can swing early epochs violently.
+    """
     step = state.step + 1
     t = step.astype(jnp.float32)
     lr_t = lr / (1.0 + decay * t) * lr_scale
+    if warmup_steps > 0:
+        lr_t = lr_t * jnp.minimum(1.0, t / float(warmup_steps))
     bc1 = 1.0 - b1**t
     bc2 = 1.0 - b2**t
     mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads)

@@ -1,0 +1,53 @@
+"""The five BASELINE.json benchmark configurations as named presets.
+
+BASELINE.json `configs` (derived from the reference's experiment grid —
+notebook cell 3 loops over client counts, FLPyfhelin.py:179-198 — plus the
+dataset/model breadth the baseline calls for):
+
+  1. mnist-plain     2-client plaintext FedAvg, 2-conv CNN, MNIST
+  2. mnist-enc       2-client CKKS-encrypted FedAvg, MNIST
+  3. medical-8       8-client encrypted FedAvg, medical images, IID split
+  4. medical-skew    8-client non-IID (label-skew) encrypted FedAvg + FedProx
+  5. cifar-resnet16  16-client encrypted FedAvg, ResNet-20, CIFAR-10
+
+Every preset keeps the reference's local-training recipe (10 epochs, batch
+32, Adam 1e-3 with Keras decay, EarlyStopping/ReduceLROnPlateau) and runs
+2 communication rounds so a warm-round time — the FL rounds/sec/chip
+north-star metric — is measurable alongside the cold round.
+"""
+
+from __future__ import annotations
+
+from hefl_tpu.experiment import ExperimentConfig, HEConfig
+from hefl_tpu.fl import TrainConfig
+
+_MNIST_TRAIN = TrainConfig(num_classes=10, warmup_steps=0)
+# Warmup ~= 2 epochs of steps: 8 clients x 200 images -> 180 train, bs 32
+# -> 5 steps/epoch, so 10 warmup steps (the 2-client flagship uses 44).
+_MED_TRAIN = TrainConfig(num_classes=2, warmup_steps=10)
+
+PRESETS: dict[str, ExperimentConfig] = {
+    "mnist-plain": ExperimentConfig(
+        model="smallcnn", dataset="mnist", num_clients=2, rounds=2,
+        encrypted=False, train=_MNIST_TRAIN, seed=0,
+    ),
+    "mnist-enc": ExperimentConfig(
+        model="smallcnn", dataset="mnist", num_clients=2, rounds=2,
+        encrypted=True, train=_MNIST_TRAIN, he=HEConfig(), seed=0,
+    ),
+    "medical-8": ExperimentConfig(
+        model="medcnn", dataset="medical", num_clients=8, rounds=2,
+        encrypted=True, train=_MED_TRAIN, he=HEConfig(), seed=0,
+    ),
+    "medical-skew": ExperimentConfig(
+        model="medcnn", dataset="medical", num_clients=8, rounds=2,
+        encrypted=True, partition="label_skew", skew_alpha=0.5,
+        train=TrainConfig(num_classes=2, warmup_steps=10, prox_mu=0.01),
+        he=HEConfig(), seed=0,
+    ),
+    "cifar-resnet16": ExperimentConfig(
+        model="resnet20", dataset="cifar10", num_clients=16, rounds=2,
+        encrypted=True, train=TrainConfig(num_classes=10), he=HEConfig(),
+        seed=0,
+    ),
+}

@@ -84,6 +84,58 @@ def test_cli_flags_map_to_config():
     assert cfg.he.n == 2048
 
 
+def test_data_dir_experiment(tmp_path):
+    # Reference layout: DIR/Train/<class>/*.png + DIR/Test/<class>/*.png
+    # (FLPyfhelin.py:38-55). A full encrypted round must run straight off
+    # the folder.
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for split, n_per in (("Train", 16), ("Test", 4)):
+        for cname in ("covid", "normal"):
+            d = tmp_path / split / cname
+            d.mkdir(parents=True)
+            for i in range(n_per):
+                arr = rng.integers(0, 256, (20, 20, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(d / f"{i}.png")
+    cfg = _tiny_cfg(
+        data_dir=str(tmp_path),
+        image_size=(16, 16),
+        rounds=1,
+        n_train=None,
+        n_test=None,
+        train=TrainConfig(
+            epochs=1, batch_size=4, num_classes=10,  # wrong on purpose:
+            augment=False, val_fraction=0.25         # folder must override
+        ),
+    )
+    out = run_experiment(cfg, verbose=False)
+    assert len(out["history"]) == 1
+    assert 0.0 <= out["history"][0]["accuracy"] <= 1.0
+    # 2 classes from the folder, not the 10 in the config
+    assert np.asarray(out["params"]["Dense_1"]["kernel"]).shape[-1] == 2
+
+
+def test_load_folder_splits_single_dir(tmp_path):
+    from PIL import Image
+
+    from hefl_tpu.data import load_folder_splits
+
+    rng = np.random.default_rng(1)
+    for cname in ("a", "b"):
+        d = tmp_path / cname
+        d.mkdir()
+        for i in range(10):
+            arr = rng.integers(0, 256, (8, 8, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.png")
+    (x, y), (xt, yt), names = load_folder_splits(
+        str(tmp_path), image_size=(8, 8), test_fraction=0.2
+    )
+    assert names == ["a", "b"]
+    assert x.shape == (16, 8, 8, 3) and xt.shape == (4, 8, 8, 3)
+    assert len(y) == 16 and len(yt) == 4
+
+
 def test_cli_main_json_output(capsys):
     from hefl_tpu.cli import main
 

@@ -44,6 +44,31 @@ def test_adam_matches_keras_decay_schedule():
     assert int(st2.step) == 1
 
 
+def test_adam_warmup_ramps_linearly():
+    # warmup_steps=10: step t applies lr * t/10 (on top of the Keras decay),
+    # reaching the full schedule at t >= 10.
+    params = {"w": jnp.float32(1.0)}
+    g = {"w": jnp.float32(0.5)}
+    lr, decay = 1e-3, 1e-4
+    st = adam_init(params)
+    new_w, _ = adam_update(g, st, params, lr, decay, jnp.float32(1.0),
+                           warmup_steps=10)
+    ref_w, _ = adam_update(g, st, params, lr, decay, jnp.float32(1.0))
+    full_delta = 1.0 - float(ref_w["w"])
+    warm_delta = 1.0 - float(new_w["w"])
+    # deltas are ~1e-4 differences of float32 ~1.0 values: ~6e-4 relative
+    # quantization noise is inherent, so compare at 1e-2.
+    assert np.isclose(warm_delta, 0.1 * full_delta, rtol=1e-2)
+    # past the ramp the schedules coincide
+    import dataclasses as _dc
+
+    st_late = _dc.replace(adam_init(params), step=jnp.int32(20))
+    a, _ = adam_update(g, st_late, params, lr, decay, jnp.float32(1.0),
+                       warmup_steps=10)
+    b, _ = adam_update(g, st_late, params, lr, decay, jnp.float32(1.0))
+    assert np.isclose(float(a["w"]), float(b["w"]), rtol=1e-7)
+
+
 def test_local_train_improves_and_restores_best():
     model, params, xs, ys, xt, yt = _setup(1, 96)
     cfg = TrainConfig(epochs=3, batch_size=16, num_classes=10, augment=False,
