@@ -28,25 +28,25 @@ _MED_TRAIN = TrainConfig(num_classes=2, warmup_steps=10)
 
 PRESETS: dict[str, ExperimentConfig] = {
     "mnist-plain": ExperimentConfig(
-        model="smallcnn", dataset="mnist", num_clients=2, rounds=2,
+        model="smallcnn", dataset="mnist", num_clients=2, rounds=3,
         encrypted=False, train=_MNIST_TRAIN, seed=0,
     ),
     "mnist-enc": ExperimentConfig(
-        model="smallcnn", dataset="mnist", num_clients=2, rounds=2,
+        model="smallcnn", dataset="mnist", num_clients=2, rounds=3,
         encrypted=True, train=_MNIST_TRAIN, he=HEConfig(), seed=0,
     ),
     "medical-8": ExperimentConfig(
-        model="medcnn", dataset="medical", num_clients=8, rounds=2,
+        model="medcnn", dataset="medical", num_clients=8, rounds=3,
         encrypted=True, train=_MED_TRAIN, he=HEConfig(), seed=0,
     ),
     "medical-skew": ExperimentConfig(
-        model="medcnn", dataset="medical", num_clients=8, rounds=2,
+        model="medcnn", dataset="medical", num_clients=8, rounds=3,
         encrypted=True, partition="label_skew", skew_alpha=0.5,
         train=TrainConfig(num_classes=2, warmup_steps=10, prox_mu=0.01),
         he=HEConfig(), seed=0,
     ),
     "cifar-resnet16": ExperimentConfig(
-        model="resnet20", dataset="cifar10", num_clients=16, rounds=2,
+        model="resnet20", dataset="cifar10", num_clients=16, rounds=3,
         encrypted=True, train=TrainConfig(num_classes=10), he=HEConfig(),
         seed=0,
     ),

@@ -9,10 +9,14 @@ explicit subsystem with a single format.
 from hefl_tpu.utils.timers import PhaseTimer
 from hefl_tpu.utils.serialization import (
     load_ciphertext,
+    load_galois_key,
     load_public_material,
+    load_relin_key,
     load_secret_key,
     save_ciphertext,
+    save_galois_key,
     save_public_material,
+    save_relin_key,
     save_secret_key,
 )
 from hefl_tpu.utils.checkpoint import (
@@ -30,6 +34,10 @@ __all__ = [
     "load_secret_key",
     "save_ciphertext",
     "load_ciphertext",
+    "save_relin_key",
+    "load_relin_key",
+    "save_galois_key",
+    "load_galois_key",
     "save_checkpoint",
     "load_checkpoint",
     "save_params",

@@ -24,7 +24,13 @@ import json
 
 import numpy as np
 
-from hefl_tpu.ckks.keys import CkksContext, PublicKey, RelinKey, SecretKey
+from hefl_tpu.ckks.keys import (
+    CkksContext,
+    GaloisKey,
+    PublicKey,
+    RelinKey,
+    SecretKey,
+)
 from hefl_tpu.ckks.ntt import NTTContext
 from hefl_tpu.ckks.ops import Ciphertext
 
@@ -130,6 +136,30 @@ def load_relin_key(path: str) -> RelinKey:
     with np.load(path) as z:
         _read_header(z, "relin")
         return RelinKey(b_mont=jnp.asarray(z["b_mont"]), a_mont=jnp.asarray(z["a_mont"]))
+
+
+def save_galois_key(path: str, gk: GaloisKey) -> None:
+    """Rotation key for the automorphism X -> X^g: like the relin key, an
+    evaluation key the server may hold (enables ct_rotate, not decryption)."""
+    header = json.dumps({"magic": _MAGIC, "kind": "galois", "g": gk.g})
+    np.savez_compressed(
+        path,
+        header=np.frombuffer(header.encode(), dtype=np.uint8),
+        b_mont=np.asarray(gk.b_mont),
+        a_mont=np.asarray(gk.a_mont),
+    )
+
+
+def load_galois_key(path: str) -> GaloisKey:
+    import jax.numpy as jnp
+
+    with np.load(path) as z:
+        header = _read_header(z, "galois")
+        return GaloisKey(
+            b_mont=jnp.asarray(z["b_mont"]),
+            a_mont=jnp.asarray(z["a_mont"]),
+            g=int(header["g"]),
+        )
 
 
 def save_ciphertext(path: str, ct: Ciphertext) -> None:

@@ -136,6 +136,23 @@ def test_load_folder_splits_single_dir(tmp_path):
     assert len(y) == 16 and len(yt) == 4
 
 
+def test_presets_cover_baseline_configs():
+    # BASELINE.json names five configurations; every one must have a preset
+    # and each preset must be a valid, internally-consistent config.
+    from hefl_tpu.models import MODEL_REGISTRY
+    from hefl_tpu.presets import PRESETS
+
+    assert len(PRESETS) == 5
+    assert [p.encrypted for p in PRESETS.values()].count(False) == 1  # config 1
+    for name, cfg in PRESETS.items():
+        assert cfg.model in MODEL_REGISTRY, name
+        assert cfg.rounds >= 2, f"{name}: need a warm round to measure"
+        assert cfg.num_clients in (2, 8, 16)
+    assert PRESETS["medical-skew"].partition == "label_skew"
+    assert PRESETS["medical-skew"].train.prox_mu > 0
+    assert PRESETS["cifar-resnet16"].num_clients == 16
+
+
 def test_cli_main_json_output(capsys):
     from hefl_tpu.cli import main
 

@@ -56,6 +56,34 @@ def test_secret_key_file_contains_no_public_material(tmp_path, ctx_keys):
     np.testing.assert_array_equal(np.asarray(sk2.s_mont), np.asarray(sk.s_mont))
 
 
+def test_galois_key_roundtrip(tmp_path, ctx_keys):
+    from hefl_tpu.ckks.galois import galois_elt_rotation
+    from hefl_tpu.ckks.keys import gen_galois_key
+    from hefl_tpu.utils import load_galois_key, save_galois_key
+
+    ctx, sk, _ = ctx_keys
+    g = galois_elt_rotation(ctx.n, 1)
+    gk = gen_galois_key(ctx, sk, jax.random.key(77), g)
+    path = str(tmp_path / "galois.npz")
+    save_galois_key(path, gk)
+    gk2 = load_galois_key(path)
+    assert gk2.g == gk.g
+    np.testing.assert_array_equal(np.asarray(gk2.b_mont), np.asarray(gk.b_mont))
+    np.testing.assert_array_equal(np.asarray(gk2.a_mont), np.asarray(gk.a_mont))
+
+
+def test_relin_key_roundtrip(tmp_path, ctx_keys):
+    from hefl_tpu.ckks.keys import gen_relin_key
+    from hefl_tpu.utils import load_relin_key, save_relin_key
+
+    ctx, sk, _ = ctx_keys
+    rlk = gen_relin_key(ctx, sk, jax.random.key(78))
+    path = str(tmp_path / "relin.npz")
+    save_relin_key(path, rlk)
+    rlk2 = load_relin_key(path)
+    np.testing.assert_array_equal(np.asarray(rlk2.b_mont), np.asarray(rlk.b_mont))
+
+
 def test_ciphertext_wire_carries_no_keys(tmp_path, ctx_keys):
     ctx, sk, pk = ctx_keys
     vals = jnp.full((ctx.n,), 0.25, jnp.float32)
