@@ -104,7 +104,9 @@ def main() -> None:
     from hefl_tpu.parallel import make_mesh
 
     num_clients = 2
-    rounds = max(1, int(os.environ.get("BENCH_ROUNDS", "3")))
+    # >= 5 rounds so "steady" is a min over >= 3 genuinely-warm samples
+    # (round 1 still carries one-time trickle costs; VERDICT r2 weak #3).
+    rounds = max(1, int(os.environ.get("BENCH_ROUNDS", "5")))
     seed = int(os.environ.get("BENCH_SEED", "0"))
     dev = jax.devices()[0]
     log(f"devices: {jax.devices()} (cache_warm={cache_warm})")
@@ -149,11 +151,12 @@ def main() -> None:
     round_stats = []
     history = []
     xt_d = None
+    overflow_total = 0
     cur = params
     for r in range(rounds):
         k_round = jax.random.fold_in(base_key, r)
         t0 = time.perf_counter()
-        ct_sum, metrics = secure_fedavg_round(
+        ct_sum, metrics, overflow = secure_fedavg_round(
             module, cfg, mesh, ctx, pk, cur, xs_d, ys_d, k_round
         )
         if xt_d is None:
@@ -178,7 +181,10 @@ def main() -> None:
             f"total {t3 - t0:.2f}s | acc {results['accuracy']:.4f} "
             f"f1 {results['f1']:.4f}"
         )
-        log(f"  per-client val-acc: {np.asarray(metrics)[:, :, 1].round(3)}")
+        ov = int(np.sum(np.asarray(overflow)))
+        overflow_total += ov
+        log(f"  per-client val-acc: {np.asarray(metrics)[:, :, 1].round(3)}"
+            + (f" | ENCODE OVERFLOW: {ov} weights clipped" if ov else ""))
         last_ct_sum, last_start, last_key, last_enc = ct_sum, cur, k_round, new_params
         cur = new_params
 
@@ -262,6 +268,20 @@ def main() -> None:
                 "plaintext_round_s": round(plaintext_round_s, 3),
                 "enc_plain_max_abs_diff": max_diff,
                 "enc_plain_max_abs_diff_exact_decode": max_diff_exact,
+                # Saturation guard (VERDICT r2 weak #1): per-client weights
+                # clipped at the CKKS encode envelope across ALL rounds —
+                # 0 proves the fidelity number above is unclipped.
+                # max_abs_trained_weight is the final AVERAGED model's
+                # largest weight (a scale-headroom indicator only; per-client
+                # clipping is exactly what encode_overflow_count counts).
+                "encode_overflow_count": overflow_total,
+                "max_abs_trained_weight": round(
+                    max(
+                        float(jnp.max(jnp.abs(v)))
+                        for v in jax.tree_util.tree_leaves(plain_params)
+                    ),
+                    4,
+                ),
                 "ciphertext_expansion": round(expansion, 2),
             }
         )

@@ -30,32 +30,58 @@ from hefl_tpu.ckks.ntt import NTTContext
 from hefl_tpu.ckks.primes import host_to_mont
 
 
-# Largest |round(v*scale)| the encode path handles safely: the hard wall is
-# int32 overflow at 2**31 (the cast wraps to the opposite sign). The float32
-# product's rounding slop at that magnitude is <= 2**31 * 2**-24 = 128, so
-# the bound backs off 256 from the wall. (Sum-across-clients headroom under
-# q ~ 2**81 is budgeted separately by CkksContext.create.)
-ENCODE_BOUND = float(2**31 - 256)
+# The scaled value v = round(w*scale) is carried as a two-part split
+# v = hi * 2**_SPLIT_BITS + lo with hi, lo independent int32s, reduced mod
+# each RNS prime with one Montgomery multiply — so the encode envelope is
+# set by the int32 range of `hi`, not of v itself. The wall backs off 256
+# from 2**31 for the float32 rounding slop at that magnitude
+# (2**31 * 2**-24 = 128). At the default scale 2**30 this admits
+# |w| < ~2**16 (vs |w| < 2.0 for a single-int32 encode); for |w| < 2**9 the
+# split is bit-exact (see `encode`), beyond that encode precision degrades
+# like float32 itself. This matches the reference encoder's contract of a
+# wide integer envelope with fixed fractional precision (64i.32f,
+# /root/reference/FLPyfhelin.py:217).
+_SPLIT_BITS = 15
+_SPLIT = float(1 << _SPLIT_BITS)
+_HI_BOUND = float(2**31 - 256)
+ENCODE_BOUND = _HI_BOUND * _SPLIT
 
 
 def encode(ctx: NTTContext, values: jnp.ndarray, scale: float) -> jnp.ndarray:
     """float[..., N] -> canonical residues uint32[..., L, N] (coefficient domain).
 
-    round(values * scale) must stay within +/- ENCODE_BOUND; a violating
-    value would wrap the int32 cast to the opposite sign and decode to
-    garbage, so it is saturated to the bound instead — overflow then shows
-    up as bounded clipping (like the reference's 64i.32f fixed-point
-    saturation envelope, SURVEY.md §0) rather than sign-flipped weights.
-    Callers choose `scale` so real weights never reach the bound;
-    `encode_overflow_count` reports violations for tests/diagnostics.
+    v = round(values*scale) is computed as hi = round(w * scale/2**15)
+    (clipped to +/-_HI_BOUND — saturation, not int32 wraparound, exactly
+    like the reference's fixed-point envelope) plus lo = round((w*scale/2**15
+    - hi) * 2**15). For |w*scale| < 2**39 every step is exact in float32
+    (products by powers of two are exact; the residual after subtracting the
+    rounded hi is a representable multiple of the operand ulp), so the split
+    reproduces round(w*scale) up to the same +/-0.5 quantization as a direct
+    rounding. Beyond 2**39 the value is already coarser than 2**15 ulps in
+    float32, so lo is exactly 0 and precision degrades gracefully with the
+    float32 input itself. `encode_overflow_count` reports saturation.
+
+    Exactness of the hi/lo recombination assumes `scale` is a power of two
+    (the default 2**30 and every config in the repo); other scales encode
+    with one extra half-ulp of rounding slop.
     """
-    scaled = jnp.round(values.astype(jnp.float32) * jnp.float32(scale))
-    scaled = jnp.clip(scaled, -ENCODE_BOUND, ENCODE_BOUND).astype(jnp.int32)
-    p = jnp.asarray(ctx.p)                      # uint32[L, 1]
+    v = values.astype(jnp.float32)
+    s_hi = jnp.float32(scale / _SPLIT)
+    hi_f = jnp.clip(jnp.round(v * s_hi), -_HI_BOUND, _HI_BOUND)
+    r = v * s_hi - hi_f                       # exact where |v*s_hi| < 2**24
+    lo = jnp.clip(jnp.round(r * _SPLIT), -_SPLIT, _SPLIT).astype(jnp.int32)
+    hi = hi_f.astype(jnp.int32)
+    p = jnp.asarray(ctx.p)                    # uint32[L, 1]
     p_i32 = p.astype(jnp.int32)
-    # numpy-style remainder: sign follows divisor, so result is canonical.
-    res = jnp.remainder(scaled[..., None, :], p_i32)
-    return res.astype(jnp.uint32)
+    # numpy-style remainder: sign follows divisor, so residues are canonical.
+    hi_res = jnp.remainder(hi[..., None, :], p_i32).astype(jnp.uint32)
+    lo_res = jnp.remainder(lo[..., None, :], p_i32).astype(jnp.uint32)
+    shift_mont = jnp.asarray(
+        [[host_to_mont(1 << _SPLIT_BITS, int(pi))] for pi in np.asarray(ctx.p)[:, 0]],
+        dtype=jnp.uint32,
+    )
+    hi_shift = modular.mont_mul(hi_res, shift_mont, p, jnp.asarray(ctx.pinv_neg))
+    return modular.add_mod(hi_shift, lo_res, p)
 
 
 def encode_overflow_count(values: jnp.ndarray, scale: float) -> jnp.ndarray:

@@ -55,6 +55,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-test", type=int, default=None)
     p.add_argument("--checkpoint", default=None, help="checkpoint path (.npz)")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--save-model", default="agg_model.npz", metavar="PATH",
+                   dest="save_model",
+                   help="persist the final aggregated model (the reference's "
+                        "agg_model.hdf5, always written); --no-save-model "
+                        "to disable")
+    p.add_argument("--no-save-model", action="store_const", const=None,
+                   dest="save_model")
+    p.add_argument("--centralized", action="store_true",
+                   help="centralized (non-federated) baseline: train one "
+                        "model on the whole dataset (train_server analog)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the first round to DIR")
     p.add_argument("--json", action="store_true", help="emit history as JSON lines")
@@ -92,6 +102,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         n_test=args.n_test,
         checkpoint_path=args.checkpoint,
         profile_dir=args.profile,
+        save_model_path=args.save_model,
+        centralized=args.centralized,
     )
 
 

@@ -32,12 +32,37 @@ class DatasetSpec:
     num_classes: int
     n_train: int
     n_test: int
+    # --- difficulty knobs -------------------------------------------------
+    # The class-information budget of a sample is (sig_amp * per-sample-amp *
+    # Gabor + tmpl_amp * per-sample-amp * template) against (bg_amp *
+    # background + noise_sigma * pixel noise). `amp_floor` is the lower edge
+    # of the per-sample amplitude U(amp_floor, 1): near 0 it produces
+    # genuinely ambiguous samples whose class signal is buried in noise, and
+    # `orient_jitter` (radians) smears each class's Gabor orientation so the
+    # class-conditional distributions overlap. Together these set an
+    # irreducible Bayes error — the headroom that makes accuracy a real
+    # measurement instead of a saturated 1.0 (VERDICT r2 weak #2).
+    sig_amp: float = 0.4
+    tmpl_amp: float = 0.5
+    bg_amp: float = 0.3
+    noise_sigma: float = 0.25
+    orient_jitter: float = 0.0
+    amp_floor: float = 0.6
 
 
 # Cardinalities mirror the reference experiment (medical: SURVEY §6) and the
 # classic dataset sizes, scaled down where full size adds nothing but time.
+# The medical spec is tuned hard on purpose: the reference recipe (MedCNN,
+# 2 clients x 10 epochs, 1600 images) should land in the ~0.85-0.95 band
+# after one FL round — comparable to the reference's 0.8425 on its real
+# data — with multi-round training climbing from there, so any quality
+# regression (encoder clipping, augment bug, optimizer bug) is visible.
 DATASETS: dict[str, DatasetSpec] = {
-    "medical": DatasetSpec("medical", 256, 256, 3, 2, 1600, 400),
+    "medical": DatasetSpec(
+        "medical", 256, 256, 3, 2, 1600, 400,
+        sig_amp=0.50, tmpl_amp=0.35, bg_amp=0.30, noise_sigma=0.35,
+        orient_jitter=0.40, amp_floor=0.0,
+    ),
     "mnist": DatasetSpec("mnist", 28, 28, 1, 10, 8000, 2000),
     "cifar10": DatasetSpec("cifar10", 32, 32, 3, 10, 8000, 2000),
 }
@@ -52,11 +77,15 @@ def _class_signal(
     yy = yy / h - 0.5
     xx = xx / w - 0.5
     n = labels.shape[0]
-    # class k -> orientation k*pi/K and frequency 4 + 3*(k % 3)
+    # class k -> orientation k*pi/K (smeared by orient_jitter so the
+    # class-conditional orientation distributions overlap) and frequency
+    # 4 + 3*(k % 3)
     theta = labels.astype(np.float32) * (np.pi / spec.num_classes)
+    if spec.orient_jitter > 0:
+        theta = theta + rng.normal(0, spec.orient_jitter, size=n).astype(np.float32)
     freq = 4.0 + 3.0 * (labels % 3).astype(np.float32)
     phase = rng.uniform(0, 2 * np.pi, size=n).astype(np.float32)
-    amp = rng.uniform(0.6, 1.0, size=n).astype(np.float32)
+    amp = rng.uniform(spec.amp_floor, 1.0, size=n).astype(np.float32)
     proj = (
         np.cos(theta)[:, None, None] * xx[None] + np.sin(theta)[:, None, None] * yy[None]
     )
@@ -125,10 +154,17 @@ def make_split(spec: DatasetSpec, n: int, seed: int) -> tuple[np.ndarray, np.nda
         k = len(lab)
         sig = _class_signal(rng, spec, lab)
         tmpl = _class_template(spec, lab)
-        tmpl_amp = rng.uniform(0.6, 1.0, size=k).astype(np.float32)[:, None, None]
+        tmpl_amp = rng.uniform(spec.amp_floor, 1.0, size=k).astype(np.float32)[
+            :, None, None
+        ]
         bg = _background(rng, k, spec)
-        noise = rng.normal(0, 0.25, size=sig.shape).astype(np.float32)
-        base = 0.4 * sig + 0.5 * tmpl_amp * tmpl + 0.3 * bg + noise
+        noise = rng.normal(0, spec.noise_sigma, size=sig.shape).astype(np.float32)
+        base = (
+            spec.sig_amp * sig
+            + spec.tmpl_amp * tmpl_amp * tmpl
+            + spec.bg_amp * bg
+            + noise
+        )
         for c in range(spec.channels):
             # slight per-channel gain so channels are informative but correlated
             imgs[lo : lo + chunk, ..., c] = np.clip(

@@ -1,0 +1,78 @@
+"""Standalone check, launched by
+`test_collectives.test_ring_secure_round_beyond_lazy_bound` in a subprocess
+with a 36-device virtual CPU platform: a mesh larger than MAX_PSUM_CLIENTS
+makes `_build_secure_round_fn` select the `ring_psum_mod` reduction
+(hefl_tpu/fl/secure.py), and the encrypted round must still match the
+plaintext round — the "any device count works" claim of SURVEY.md §2.13,
+exercised end-to-end instead of only on the collective in isolation
+(VERDICT r2 weak #6).
+
+Not named test_*.py on purpose: pytest must not collect it in the 8-device
+parent process.
+"""
+
+import numpy as np
+import jax
+
+# The ambient sitecustomize preimports JAX pinned to the real TPU; pin back
+# to CPU BEFORE any backend touch (the JAX_PLATFORMS env var alone is too
+# late when jax is already imported — same recipe as tests/conftest.py and
+# the __graft_entry__ re-exec child).
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from hefl_tpu.ckks.keys import CkksContext, keygen
+from hefl_tpu.ckks.packing import PackSpec
+from hefl_tpu.fl import (
+    TrainConfig,
+    decrypt_average,
+    fedavg_round,
+    secure_fedavg_round,
+)
+from hefl_tpu.models import MedCNN
+from hefl_tpu.parallel import CLIENT_AXIS
+from hefl_tpu.parallel.collectives import MAX_PSUM_CLIENTS
+
+N_DEV = 36
+
+
+def main() -> None:
+    devs = jax.devices()
+    assert len(devs) >= N_DEV, f"need {N_DEV} devices, have {len(devs)}"
+    assert N_DEV > MAX_PSUM_CLIENTS  # guarantees the ring branch is taken
+    mesh = Mesh(np.array(devs[:N_DEV]), (CLIENT_AXIS,))
+
+    module = MedCNN(num_classes=2, features=(4,), dense=(8,))
+    params = module.init(jax.random.key(0), jnp.zeros((1, 16, 16, 3)))["params"]
+    cfg = TrainConfig(
+        epochs=1, batch_size=4, num_classes=2, augment=False, val_fraction=0.25
+    )
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.integers(0, 256, (N_DEV, 8, 16, 16, 3), dtype=np.uint8))
+    ys = jnp.asarray(rng.integers(0, 2, (N_DEV, 8), dtype=np.int32))
+
+    ctx = CkksContext.create(n=128)
+    sk, pk = keygen(ctx, jax.random.key(1))
+    spec = PackSpec.for_params(params, ctx.n)
+    key = jax.random.key(5)
+
+    ct_sum, metrics, overflow = secure_fedavg_round(
+        module, cfg, mesh, ctx, pk, params, xs, ys, key
+    )
+    assert metrics.shape == (N_DEV, 1, 4)
+    assert int(np.sum(np.asarray(overflow))) == 0
+    enc_avg = decrypt_average(ctx, sk, ct_sum, N_DEV, spec)
+
+    k_train, _ = jax.random.split(key)  # plaintext round trains with k_train
+    plain_avg, _ = fedavg_round(module, cfg, mesh, params, xs, ys, k_train)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(enc_avg), jax.tree_util.tree_leaves(plain_avg)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+    print(f"ring secure round OK on {N_DEV} devices")
+
+
+if __name__ == "__main__":
+    main()

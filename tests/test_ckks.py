@@ -41,18 +41,32 @@ def test_encode_decode_exact_roundtrip(ctx):
 def test_encode_overflow_saturates_not_wraps(ctx):
     # A weight whose |w * scale| exceeds ENCODE_BOUND must clip to the bound
     # (bounded error), never wrap int32 to the opposite sign (VERDICT r1
-    # weak #6). At scale=2**30 the envelope is |w| < ~2; real CNN weights
-    # (incl. |w| ~ 1.4 biases) pass through untouched.
+    # weak #6). At scale=2**30 the hi/lo split's envelope is |w| < ~2**16;
+    # anything a trained CNN produces passes through untouched.
     w = np.zeros(ctx.n, np.float32)
-    w[0], w[1], w[2], w[3] = 7.5, -123.0, 0.25, 1.4
+    w[0], w[1], w[2], w[3] = 1e6, -3e6, 0.25, 123.0
     m = encoding.encode(ctx.ntt, jnp.asarray(w), ctx.scale)
     back = encoding.decode_exact(ctx.ntt, np.asarray(m), ctx.scale)
     bound = encoding.ENCODE_BOUND / ctx.scale
     assert back[0] == pytest.approx(bound, rel=1e-6)   # saturated, same sign
     assert back[1] == pytest.approx(-bound, rel=1e-6)
     assert back[2] == pytest.approx(0.25, abs=1e-6)    # in-range untouched
-    assert back[3] == pytest.approx(1.4, abs=1e-6)     # > 1 but in envelope
+    assert back[3] == pytest.approx(123.0, abs=1e-6)   # large but in envelope
     assert int(encoding.encode_overflow_count(jnp.asarray(w), ctx.scale)) == 2
+
+
+def test_encode_trained_weight_magnitudes_exact(ctx):
+    # Regression for the round-2 flagship defect: trained weights just above
+    # 2.0 were silently clipped by the old single-int32 envelope, showing up
+    # as ~5e-4 enc-vs-plain error on two of three seeds (VERDICT r2 weak #1).
+    # The hi/lo-split encode must carry them at full half-lsb precision, and
+    # stay bit-exact out to |w| < 2**9.
+    w = np.array([2.0005, -2.0005, 3.7, -15.9, 255.1, -511.5, 0.0], np.float32)
+    w = np.pad(w, (0, ctx.n - len(w)))
+    m = encoding.encode(ctx.ntt, jnp.asarray(w), ctx.scale)
+    back = encoding.decode_exact(ctx.ntt, np.asarray(m), ctx.scale)
+    assert np.max(np.abs(back - w)) <= 0.5 / ctx.scale + 1e-12
+    assert int(encoding.encode_overflow_count(jnp.asarray(w), ctx.scale)) == 0
 
 
 def test_device_decode_matches_exact(ctx, keys):

@@ -57,6 +57,36 @@ def test_lazy_sum_mod_chunked_beyond_32():
     np.testing.assert_array_equal(got, want)
 
 
+def test_ring_secure_round_beyond_lazy_bound():
+    """36 virtual devices (> MAX_PSUM_CLIENTS) drive secure_fedavg_round
+    through the ring_psum_mod branch end-to-end; see ring_round_check.py.
+    Subprocess because the parent is pinned to an 8-device platform."""
+    import os
+    import pathlib
+    import re
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", env.get("XLA_FLAGS", "")
+    )
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=36").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    root = pathlib.Path(__file__).resolve().parent.parent
+    env["PYTHONPATH"] = str(root) + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, str(root / "tests" / "ring_round_check.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=str(root),
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "ring secure round OK" in proc.stdout
+
+
 def test_aggregate_encrypted_beyond_32_stacks():
     """40 client ciphertext stacks aggregate + decrypt-average correctly."""
     from hefl_tpu.ckks import encoding, ops

@@ -125,10 +125,12 @@ def test_secure_round_matches_plain_round_end_to_end():
     spec = PackSpec.for_params(params, ctx.n)
     key = jax.random.key(5)
 
-    ct_sum, metrics = secure_fedavg_round(
+    ct_sum, metrics, overflow = secure_fedavg_round(
         model, cfg, mesh, ctx, pk, params, jnp.asarray(xs), jnp.asarray(ys), key
     )
     assert metrics.shape == (num_clients, 1, 4)
+    assert overflow.shape == (num_clients,)
+    assert int(np.sum(np.asarray(overflow))) == 0  # no encoder saturation
     enc_avg = decrypt_average(ctx, sk, ct_sum, num_clients, spec)
 
     k_train, _ = jax.random.split(key)  # plaintext round trains with k_train
