@@ -27,7 +27,7 @@ from jax.sharding import PartitionSpec as P
 from hefl_tpu.data.augment import rescale
 from hefl_tpu.fl.client import local_train
 from hefl_tpu.fl.config import TrainConfig
-from hefl_tpu.parallel import CLIENT_AXIS, pmean_tree
+from hefl_tpu.parallel import client_axes, client_mesh_size, pmean_tree
 
 
 @functools.lru_cache(maxsize=32)
@@ -36,19 +36,21 @@ def _build_round_fn(module, cfg: TrainConfig, mesh):
     (module, cfg, mesh) triple. Cached so an R-round experiment traces and
     compiles the program a single time, not once per round."""
 
+    axes = client_axes(mesh)   # ("clients",) or ("hosts", "clients")
+
     def body(gp, x_blk, y_blk, k_blk):
         # x_blk: [cpd, m, ...] — this device's clients; vmap trains them
         # "concurrently" (XLA interleaves), shard_map spans the mesh.
         train_one = lambda x, y, k: local_train(module, cfg, gp, x, y, k)  # noqa: E731
         p_out, mets = jax.vmap(train_one)(x_blk, y_blk, k_blk)
         local_mean = jax.tree_util.tree_map(lambda t: jnp.mean(t, axis=0), p_out)
-        return pmean_tree(local_mean, CLIENT_AXIS), mets
+        return pmean_tree(local_mean, axes), mets
 
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS)),
-        out_specs=(P(), P(CLIENT_AXIS)),
+        in_specs=(P(), P(axes), P(axes), P(axes)),
+        out_specs=(P(), P(axes)),
         check_vma=False,
     )
     return jax.jit(fn)
@@ -69,7 +71,7 @@ def fedavg_round(
     axis 0 sharded over the mesh). -> (new_global_params, metrics[C, E, 4]).
     """
     num_clients = int(xs.shape[0])
-    n_dev = mesh.shape[CLIENT_AXIS]
+    n_dev = client_mesh_size(mesh)
     if num_clients % n_dev != 0:
         raise ValueError(f"{num_clients} clients on {n_dev} devices: must divide")
     client_keys = jax.random.split(key, num_clients)

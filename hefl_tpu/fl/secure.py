@@ -36,8 +36,8 @@ from hefl_tpu.ckks.packing import PackSpec, pack_pytree, unpack_blocks
 from hefl_tpu.fl.client import local_train
 from hefl_tpu.fl.config import TrainConfig
 from hefl_tpu.ckks.modular import add_mod as modular_add_mod
-from hefl_tpu.parallel import CLIENT_AXIS
-from hefl_tpu.parallel.collectives import MAX_PSUM_CLIENTS, psum_mod, ring_psum_mod
+from hefl_tpu.parallel import client_axes, client_mesh_size
+from hefl_tpu.parallel.collectives import MAX_PSUM_CLIENTS, hierarchical_psum_mod
 
 
 @partial(jax.jit, static_argnums=0)
@@ -143,7 +143,7 @@ def secure_fedavg_round(
     scale must come down (VERDICT r2 weak #1's silent-saturation guard).
     """
     num_clients = int(xs.shape[0])
-    n_dev = mesh.shape[CLIENT_AXIS]
+    n_dev = client_mesh_size(mesh)
     if num_clients % n_dev != 0:
         raise ValueError(f"{num_clients} clients on {n_dev} devices: must divide")
     k_train, k_enc = jax.random.split(key)
@@ -161,6 +161,8 @@ def _build_secure_round_fn(module, cfg: TrainConfig, mesh, ctx: CkksContext):
     across all rounds). `pk` is a traced, mesh-replicated argument so key
     rotation does not retrigger compilation."""
 
+    axes = client_axes(mesh)   # ("clients",) or ("hosts", "clients")
+
     def body(gp, pk, x_blk, y_blk, kt_blk, ke_blk):
         train_one = lambda x, y, k: local_train(module, cfg, gp, x, y, k)  # noqa: E731
         p_out, mets = jax.vmap(train_one)(x_blk, y_blk, kt_blk)
@@ -174,14 +176,16 @@ def _build_secure_round_fn(module, cfg: TrainConfig, mesh, ctx: CkksContext):
         cts = jax.vmap(enc_one)(p_out, ke_blk)        # [cpd, n_ct, L, N]
         local = aggregate_encrypted(ctx, cts)          # this device's clients
         p = jnp.asarray(ctx.ntt.p)
-        # Per-device partials are canonical (< p < 2**27): the fused XLA
+        # Per-device partials are canonical (< p < 2**27), so each stage of
+        # the hierarchical reduce starts canonical: the fused XLA
         # all-reduce's lazy reduction is sound up to MAX_PSUM_CLIENTS
-        # devices; past that, the ppermute ring reduces canonically per hop.
-        reduce = psum_mod if mesh.shape[CLIENT_AXIS] <= MAX_PSUM_CLIENTS else ring_psum_mod
+        # devices per axis (the ppermute ring lifts an axis past that), and
+        # on a ("hosts", "clients") mesh the client axis reduces over ICI
+        # before one cross-host (DCN) fold — see hierarchical_psum_mod.
         return (
             Ciphertext(
-                c0=reduce(local.c0, p, CLIENT_AXIS),
-                c1=reduce(local.c1, p, CLIENT_AXIS),
+                c0=hierarchical_psum_mod(local.c0, p, axes),
+                c1=hierarchical_psum_mod(local.c1, p, axes),
                 scale=local.scale,
             ),
             mets,
@@ -191,10 +195,8 @@ def _build_secure_round_fn(module, cfg: TrainConfig, mesh, ctx: CkksContext):
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(
-            P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS),
-        ),
-        out_specs=(P(), P(CLIENT_AXIS), P(CLIENT_AXIS)),
+        in_specs=(P(), P(), P(axes), P(axes), P(axes), P(axes)),
+        out_specs=(P(), P(axes), P(axes)),
         check_vma=False,
     )
     return jax.jit(fn)

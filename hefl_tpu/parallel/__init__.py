@@ -10,14 +10,32 @@ collective over ICI — `pmean` of weight pytrees for plaintext FedAvg,
 encrypted path.
 """
 
-from hefl_tpu.parallel.mesh import CLIENT_AXIS, local_client_count, make_mesh
-from hefl_tpu.parallel.collectives import psum_mod, pmean_tree, ring_psum_mod
+from hefl_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    HOST_AXIS,
+    client_axes,
+    client_mesh_size,
+    local_client_count,
+    make_host_mesh,
+    make_mesh,
+)
+from hefl_tpu.parallel.collectives import (
+    hierarchical_psum_mod,
+    pmean_tree,
+    psum_mod,
+    ring_psum_mod,
+)
 
 __all__ = [
     "CLIENT_AXIS",
+    "HOST_AXIS",
+    "client_axes",
+    "client_mesh_size",
     "make_mesh",
+    "make_host_mesh",
     "local_client_count",
     "psum_mod",
     "pmean_tree",
     "ring_psum_mod",
+    "hierarchical_psum_mod",
 ]

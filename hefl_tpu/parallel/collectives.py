@@ -32,9 +32,38 @@ def psum_mod(residues: jax.Array, p: jax.Array, axis_name: str) -> jax.Array:
     return jax.lax.rem(total, jnp.broadcast_to(p, total.shape))
 
 
-def pmean_tree(tree, axis_name: str):
-    """Plaintext FedAvg: pmean of a parameter pytree over the client axis."""
+def pmean_tree(tree, axis_name: str | tuple[str, ...]):
+    """Plaintext FedAvg: pmean of a parameter pytree over the client axis —
+    one name on the flat mesh, the ("hosts", "clients") tuple on the 2-D
+    multi-host mesh (lax.pmean reduces over all named axes jointly)."""
     return jax.tree_util.tree_map(lambda x: jax.lax.pmean(x, axis_name), tree)
+
+
+def reduce_mod(residues: jax.Array, p: jax.Array, axis_name: str) -> jax.Array:
+    """Modular all-reduce over one axis, picking the sound backend: the
+    fused lazy psum up to MAX_PSUM_CLIENTS participants, the canonical
+    ppermute ring beyond."""
+    n = jax.lax.axis_size(axis_name)
+    return (psum_mod if n <= MAX_PSUM_CLIENTS else ring_psum_mod)(
+        residues, p, axis_name
+    )
+
+
+def hierarchical_psum_mod(
+    residues: jax.Array, p: jax.Array, axis_names: tuple[str, ...]
+) -> jax.Array:
+    """Modular all-reduce over several mesh axes, innermost LAST — the
+    multi-host pattern (SURVEY.md §2.13's distributed-backend story): on a
+    ("hosts", "clients") mesh pass `("hosts", "clients")` and each host row
+    first reduces its clients over ICI (fast, lazy psum), then the
+    already-reduced per-host partials cross DCN once. Each stage re-canonicalizes
+    (< p), so the lazy uint32 bound applies PER AXIS — 32 clients per host
+    times 32 hosts = 1024 participants without ever leaving the fused-psum
+    fast path, and the ring lifts either axis past 32.
+    """
+    for axis in reversed(axis_names):   # innermost (intra-host) first
+        residues = reduce_mod(residues, p, axis)
+    return residues
 
 
 def ring_psum_mod(residues: jax.Array, p: jax.Array, axis_name: str) -> jax.Array:

@@ -1,4 +1,16 @@
-"""Mesh construction for the one-client-per-device FL topology."""
+"""Mesh construction for the one-client-per-device FL topology.
+
+Two shapes:
+
+  * `make_mesh` — the flat 1-D "clients" mesh (one pod slice, clients over
+    ICI). This is the default topology for every single-host experiment.
+  * `make_host_mesh` — a 2-D ("hosts", "clients") mesh modeling the
+    multi-host deployment: the client collective runs over the fast
+    intra-host interconnect (ICI), and the cross-host fold is the one DCN
+    hop per round. The reference's analog of "many machines exchanging
+    pickle files" (SURVEY.md §2.13) — here the exchange IS the hierarchical
+    collective.
+"""
 
 from __future__ import annotations
 
@@ -7,6 +19,20 @@ import numpy as np
 from jax.sharding import Mesh
 
 CLIENT_AXIS = "clients"
+HOST_AXIS = "hosts"
+
+
+def client_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Mesh axes the federated client dimension shards over (outer-first:
+    hosts, then clients on a 2-D mesh)."""
+    if HOST_AXIS in mesh.axis_names:
+        return (HOST_AXIS, CLIENT_AXIS)
+    return (CLIENT_AXIS,)
+
+
+def client_mesh_size(mesh: Mesh) -> int:
+    """Total devices the client dimension spans."""
+    return int(np.prod([mesh.shape[a] for a in client_axes(mesh)]))
 
 
 def make_mesh(num_clients: int, devices: list | None = None) -> Mesh:
@@ -26,6 +52,24 @@ def make_mesh(num_clients: int, devices: list | None = None) -> Mesh:
     return Mesh(np.array(devs[:n]), (CLIENT_AXIS,))
 
 
+def make_host_mesh(
+    num_hosts: int, clients_per_host: int, devices: list | None = None
+) -> Mesh:
+    """2-D ("hosts", "clients") mesh: `num_hosts` rows of `clients_per_host`
+    devices. Federated arrays shard their client axis over BOTH axes
+    (row-major: host 0 takes the first `clients_per_host` clients); the
+    secure round reduces within a host first (lazy psum over ICI), then
+    across hosts (the DCN hop) — see parallel.collectives and fl.secure."""
+    devs = list(devices if devices is not None else jax.devices())
+    need = num_hosts * clients_per_host
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices for a {num_hosts}x{clients_per_host} mesh, have {len(devs)}")
+    return Mesh(
+        np.array(devs[:need]).reshape(num_hosts, clients_per_host),
+        (HOST_AXIS, CLIENT_AXIS),
+    )
+
+
 def local_client_count(mesh: Mesh, num_clients: int) -> int:
     """Clients simulated per device (>=1)."""
-    return num_clients // mesh.shape[CLIENT_AXIS]
+    return num_clients // client_mesh_size(mesh)

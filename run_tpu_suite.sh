@@ -1,0 +1,35 @@
+#!/bin/bash
+# Serial TPU measurement suite for round 3. Run when the axon tunnel is up:
+#   bash run_tpu_suite.sh 2>&1 | tee tpu_suite.log
+# Each stage is independent; a failure skips to the next so one tunnel
+# hiccup doesn't lose the rest.
+set -x
+cd /root/repo
+
+echo "=== stage 1: flagship bench (also writes seed 0)"
+BENCH_SEED=0 python bench.py > seeds_0.json 2> seeds_err_0.log
+tail -2 seeds_err_0.log
+
+echo "=== stage 2: seed sweep 1,2"
+for s in 1 2; do
+  BENCH_SEED=$s python bench.py > seeds_$s.json 2> seeds_err_$s.log
+  tail -2 seeds_err_$s.log
+done
+
+echo "=== stage 3: NTT microbenchmark"
+python bench_ntt.py > NTT_TABLE.md 2> ntt_err.log
+cat NTT_TABLE.md
+
+echo "=== stage 4: phase attribution"
+python profile_round.py > PROFILE.md 2> profile_err.log
+cat PROFILE.md
+
+echo "=== stage 5: preset table"
+python results.py 2> results_err.log
+tail -3 results_err.log
+
+echo "=== stage 6: convergence curves"
+python results.py --convergence 2> conv_err.log
+tail -3 conv_err.log
+
+echo "=== done"

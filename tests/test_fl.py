@@ -208,6 +208,29 @@ def test_fedprox_term_pulls_toward_global():
     assert dist(p_prox) < dist(p_base)
 
 
+def test_plain_fedavg_on_host_mesh_matches_flat_mesh():
+    # The plaintext round generalizes to the 2-D hosts x clients mesh too:
+    # same 8 clients, same RNG -> identical trainings; only the float
+    # summation grouping of the pmean differs between topologies, so the
+    # aggregated models agree to float32 rounding.
+    from hefl_tpu.parallel import make_host_mesh
+
+    model, params, xs, ys, _, _ = _setup(8, 16, seed=4)
+    key = jax.random.key(3)
+    outs = []
+    for mesh in (make_host_mesh(2, 4), make_mesh(8)):
+        avg, metrics = fedavg_round(
+            model, CFG, mesh, params, jnp.asarray(xs), jnp.asarray(ys), key
+        )
+        assert metrics.shape == (8, CFG.epochs, 4)
+        outs.append(avg)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(outs[0]), jax.tree_util.tree_leaves(outs[1])
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6,
+                                   atol=1e-7)
+
+
 def test_fl_accuracy_improves_over_rounds():
     # the convergence smoke test: 2 clients, 3 rounds on synthetic mnist
     model, params, xs, ys, xt, yt = _setup(2, 160, seed=9)

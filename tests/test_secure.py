@@ -24,7 +24,7 @@ from hefl_tpu.fl import (
     secure_fedavg_round,
 )
 from hefl_tpu.models import SmallCNN
-from hefl_tpu.parallel import make_mesh
+from hefl_tpu.parallel import make_host_mesh, make_mesh
 
 
 @pytest.fixture(scope="module")
@@ -141,3 +141,44 @@ def test_secure_round_matches_plain_round_end_to_end():
         jax.tree_util.tree_leaves(enc_avg), jax.tree_util.tree_leaves(plain_avg)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_secure_round_on_host_mesh_matches_flat_mesh():
+    # Multi-host topology (SURVEY.md §2.13 distributed backend): the same 8
+    # clients on a 2x4 ("hosts", "clients") mesh — intra-host lazy psum over
+    # ICI, then the cross-host DCN fold — must produce the same aggregated
+    # model as the flat 8-device mesh (identical client RNG streams).
+    num_clients = 8
+    (x, y), _, _ = make_dataset("mnist", seed=1, n_train=num_clients * 16, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    cfg = TrainConfig(epochs=1, batch_size=8, num_classes=10, augment=False,
+                      val_fraction=0.25)
+    ctx = CkksContext.create(n=512)
+    sk, pk = keygen(ctx, jax.random.key(9))
+    spec = PackSpec.for_params(params, ctx.n)
+    key = jax.random.key(6)
+    xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
+
+    results = []
+    for mesh in (make_host_mesh(2, 4), make_mesh(num_clients)):
+        ct_sum, metrics, overflow = secure_fedavg_round(
+            model, cfg, mesh, ctx, pk, params, xs_d, ys_d, key
+        )
+        assert metrics.shape == (num_clients, 1, 4)
+        assert overflow.shape == (num_clients,)
+        results.append(ct_sum)
+    host_ct, flat_ct = results
+    # Same per-client trainings and encryption keys, and the mod-p ciphertext
+    # sum is exact integer arithmetic independent of reduction grouping: the
+    # two topologies must agree BITWISE, on the ciphertext and therefore on
+    # the decrypted model.
+    np.testing.assert_array_equal(np.asarray(host_ct.c0), np.asarray(flat_ct.c0))
+    np.testing.assert_array_equal(np.asarray(host_ct.c1), np.asarray(flat_ct.c1))
+    host_avg = decrypt_average(ctx, sk, host_ct, num_clients, spec)
+    flat_avg = decrypt_average(ctx, sk, flat_ct, num_clients, spec)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(host_avg), jax.tree_util.tree_leaves(flat_avg)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
