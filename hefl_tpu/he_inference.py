@@ -1,0 +1,127 @@
+"""Encrypted inference: linear scoring of slot-packed features under CKKS.
+
+Beyond the reference's capability surface: its pipeline only ever AGGREGATES
+under encryption (ct+ct and ct x plaintext-scalar,
+/root/reference/FLPyfhelin.py:366-390) — the model itself always runs on
+plaintext. With the rebuild's slot packing (encoding.encode_slots), ct x
+plaintext-polynomial multiplies, and Galois rotations, a server holding only
+(context, pk, rotation keys) can additionally score an ENCRYPTED feature
+vector against its own plaintext linear model — private inference riding the
+same crypto layer as the FL training loop:
+
+    scores[k] = <x, W[k]> + b[k]   computed entirely under encryption:
+
+  1. slot-wise product  ct_x (*) encode_slots(W[k])      (ops.ct_mul_plain_poly)
+  2. rotate-and-sum     log2(slots) rotations+adds fold every slot into the
+                        total inner product (each slot ends up holding it)
+  3. bias               ct_add_plain of b[k] at the product scale
+
+The client decrypts num_classes scores — the server never sees features and
+the client never sees W. Every step is jit-compatible (rotation count and
+class count are static).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hefl_tpu.ckks import encoding, galois, ops
+from hefl_tpu.ckks.keys import CkksContext, GaloisKey, PublicKey, SecretKey, gen_galois_key
+from hefl_tpu.ckks.ops import Ciphertext
+
+
+def rotation_steps(num_slots: int) -> list[int]:
+    """Power-of-two left-rotation steps a full rotate-and-sum needs."""
+    steps = []
+    s = 1
+    while s < num_slots:
+        steps.append(s)
+        s *= 2
+    return steps
+
+
+def gen_rotation_keys(
+    ctx: CkksContext, sk: SecretKey, key: jax.Array
+) -> dict[int, GaloisKey]:
+    """Galois keys for every power-of-two rotation up to slots/2 — the key
+    bundle the scoring server holds (log2(slots) keys; never sk itself)."""
+    keys = {}
+    for i, step in enumerate(rotation_steps(encoding.num_slots(ctx.ntt))):
+        k = jax.random.fold_in(key, i)
+        keys[step] = gen_galois_key(
+            ctx, sk, k, galois.galois_elt_rotation(ctx.n, step)
+        )
+    return keys
+
+
+def encrypt_features(
+    ctx: CkksContext, pk: PublicKey, x: np.ndarray, key: jax.Array
+) -> Ciphertext:
+    """Real feature vector [d] (d <= slots) -> slot-packed ciphertext.
+    Zero-padded so the rotate-and-sum over all slots is exact."""
+    slots = encoding.num_slots(ctx.ntt)
+    if x.shape[-1] > slots:
+        raise ValueError(f"{x.shape[-1]} features exceed {slots} slots")
+    z = np.zeros(x.shape[:-1] + (slots,), np.float64)
+    z[..., : x.shape[-1]] = np.asarray(x, np.float64)
+    res = encoding.encode_slots(ctx.ntt, z, ctx.scale)
+    return ops.encrypt(ctx, pk, jnp.asarray(res), key)
+
+
+def rotate_and_sum(
+    ctx: CkksContext, ct: Ciphertext, gks: dict[int, GaloisKey]
+) -> Ciphertext:
+    """Fold all slots into their total: after log2(slots) rotate+add stages
+    every slot holds sum_j z_j."""
+    for step in rotation_steps(encoding.num_slots(ctx.ntt)):
+        ct = ops.ct_add(ctx, ct, ops.ct_rotate(ctx, ct, gks[step], step))
+    return ct
+
+
+def encrypted_linear(
+    ctx: CkksContext,
+    ct_x: Ciphertext,
+    weights: np.ndarray,
+    bias: np.ndarray,
+    gks: dict[int, GaloisKey],
+    pt_scale: float = 2.0**14,
+) -> list[Ciphertext]:
+    """scores[k] = <x, weights[k]> + bias[k] under encryption.
+
+    weights: float[K, d] (d <= slots), bias: float[K]. Returns K ciphertexts,
+    each carrying its score replicated across all slots at scale
+    ct_x.scale * pt_scale. The caller owns neither x nor sk; only the
+    plaintext model.
+    """
+    slots = encoding.num_slots(ctx.ntt)
+    weights = np.asarray(weights, np.float64)
+    if weights.ndim != 2 or weights.shape[1] > slots:
+        raise ValueError(f"weights must be [K, d<= {slots}], got {weights.shape}")
+    out = []
+    for k in range(weights.shape[0]):
+        wz = np.zeros(slots, np.float64)
+        wz[: weights.shape[1]] = weights[k]
+        w_res = jnp.asarray(encoding.encode_slots(ctx.ntt, wz, pt_scale))
+        ct = ops.ct_mul_plain_poly(ctx, ct_x, w_res, pt_scale)
+        ct = rotate_and_sum(ctx, ct, gks)
+        b_res = jnp.asarray(
+            encoding.encode_slots(
+                ctx.ntt, np.full(slots, float(bias[k])), ct.scale
+            )
+        )
+        out.append(ops.ct_add_plain(ctx, ct, b_res))
+    return out
+
+
+def decrypt_scores(
+    ctx: CkksContext, sk: SecretKey, cts: list[Ciphertext]
+) -> np.ndarray:
+    """Owner-side: decrypt each class ciphertext, read slot 0 -> scores [K]."""
+    scores = []
+    for ct in cts:
+        res = np.asarray(ops.decrypt(ctx, sk, ct))
+        z = encoding.decode_slots(ctx.ntt, res, ct.scale)
+        scores.append(float(np.real(z[..., 0])))
+    return np.asarray(scores)

@@ -64,6 +64,18 @@ def make_host_mesh(
     need = num_hosts * clients_per_host
     if len(devs) < need:
         raise ValueError(f"need {need} devices for a {num_hosts}x{clients_per_host} mesh, have {len(devs)}")
+    if devices is None:
+        # The hierarchical reduce's performance story (clients over ICI,
+        # hosts over DCN) only holds if each mesh row lives on ONE physical
+        # process; jax.devices() is process-major but nothing forces the row
+        # width to match. Group by process so rows align when possible —
+        # the mod-p result is grouping-independent either way, only the
+        # interconnect each stage rides changes.
+        by_proc: dict[int, list] = {}
+        for d in devs:
+            by_proc.setdefault(getattr(d, "process_index", 0), []).append(d)
+        if all(len(g) % clients_per_host == 0 for g in by_proc.values()):
+            devs = [d for g in by_proc.values() for d in g]
     return Mesh(
         np.array(devs[:need]).reshape(num_hosts, clients_per_host),
         (HOST_AXIS, CLIENT_AXIS),

@@ -29,6 +29,8 @@ import json
 import sys
 import time
 
+import numpy as np
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -131,6 +133,35 @@ def main() -> None:
     log(f"standalone encrypt(1 client): {t_encrypt:.3f}s, aggregate(2): "
         f"{t_aggregate:.3f}s, decrypt: {t_decrypt:.3f}s, evaluate: {t_evaluate:.3f}s")
 
+    # Augment row-shift backend shootout at the training batch shape: the
+    # spectral shear is the augment pipeline's dominant FLOP term, so this
+    # picks the default for HEFL_AUG_SHIFT.
+    from hefl_tpu.data import augment as aug_mod
+
+    batch = jnp.asarray(
+        np.random.default_rng(3).random((cfg.batch_size, 256, 256, 3), np.float32)
+    )
+    aug_times = {}
+    prev_backend = aug_mod._SHIFT_BACKEND
+    try:
+        for backend in ("fft", "dft"):
+            aug_mod._SHIFT_BACKEND = backend
+            # random_augment's own jit cache is keyed on shapes/statics, not
+            # on the backend flag — trace the unjitted fn under a fresh jit
+            # per backend so each one actually compiles its own program.
+            fn = jax.jit(
+                lambda k, im, _b=backend: aug_mod.random_augment.__wrapped__(
+                    k, im
+                )
+            )
+            aug_times[backend] = _steady(
+                lambda: fn(jax.random.key(0), batch), reps=10
+            )
+            log(f"random_augment[{backend}] per batch-{cfg.batch_size}: "
+                f"{aug_times[backend] * 1e3:.2f} ms")
+    finally:
+        aug_mod._SHIFT_BACKEND = prev_backend
+
     full = times["full secure round (train+encrypt+aggregate)"]
     train_only = times["plain round (train+pmean, no HE)"]
     no_aug = times["plain round, augment off"]
@@ -146,6 +177,8 @@ def main() -> None:
         "standalone_aggregate_s": round(t_aggregate, 3),
         "decrypt_s": round(t_decrypt, 3),
         "evaluate_s": round(t_evaluate, 3),
+        "augment_fft_ms": round(aug_times["fft"] * 1e3, 3),
+        "augment_dft_ms": round(aug_times["dft"] * 1e3, 3),
         "device": getattr(jax.devices()[0], "device_kind", "cpu"),
     }
 
@@ -166,6 +199,11 @@ def main() -> None:
         print(f"| {name} | {t:.3f} | {share:.1%} |")
     print(f"| decrypt (separate phase) | {att['decrypt_s']:.3f} | — |")
     print(f"| evaluate (separate phase) | {att['evaluate_s']:.3f} | — |")
+    print()
+    print("| augment row-shift backend | ms / batch |")
+    print("|---|---|")
+    print(f"| fft (default) | {att['augment_fft_ms']} |")
+    print(f"| dft (matmul) | {att['augment_dft_ms']} |")
     print(json.dumps({"metric": "phase_attribution", **att}))
 
 

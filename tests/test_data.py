@@ -122,6 +122,20 @@ def test_random_augment_preserves_shape_and_range():
     assert np.allclose(np.asarray(ident), np.asarray(imgs), atol=1e-5)
 
 
+def test_shift_backends_agree():
+    # The FFT row shift (default, O(W log W)) and the matmul-DFT form are
+    # the same bandlimited interpolation expressed two ways; they must agree
+    # to float32 rounding on identical inputs.
+    from hefl_tpu.data.augment import _shift_rows_dft, _shift_rows_fft
+
+    key = jax.random.key(7)
+    x = jax.random.uniform(key, (3, 8, 32, 2))
+    delta = jax.random.uniform(jax.random.key(8), (3, 8), minval=-6.0, maxval=6.0)
+    a = np.asarray(_shift_rows_dft(x, delta))
+    b = np.asarray(_shift_rows_fft(x, delta))
+    np.testing.assert_allclose(a, b, atol=2e-5)
+
+
 def test_random_augment_flip_only_is_mirror():
     key = jax.random.key(1)
     imgs = jnp.arange(16.0).reshape(1, 4, 4, 1) / 16.0

@@ -1,0 +1,63 @@
+"""Encrypted linear inference (hefl_tpu.he_inference): the server scores an
+encrypted feature vector with a plaintext model, and the decrypted scores
+match the plaintext x @ W.T + b to within accumulated CKKS noise."""
+
+import numpy as np
+import jax
+import pytest
+
+from hefl_tpu import he_inference as hei
+from hefl_tpu.ckks import encoding
+from hefl_tpu.ckks.keys import CkksContext, keygen
+
+
+@pytest.fixture(scope="module")
+def setup():
+    ctx = CkksContext.create(n=256)   # 128 slots: fast CI, same code path
+    sk, pk = keygen(ctx, jax.random.key(0))
+    gks = hei.gen_rotation_keys(ctx, sk, jax.random.key(1))
+    return ctx, sk, pk, gks
+
+
+def test_rotation_steps():
+    assert hei.rotation_steps(8) == [1, 2, 4]
+    assert hei.rotation_steps(128) == [1, 2, 4, 8, 16, 32, 64]
+
+
+def test_rotate_and_sum_totals_all_slots(setup):
+    ctx, sk, pk, gks = setup
+    rng = np.random.default_rng(2)
+    x = rng.normal(0, 0.5, encoding.num_slots(ctx.ntt))
+    ct = hei.encrypt_features(ctx, pk, x, jax.random.key(3))
+    total = hei.rotate_and_sum(ctx, ct, gks)
+    import jax.numpy as jnp
+    from hefl_tpu.ckks import ops
+
+    z = encoding.decode_slots(ctx.ntt, np.asarray(ops.decrypt(ctx, sk, total)), total.scale)
+    np.testing.assert_allclose(np.real(z), x.sum(), atol=5e-2 * np.sqrt(len(x)))
+
+
+def test_encrypted_linear_matches_plaintext(setup):
+    ctx, sk, pk, gks = setup
+    rng = np.random.default_rng(4)
+    d, num_classes = 100, 3          # d < slots: exercises zero padding
+    x = rng.normal(0, 0.5, d)
+    W = rng.normal(0, 0.3, (num_classes, d))
+    b = rng.normal(0, 0.2, num_classes)
+
+    ct_x = hei.encrypt_features(ctx, pk, x, jax.random.key(5))
+    cts = hei.encrypted_linear(ctx, ct_x, W, b, gks)
+    got = hei.decrypt_scores(ctx, sk, cts)
+    want = x @ W.T + b
+    # tolerance: key-switch noise per rotation (~4e-4 of signal, keys.py)
+    # accumulated over log2(128)=7 rotate+add stages on O(sqrt(d)) sums
+    np.testing.assert_allclose(got, want, atol=0.05)
+    assert np.argmax(got) == np.argmax(want)
+
+
+def test_feature_overflow_rejected(setup):
+    ctx, _, pk, _ = setup
+    with pytest.raises(ValueError, match="exceed"):
+        hei.encrypt_features(
+            ctx, pk, np.zeros(encoding.num_slots(ctx.ntt) + 1), jax.random.key(0)
+        )
