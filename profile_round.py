@@ -70,13 +70,25 @@ def main() -> None:
     from hefl_tpu.models import create_model
     from hefl_tpu.parallel import make_mesh
 
+    import os
+
     num_clients = 2
-    (x, y), (xt, yt), _ = make_dataset("medical", seed=0)
-    xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
-    module, params = create_model("medcnn", rng=jax.random.key(123))
-    cfg = TrainConfig(warmup_steps=44)
+    smoke = os.environ.get("PROFILE_SMOKE") == "1"
+    if smoke:
+        # CI/CPU shakeout of the harness itself (tiny shapes, same code
+        # path); real numbers come from the TPU run without this flag.
+        (x, y), (xt, yt), _ = make_dataset("mnist", seed=0, n_train=64, n_test=32)
+        xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
+        module, params = create_model("smallcnn", rng=jax.random.key(123))
+        cfg = TrainConfig(epochs=1, batch_size=8, num_classes=10,
+                          val_fraction=0.25)
+    else:
+        (x, y), (xt, yt), _ = make_dataset("medical", seed=0)
+        xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
+        module, params = create_model("medcnn", rng=jax.random.key(123))
+        cfg = TrainConfig(warmup_steps=44)
+    ctx = CkksContext.create(n=256) if smoke else CkksContext.create()
     mesh = make_mesh(num_clients)
-    ctx = CkksContext.create()
     sk, pk = keygen(ctx, jax.random.key(99))
     pack = PackSpec.for_params(params, ctx.n)
     xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
@@ -150,9 +162,7 @@ def main() -> None:
             # on the backend flag — trace the unjitted fn under a fresh jit
             # per backend so each one actually compiles its own program.
             fn = jax.jit(
-                lambda k, im, _b=backend: aug_mod.random_augment.__wrapped__(
-                    k, im
-                )
+                lambda k, im: aug_mod.random_augment.__wrapped__(k, im)
             )
             aug_times[backend] = _steady(
                 lambda: fn(jax.random.key(0), batch), reps=10
@@ -188,7 +198,7 @@ def main() -> None:
         ("fused round total", full, 1.0),
         ("  local SGD (no augment, no val)", att["sgd_core_s"],
          att["sgd_core_s"] / full),
-        ("  data augmentation (affine/DFT)", att["augment_s"],
+        ("  data augmentation (affine/spectral shear)", att["augment_s"],
          att["augment_s"] / full),
         ("  per-epoch validation + callbacks", att["per_epoch_val_s"],
          att["per_epoch_val_s"] / full),

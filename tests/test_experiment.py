@@ -41,6 +41,8 @@ def test_encrypted_experiment_two_rounds():
         )
         assert 0.0 <= rec["accuracy"] <= 1.0
         assert len(rec["val_acc"]) == 2
+        # per-client encoder-saturation diagnostic must be recorded (and 0)
+        assert rec["encode_overflow"] == [0, 0]
     for leaf in np.asarray(out["params"]["Conv_0"]["kernel"]).ravel()[:5]:
         assert np.isfinite(leaf)
 
