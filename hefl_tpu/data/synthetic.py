@@ -60,8 +60,8 @@ class DatasetSpec:
 DATASETS: dict[str, DatasetSpec] = {
     "medical": DatasetSpec(
         "medical", 256, 256, 3, 2, 1600, 400,
-        sig_amp=0.50, tmpl_amp=0.35, bg_amp=0.30, noise_sigma=0.35,
-        orient_jitter=0.40, amp_floor=0.0,
+        sig_amp=0.50, tmpl_amp=0.35, bg_amp=0.30, noise_sigma=0.32,
+        orient_jitter=0.30, amp_floor=0.12,
     ),
     "mnist": DatasetSpec("mnist", 28, 28, 1, 10, 8000, 2000),
     "cifar10": DatasetSpec("cifar10", 32, 32, 3, 10, 8000, 2000),

@@ -15,12 +15,13 @@ devices) and aggregation is a collective.
 
 from hefl_tpu.fl.config import TrainConfig
 from hefl_tpu.fl.client import local_train, train_centralized
-from hefl_tpu.fl.fedavg import evaluate, fedavg_round
+from hefl_tpu.fl.fedavg import evaluate, fedavg_round, train_clients
 from hefl_tpu.fl.metrics import classification_metrics
 from hefl_tpu.fl.secure import (
     aggregate_encrypted,
     decrypt_average,
     encrypt_params,
+    encrypt_stack,
     secure_fedavg_round,
 )
 
@@ -29,9 +30,11 @@ __all__ = [
     "local_train",
     "train_centralized",
     "fedavg_round",
+    "train_clients",
     "evaluate",
     "classification_metrics",
     "encrypt_params",
+    "encrypt_stack",
     "aggregate_encrypted",
     "decrypt_average",
     "secure_fedavg_round",

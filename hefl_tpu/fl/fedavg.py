@@ -30,19 +30,36 @@ from hefl_tpu.fl.config import TrainConfig
 from hefl_tpu.parallel import client_axes, client_mesh_size, pmean_tree
 
 
+def vmapped_train(module, cfg: TrainConfig, gp, x_blk, y_blk, k_blk):
+    """Train one device's block of clients from the shared global weights.
+
+    x_blk: [cpd, m, ...] — this device's clients; vmap trains them
+    "concurrently" (XLA interleaves). The SINGLE training body shared by the
+    plaintext round, the encrypted round, and the train_clients measurement
+    hook — so "same keys => same trainings" holds across all three by
+    construction. -> (stacked weight trees [cpd, ...], metrics [cpd, E, 4]).
+    """
+    train_one = lambda x, y, k: local_train(module, cfg, gp, x, y, k)  # noqa: E731
+    return jax.vmap(train_one)(x_blk, y_blk, k_blk)
+
+
 @functools.lru_cache(maxsize=32)
-def _build_round_fn(module, cfg: TrainConfig, mesh):
+def _build_round_fn(module, cfg: TrainConfig, mesh, stacked: bool = False):
     """Compile-once factory: the jitted SPMD round program for one
     (module, cfg, mesh) triple. Cached so an R-round experiment traces and
-    compiles the program a single time, not once per round."""
+    compiles the program a single time, not once per round.
+
+    stacked=False -> (global mean, metrics): the FedAvg round.
+    stacked=True  -> (per-client weight trees [C, ...], metrics): the
+    train_clients measurement hook. One factory so the two programs can
+    never drift apart in specs or training body."""
 
     axes = client_axes(mesh)   # ("clients",) or ("hosts", "clients")
 
     def body(gp, x_blk, y_blk, k_blk):
-        # x_blk: [cpd, m, ...] — this device's clients; vmap trains them
-        # "concurrently" (XLA interleaves), shard_map spans the mesh.
-        train_one = lambda x, y, k: local_train(module, cfg, gp, x, y, k)  # noqa: E731
-        p_out, mets = jax.vmap(train_one)(x_blk, y_blk, k_blk)
+        p_out, mets = vmapped_train(module, cfg, gp, x_blk, y_blk, k_blk)
+        if stacked:
+            return p_out, mets
         local_mean = jax.tree_util.tree_map(lambda t: jnp.mean(t, axis=0), p_out)
         return pmean_tree(local_mean, axes), mets
 
@@ -50,10 +67,24 @@ def _build_round_fn(module, cfg: TrainConfig, mesh):
         body,
         mesh=mesh,
         in_specs=(P(), P(axes), P(axes), P(axes)),
-        out_specs=(P(), P(axes)),
+        out_specs=(P(axes) if stacked else P(), P(axes)),
         check_vma=False,
     )
     return jax.jit(fn)
+
+
+def replicate_on(mesh, tree):
+    """Commit a pytree to the mesh with replicated (P()) sharding.
+
+    Round programs take the global params replicated; an aval whose sharding
+    differs between calls (fresh `create_model` output is SingleDeviceSharding,
+    a decrypted aggregate is NamedSharding) would recompile the whole round
+    program on round 1 (measured: a second full XLA compile, ~44 s on TPU at
+    the flagship shape). Canonicalizing here makes every round hit the
+    round-0 executable; a no-op when the sharding already matches.
+    """
+    rep = jax.sharding.NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda t: jax.device_put(t, rep), tree)
 
 
 def fedavg_round(
@@ -75,7 +106,33 @@ def fedavg_round(
     if num_clients % n_dev != 0:
         raise ValueError(f"{num_clients} clients on {n_dev} devices: must divide")
     client_keys = jax.random.split(key, num_clients)
-    return _build_round_fn(module, cfg, mesh)(global_params, xs, ys, client_keys)
+    gp = replicate_on(mesh, global_params)
+    return _build_round_fn(module, cfg, mesh)(gp, xs, ys, client_keys)
+
+
+def train_clients(
+    module,
+    cfg: TrainConfig,
+    mesh,
+    global_params,
+    xs: jax.Array,
+    ys: jax.Array,
+    key: jax.Array,
+):
+    """Train every client from the global weights, returning the stacked
+    per-client weight trees (leaves [C, ...]) and metrics [C, E, 4].
+
+    Uses the same per-client key derivation as `fedavg_round` (split(key, C)),
+    so `train_clients(..., k_train)` reproduces the trainings inside
+    `secure_fedavg_round(..., key)` when `k_train, _ = jax.random.split(key)`.
+    """
+    num_clients = int(xs.shape[0])
+    n_dev = client_mesh_size(mesh)
+    if num_clients % n_dev != 0:
+        raise ValueError(f"{num_clients} clients on {n_dev} devices: must divide")
+    client_keys = jax.random.split(key, num_clients)
+    gp = replicate_on(mesh, global_params)
+    return _build_round_fn(module, cfg, mesh, stacked=True)(gp, xs, ys, client_keys)
 
 
 @partial(jax.jit, static_argnums=(0, 3))

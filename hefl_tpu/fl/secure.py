@@ -33,10 +33,10 @@ from hefl_tpu.ckks import encoding, ops
 from hefl_tpu.ckks.keys import CkksContext, PublicKey, SecretKey
 from hefl_tpu.ckks.ops import Ciphertext
 from hefl_tpu.ckks.packing import PackSpec, pack_pytree, unpack_blocks
-from hefl_tpu.fl.client import local_train
 from hefl_tpu.fl.config import TrainConfig
+from hefl_tpu.fl.fedavg import replicate_on, vmapped_train
 from hefl_tpu.ckks.modular import add_mod as modular_add_mod
-from hefl_tpu.parallel import client_axes, client_mesh_size
+from hefl_tpu.parallel import client_axes, client_mesh_size, pmean_tree
 from hefl_tpu.parallel.collectives import MAX_PSUM_CLIENTS, hierarchical_psum_mod
 
 
@@ -72,6 +72,15 @@ def _lazy_sum_mod(x: jax.Array, p: jax.Array) -> jax.Array:
     for lo in range(MAX_PSUM_CLIENTS, num, MAX_PSUM_CLIENTS):
         acc = modular_add_mod(acc, chunk_sum(x[lo : lo + MAX_PSUM_CLIENTS]), p_full)
     return acc
+
+
+def encrypt_stack(ctx: CkksContext, pk: PublicKey, p_out, enc_keys) -> Ciphertext:
+    """Encrypt stacked per-client weight trees (leaves [C, ...]) into one
+    [C, n_ct, L, N]-batched Ciphertext — the encrypt half of the round for
+    weights that are already materialized (bench.py's cell-6 artifact, the
+    secure-round tests)."""
+    enc_one = lambda prm, k: encrypt_params(ctx, pk, prm, k)  # noqa: E731
+    return jax.vmap(enc_one)(p_out, enc_keys)
 
 
 def aggregate_encrypted(ctx: CkksContext, cts: Ciphertext) -> Ciphertext:
@@ -126,6 +135,7 @@ def secure_fedavg_round(
     xs: jax.Array,
     ys: jax.Array,
     key: jax.Array,
+    with_plain_reference: bool = False,
 ) -> tuple[Ciphertext, jax.Array]:
     """One encrypted FedAvg round: local training + encrypt + psum, jitted.
 
@@ -141,6 +151,17 @@ def secure_fedavg_round(
     the encoder envelope (encoding.ENCODE_BOUND) — 0 on a healthy pipeline;
     any nonzero value means the flagship fidelity number is clipped and the
     scale must come down (VERDICT r2 weak #1's silent-saturation guard).
+
+    with_plain_reference=True is a MEASUREMENT-ONLY mode that appends a 4th
+    output: the plaintext FedAvg mean of the SAME in-program trained
+    weights (pmean over the same mesh). It deliberately leaks what the
+    encrypted path exists to hide — never use it in production — but it is
+    the only way to check the full production pipeline (encode + encrypt +
+    hierarchical psum-of-limbs + decrypt) against a plaintext reference at
+    flagship scale: re-running training in a second XLA program is not
+    bit-reproducible (fusion-level float differences flip the discrete
+    best-epoch restore), so a cross-program comparison measures training
+    chaos, not HE error. bench.py's cell-6 artifact uses this.
     """
     num_clients = int(xs.shape[0])
     n_dev = client_mesh_size(mesh)
@@ -149,13 +170,20 @@ def secure_fedavg_round(
     k_train, k_enc = jax.random.split(key)
     train_keys = jax.random.split(k_train, num_clients)
     enc_keys = jax.random.split(k_enc, num_clients)
-    return _build_secure_round_fn(module, cfg, mesh, ctx)(
-        global_params, pk, xs, ys, train_keys, enc_keys
+    # Canonicalize the replicated-global-params sharding so round 1 (params
+    # now a decrypt_average output) reuses round 0's executable — see
+    # fedavg.replicate_on.
+    gp = replicate_on(mesh, global_params)
+    return _build_secure_round_fn(module, cfg, mesh, ctx, with_plain_reference)(
+        gp, pk, xs, ys, train_keys, enc_keys
     )
 
 
 @functools.lru_cache(maxsize=32)
-def _build_secure_round_fn(module, cfg: TrainConfig, mesh, ctx: CkksContext):
+def _build_secure_round_fn(
+    module, cfg: TrainConfig, mesh, ctx: CkksContext,
+    with_plain_reference: bool = False,
+):
     """Compile-once factory for the encrypted round program (same rationale
     as fedavg._build_round_fn: one trace/compile per configuration, reused
     across all rounds). `pk` is a traced, mesh-replicated argument so key
@@ -164,16 +192,14 @@ def _build_secure_round_fn(module, cfg: TrainConfig, mesh, ctx: CkksContext):
     axes = client_axes(mesh)   # ("clients",) or ("hosts", "clients")
 
     def body(gp, pk, x_blk, y_blk, kt_blk, ke_blk):
-        train_one = lambda x, y, k: local_train(module, cfg, gp, x, y, k)  # noqa: E731
-        p_out, mets = jax.vmap(train_one)(x_blk, y_blk, kt_blk)
+        p_out, mets = vmapped_train(module, cfg, gp, x_blk, y_blk, kt_blk)
         # Saturation diagnostic on exactly what gets encoded (the packed
         # blocks); XLA CSEs the duplicate pack with encrypt_params' own.
         ov_one = lambda prm: encoding.encode_overflow_count(  # noqa: E731
             pack_pytree(prm, ctx.n), ctx.scale
         )
         overflow = jax.vmap(ov_one)(p_out)             # [cpd] int32
-        enc_one = lambda prm, k: encrypt_params(ctx, pk, prm, k)  # noqa: E731
-        cts = jax.vmap(enc_one)(p_out, ke_blk)        # [cpd, n_ct, L, N]
+        cts = encrypt_stack(ctx, pk, p_out, ke_blk)    # [cpd, n_ct, L, N]
         local = aggregate_encrypted(ctx, cts)          # this device's clients
         p = jnp.asarray(ctx.ntt.p)
         # Per-device partials are canonical (< p < 2**27), so each stage of
@@ -182,7 +208,7 @@ def _build_secure_round_fn(module, cfg: TrainConfig, mesh, ctx: CkksContext):
         # devices per axis (the ppermute ring lifts an axis past that), and
         # on a ("hosts", "clients") mesh the client axis reduces over ICI
         # before one cross-host (DCN) fold — see hierarchical_psum_mod.
-        return (
+        outs = (
             Ciphertext(
                 c0=hierarchical_psum_mod(local.c0, p, axes),
                 c1=hierarchical_psum_mod(local.c1, p, axes),
@@ -191,12 +217,21 @@ def _build_secure_round_fn(module, cfg: TrainConfig, mesh, ctx: CkksContext):
             mets,
             overflow,
         )
+        if with_plain_reference:
+            local_mean = jax.tree_util.tree_map(
+                lambda t: jnp.mean(t, axis=0), p_out
+            )
+            outs = outs + (pmean_tree(local_mean, axes),)
+        return outs
 
+    out_specs = (P(), P(axes), P(axes))
+    if with_plain_reference:
+        out_specs = out_specs + (P(),)
     fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(), P(axes), P(axes), P(axes), P(axes)),
-        out_specs=(P(), P(axes), P(axes)),
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(fn)

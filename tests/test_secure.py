@@ -182,3 +182,109 @@ def test_secure_round_on_host_mesh_matches_flat_mesh():
         jax.tree_util.tree_leaves(host_avg), jax.tree_util.tree_leaves(flat_avg)
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_round_program_compiles_once_across_rounds():
+    # VERDICT r3: feeding a decrypt_average output back as the next round's
+    # global params must NOT recompile the round program (round 1 used to
+    # pay a second full XLA compile because fresh-model params are
+    # SingleDeviceSharding while decrypt outputs carry a NamedSharding).
+    from hefl_tpu.fl.secure import _build_secure_round_fn
+
+    # The factory is lru_cached on value-equal (module, cfg, mesh, ctx):
+    # another test using the same config with different data shapes would
+    # share this jit and pollute the count — isolate it.
+    _build_secure_round_fn.cache_clear()
+    num_clients = 2
+    (x, y), _, _ = make_dataset("mnist", seed=3, n_train=num_clients * 8, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    cfg = TrainConfig(epochs=1, batch_size=4, num_classes=10, augment=False,
+                      val_fraction=0.25)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(1))
+    spec = PackSpec.for_params(params, ctx.n)
+    xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
+
+    cur = params
+    for r in range(3):
+        ct, _, _ = secure_fedavg_round(
+            model, cfg, mesh, ctx, pk, cur, xs_d, ys_d,
+            jax.random.fold_in(jax.random.key(2), r),
+        )
+        cur = decrypt_average(ctx, sk, ct, num_clients, spec)
+    fn = _build_secure_round_fn(model, cfg, mesh, ctx, False)
+    assert fn._cache_size() == 1, (
+        f"secure round program compiled {fn._cache_size()} times across 3 "
+        "rounds; params sharding must be canonicalized (fedavg.replicate_on)"
+    )
+
+
+def test_train_clients_weights_agree_with_both_aggregators(ctx_keys):
+    # The bench cell-6 artifact path: train_clients' stacked weight trees
+    # pushed through (a) the plain mean and (b) vmapped encrypt -> lazy
+    # modular sum -> decrypt must agree to encoder precision, because both
+    # consume the IDENTICAL trained weights.
+    ctx, sk, pk = ctx_keys
+    from hefl_tpu.fl import train_clients
+    from hefl_tpu.fl.secure import encrypt_stack
+
+    num_clients = 2
+    (x, y), _, _ = make_dataset("mnist", seed=4, n_train=num_clients * 8, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    cfg = TrainConfig(epochs=1, batch_size=4, num_classes=10, augment=False,
+                      val_fraction=0.25)
+    mesh = make_mesh(num_clients)
+    spec = PackSpec.for_params(params, ctx.n)
+    key = jax.random.key(11)
+
+    p_out, metrics = train_clients(
+        model, cfg, mesh, params, jnp.asarray(xs), jnp.asarray(ys), key
+    )
+    assert metrics.shape == (num_clients, 1, 4)
+    plain = jax.tree_util.tree_map(lambda t: jnp.mean(t, axis=0), p_out)
+    enc_keys = jax.random.split(jax.random.key(12), num_clients)
+    cts = encrypt_stack(ctx, pk, p_out, enc_keys)
+    enc_avg = decrypt_average(
+        ctx, sk, aggregate_encrypted(ctx, cts), num_clients, spec
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(enc_avg), jax.tree_util.tree_leaves(plain)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_with_plain_reference_isolates_he_error():
+    # The bench cell-6 mode: the production secure round with a 4th output —
+    # the plaintext pmean of the SAME in-program trained weights. The
+    # decrypted aggregate must match that reference to encoder precision
+    # (pure HE error), validating the full production pipeline including
+    # the hierarchical psum collective at the same program shape.
+    num_clients = 4
+    (x, y), _, _ = make_dataset("mnist", seed=5, n_train=num_clients * 8, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    cfg = TrainConfig(epochs=1, batch_size=4, num_classes=10, augment=False,
+                      val_fraction=0.25)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=512)
+    sk, pk = keygen(ctx, jax.random.key(21))
+    spec = PackSpec.for_params(params, ctx.n)
+
+    ct, mets, ov, plain_ref = secure_fedavg_round(
+        model, cfg, mesh, ctx, pk, params, jnp.asarray(xs), jnp.asarray(ys),
+        jax.random.key(22), with_plain_reference=True,
+    )
+    assert mets.shape == (num_clients, 1, 4)
+    assert int(np.sum(np.asarray(ov))) == 0
+    enc_avg = decrypt_average(ctx, sk, ct, num_clients, spec)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(enc_avg),
+        jax.tree_util.tree_leaves(plain_ref),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
