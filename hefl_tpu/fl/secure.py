@@ -136,7 +136,7 @@ def secure_fedavg_round(
     ys: jax.Array,
     key: jax.Array,
     with_plain_reference: bool = False,
-) -> tuple[Ciphertext, jax.Array]:
+) -> tuple:
     """One encrypted FedAvg round: local training + encrypt + psum, jitted.
 
     Same contract as `fedavg_round` but the output is the *encrypted sum*

@@ -118,10 +118,94 @@ def encrypted_linear(
 def decrypt_scores(
     ctx: CkksContext, sk: SecretKey, cts: list[Ciphertext]
 ) -> np.ndarray:
-    """Owner-side: decrypt each class ciphertext, read slot 0 -> scores [K]."""
+    """Owner-side: decrypt each class ciphertext, read slot 0 -> scores [K].
+
+    `sk` must match `ctx`'s level: after rescales, slice it with
+    `slice_secret_key(sk, ctx.num_primes)`.
+    """
     scores = []
     for ct in cts:
         res = np.asarray(ops.decrypt(ctx, sk, ct))
         z = encoding.decode_slots(ctx.ntt, res, ct.scale)
         scores.append(float(np.real(z[..., 0])))
     return np.asarray(scores)
+
+
+def slice_secret_key(sk: SecretKey, num_primes: int) -> SecretKey:
+    """Drop RNS limbs from sk to match a rescaled (shrunken) context."""
+    return SecretKey(s_mont=sk.s_mont[:num_primes])
+
+
+def encrypted_mlp(
+    ctx: CkksContext,
+    ct_x: Ciphertext,
+    w1: np.ndarray,
+    b1: np.ndarray,
+    w2: np.ndarray,
+    b2: np.ndarray,
+    gks: dict[int, GaloisKey],
+    rlk,
+    pt_scale: float = 2.0**14,
+    rescales: int = 2,
+) -> tuple[CkksContext, list[Ciphertext]]:
+    """Private 1-hidden-layer MLP: scores = W2 · (W1 x + b1)² + b2, computed
+    entirely under encryption — a DEPTH-2 homomorphic circuit.
+
+    The square is the classic HE-friendly activation (CryptoNets): it is the
+    one nonlinearity CKKS evaluates exactly, via ct × ct + relinearization.
+    Level budget (why this needs `ctx` with num_primes >= 3 + rescales):
+
+      1. hidden pre-activations   H × [ct×plain W1 row, rotate-and-sum,
+                                  bias] — key-switches at FULL level, so the
+                                  server's rotation keys work unchanged;
+      2. square activation        ct_mul(h, h, rlk) at full level
+                                  (scale Δ·pt_scale squared — the modulus
+                                  must hold it, which ct_mul guards);
+      3. `rescales` × rescale     shed limbs / renormalize the scale so the
+                                  output layer and the f64 slot decode stay
+                                  in exact range;
+      4. output layer             scores_k = Σ_j W2[k,j]·h²_j + b2[k] as
+                                  ct × replicated-plaintext + adds — no
+                                  rotations (each h²_j already holds its
+                                  value in every slot).
+
+    Returns (shrunken context, K score ciphertexts); decrypt with
+    `decrypt_scores(sub_ctx, slice_secret_key(sk, sub_ctx.num_primes), ...)`.
+    The server holds only (ctx, rotation keys, rlk) and its plaintext
+    weights; it never sees x, h, or the scores.
+    """
+    w1 = np.asarray(w1, np.float64)
+    w2 = np.asarray(w2, np.float64)
+    b2 = np.asarray(b2, np.float64)
+    # Validate shapes BEFORE the expensive HE work (H squarings with
+    # key-switches + rescales): malformed input should fail in microseconds.
+    if w2.ndim != 2 or w2.shape[1] != w1.shape[0]:
+        raise ValueError(f"w2 must be [K, {w1.shape[0]}], got {w2.shape}")
+    if b2.shape != (w2.shape[0],):
+        raise ValueError(f"b2 must be [{w2.shape[0]}], got {b2.shape}")
+    h = encrypted_linear(ctx, ct_x, w1, b1, gks, pt_scale)
+    h2 = [ops.ct_mul(ctx, c, c, rlk) for c in h]
+    cur = ctx
+    for _ in range(rescales):
+        rescaled = [ops.rescale(cur, c) for c in h2]
+        cur = rescaled[0][0]
+        h2 = [c for _, c in rescaled]
+    slots = encoding.num_slots(cur.ntt)
+    out = []
+    for k in range(w2.shape[0]):
+        acc = None
+        for j in range(w2.shape[1]):
+            w_res = jnp.asarray(
+                encoding.encode_slots(
+                    cur.ntt, np.full(slots, w2[k, j]), pt_scale
+                )
+            )
+            term = ops.ct_mul_plain_poly(cur, h2[j], w_res, pt_scale)
+            acc = term if acc is None else ops.ct_add(cur, acc, term)
+        b_res = jnp.asarray(
+            encoding.encode_slots(
+                cur.ntt, np.full(slots, float(b2[k])), acc.scale
+            )
+        )
+        out.append(ops.ct_add_plain(cur, acc, b_res))
+    return cur, out

@@ -12,8 +12,8 @@ dataset/model breadth the baseline calls for):
 
 Every preset keeps the reference's local-training recipe (10 epochs, batch
 32, Adam 1e-3 with Keras decay, EarlyStopping/ReduceLROnPlateau) and runs
-2 communication rounds so a warm-round time — the FL rounds/sec/chip
-north-star metric — is measurable alongside the cold round.
+3 communication rounds so a warm-round time — the FL rounds/sec/chip
+north-star metric — is a min over two post-cold samples.
 """
 
 from __future__ import annotations

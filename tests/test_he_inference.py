@@ -61,3 +61,36 @@ def test_feature_overflow_rejected(setup):
         hei.encrypt_features(
             ctx, pk, np.zeros(encoding.num_slots(ctx.ntt) + 1), jax.random.key(0)
         )
+
+
+def test_encrypted_mlp_matches_plaintext():
+    # Depth-2 homomorphic circuit: scores = W2 (W1 x + b1)^2 + b2 under
+    # encryption (square activation a la CryptoNets: ct x ct + relin, then
+    # two rescales, then the plaintext output layer). Needs its own deeper
+    # modulus chain (5 primes) so the square has headroom and the output
+    # layer still has limbs left after rescaling.
+    from hefl_tpu.ckks.keys import gen_relin_key
+
+    ctx = CkksContext.create(n=512, num_primes=5)
+    sk, pk = keygen(ctx, jax.random.key(10))
+    gks = hei.gen_rotation_keys(ctx, sk, jax.random.key(11))
+    rlk = gen_relin_key(ctx, sk, jax.random.key(12))
+
+    rng = np.random.default_rng(13)
+    d, hidden, num_classes = 16, 4, 3
+    x = rng.normal(0, 0.4, d)
+    w1 = rng.normal(0, 0.3, (hidden, d))
+    b1 = rng.normal(0, 0.2, hidden)
+    w2 = rng.normal(0, 0.3, (num_classes, hidden))
+    b2 = rng.normal(0, 0.2, num_classes)
+
+    ct_x = hei.encrypt_features(ctx, pk, x, jax.random.key(14))
+    sub_ctx, cts = hei.encrypted_mlp(ctx, ct_x, w1, b1, w2, b2, gks, rlk)
+    assert sub_ctx.num_primes == ctx.num_primes - 2
+    got = hei.decrypt_scores(
+        sub_ctx, hei.slice_secret_key(sk, sub_ctx.num_primes), cts
+    )
+    h = (x @ w1.T + b1) ** 2
+    want = h @ w2.T + b2
+    np.testing.assert_allclose(got, want, atol=0.05)
+    assert np.argmax(got) == np.argmax(want)
