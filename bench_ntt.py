@@ -38,7 +38,15 @@ def _time(fn, *args, reps: int = 20, warmup: int = 3) -> float:
 
 
 def main() -> None:
+    import os
+
     import jax
+
+    if os.environ.get("NTT_SMOKE") == "1":
+        # Harness shakeout: pin to CPU before any backend touch (the ambient
+        # sitecustomize preimports jax on the tunneled TPU; a wedged tunnel
+        # would hang the smoke run that exists to avoid wasting TPU time).
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")
@@ -48,7 +56,7 @@ def main() -> None:
     from hefl_tpu.ckks import pallas_ntt
     from hefl_tpu.ckks.keys import CkksContext
 
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = ntt_mod.on_tpu_backend()
     dev = jax.devices()[0]
     print(
         f"device: {getattr(dev, 'device_kind', dev)} "

@@ -233,6 +233,25 @@ def encode_slots(ctx: NTTContext, z: np.ndarray, scale: float) -> np.ndarray:
     return res.astype(np.uint32)
 
 
+def encode_slots_const(ctx: NTTContext, c: float, scale: float) -> np.ndarray:
+    """Constant-in-every-slot plaintext without the N-point FFT.
+
+    The canonical embedding of a constant real vector is the constant
+    polynomial (coefficient 0 = round(c·scale), all others 0), so the
+    residues can be written directly in O(L) work instead of
+    encode_slots' O(N log N) host FFT — the serving-path win for
+    ct × scalar-constant multiplies (he_inference's output layers encode
+    K·H such constants per scored sample). Matches
+    encode_slots(ctx, full(N/2, c), scale) exactly: the FFT's float
+    roundoff there is ~1e-13·N·|c|·scale, far below the 0.5 rounding
+    threshold at any scale this library uses.
+    """
+    p = np.asarray(ctx.p)[:, 0].astype(np.int64)
+    res = np.zeros((len(p), ctx.n), np.int64)
+    res[:, 0] = np.mod(int(round(c * scale)), p)
+    return res.astype(np.uint32)
+
+
 def decode_slots(ctx: NTTContext, residues: np.ndarray, scale: float) -> np.ndarray:
     """Residues uint32[..., L, N] -> complex128 slot values [..., N/2]."""
     n = ctx.n

@@ -35,10 +35,27 @@ from hefl_tpu.ckks.modular import add_mod, mont_mul, sub_mod
 _BACKEND = os.environ.get("HEFL_NTT", "auto")
 
 
+def on_tpu_backend() -> bool:
+    """True when the default JAX backend drives real TPU hardware.
+
+    `jax.default_backend() == "tpu"` alone is NOT enough: tunneled TPU
+    platforms (e.g. the experimental "axon" plugin) report their own
+    platform name while their devices are TPU chips — under them the old
+    check silently routed every NTT to the XLA path and would have run a
+    forced Pallas kernel interpreted. The device_kind probe catches those.
+    """
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        return "tpu" in jax.devices()[0].device_kind.lower()
+    except Exception:
+        return False
+
+
 def _use_pallas(ctx: "NTTContext") -> bool:
     if _BACKEND == "xla":
         return False
-    if _BACKEND == "auto" and jax.default_backend() != "tpu":
+    if _BACKEND == "auto" and not on_tpu_backend():
         return False  # cheap check first: never import pallas off-TPU in auto
     if _BACKEND not in ("auto", "pallas"):
         raise ValueError(f"HEFL_NTT={_BACKEND!r}: expected 'auto', 'xla' or 'pallas'")

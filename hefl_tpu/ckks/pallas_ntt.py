@@ -145,7 +145,9 @@ def _run(ctx: NTTContext, a: jnp.ndarray, inverse: bool, interpret: bool | None)
     if interpret is None:
         # Mosaic lowering needs real TPU hardware; elsewhere (CPU test mesh,
         # HEFL_NTT=pallas forced off-TPU) run the kernel interpreted.
-        interpret = jax.default_backend() != "tpu"
+        from hefl_tpu.ckks.ntt import on_tpu_backend
+
+        interpret = not on_tpu_backend()
     tabs = _tables(ctx)
     n, logn = ctx.n, ctx.logn
     s_rows = n // LANES

@@ -97,8 +97,11 @@ def encrypted_linear(
     """
     slots = encoding.num_slots(ctx.ntt)
     weights = np.asarray(weights, np.float64)
+    bias = np.asarray(bias, np.float64)
     if weights.ndim != 2 or weights.shape[1] > slots:
         raise ValueError(f"weights must be [K, d<= {slots}], got {weights.shape}")
+    if bias.shape != (weights.shape[0],):
+        raise ValueError(f"bias must be [{weights.shape[0]}], got {bias.shape}")
     out = []
     for k in range(weights.shape[0]):
         wz = np.zeros(slots, np.float64)
@@ -107,9 +110,7 @@ def encrypted_linear(
         ct = ops.ct_mul_plain_poly(ctx, ct_x, w_res, pt_scale)
         ct = rotate_and_sum(ctx, ct, gks)
         b_res = jnp.asarray(
-            encoding.encode_slots(
-                ctx.ntt, np.full(slots, float(bias[k])), ct.scale
-            )
+            encoding.encode_slots_const(ctx.ntt, float(bias[k]), ct.scale)
         )
         out.append(ops.ct_add_plain(ctx, ct, b_res))
     return out
@@ -175,10 +176,16 @@ def encrypted_mlp(
     weights; it never sees x, h, or the scores.
     """
     w1 = np.asarray(w1, np.float64)
+    b1 = np.asarray(b1, np.float64)
     w2 = np.asarray(w2, np.float64)
     b2 = np.asarray(b2, np.float64)
-    # Validate shapes BEFORE the expensive HE work (H squarings with
-    # key-switches + rescales): malformed input should fail in microseconds.
+    # Validate ALL shapes BEFORE the expensive HE work (H rotate-and-sums,
+    # H squarings with key-switches, rescales): malformed input should fail
+    # in microseconds, not mid-circuit.
+    if w1.ndim != 2:
+        raise ValueError(f"w1 must be [H, d], got {w1.shape}")
+    if b1.shape != (w1.shape[0],):
+        raise ValueError(f"b1 must be [{w1.shape[0]}], got {b1.shape}")
     if w2.ndim != 2 or w2.shape[1] != w1.shape[0]:
         raise ValueError(f"w2 must be [K, {w1.shape[0]}], got {w2.shape}")
     if b2.shape != (w2.shape[0],):
@@ -190,22 +197,17 @@ def encrypted_mlp(
         rescaled = [ops.rescale(cur, c) for c in h2]
         cur = rescaled[0][0]
         h2 = [c for _, c in rescaled]
-    slots = encoding.num_slots(cur.ntt)
     out = []
     for k in range(w2.shape[0]):
         acc = None
         for j in range(w2.shape[1]):
             w_res = jnp.asarray(
-                encoding.encode_slots(
-                    cur.ntt, np.full(slots, w2[k, j]), pt_scale
-                )
+                encoding.encode_slots_const(cur.ntt, w2[k, j], pt_scale)
             )
             term = ops.ct_mul_plain_poly(cur, h2[j], w_res, pt_scale)
             acc = term if acc is None else ops.ct_add(cur, acc, term)
         b_res = jnp.asarray(
-            encoding.encode_slots(
-                cur.ntt, np.full(slots, float(b2[k])), acc.scale
-            )
+            encoding.encode_slots_const(cur.ntt, float(b2[k]), acc.scale)
         )
         out.append(ops.ct_add_plain(cur, acc, b_res))
     return cur, out

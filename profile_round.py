@@ -50,7 +50,15 @@ def _steady(fn, reps: int = 3, warmup: int = 1) -> float:
 
 
 def main() -> None:
+    import os
+
     import jax
+
+    if os.environ.get("PROFILE_SMOKE") == "1":
+        # Harness shakeout: pin to CPU before any backend touch (the ambient
+        # sitecustomize preimports jax on the tunneled TPU; a wedged tunnel
+        # would hang the smoke run that exists to avoid wasting TPU time).
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")

@@ -62,3 +62,20 @@ def test_ct_mul_is_elementwise_on_slots(ctx, material):
     got = encoding.decode_slots(ctx.ntt, np.asarray(ops.decrypt(ctx, sk, prod)), prod.scale)
     assert np.max(np.abs(got.real - z1 * z2)) < 1e-3
     assert np.max(np.abs(got.imag)) < 1e-3
+
+
+def test_encode_slots_const_matches_fft_path():
+    # The O(L) constant encode must be bit-identical to the generic FFT
+    # encode of a constant-filled slot vector (he_inference's serving path
+    # relies on interchangeability).
+    import numpy as np
+    from hefl_tpu.ckks import encoding
+    from hefl_tpu.ckks.keys import CkksContext
+
+    ctx = CkksContext.create(n=256)
+    slots = encoding.num_slots(ctx.ntt)
+    for c, scale in [(0.37, 2.0**14), (-1.25, 2.0**14), (0.0, 2.0**20),
+                     (2.5, 2.0**30)]:
+        fast = encoding.encode_slots_const(ctx.ntt, c, scale)
+        gold = encoding.encode_slots(ctx.ntt, np.full(slots, c), scale)
+        np.testing.assert_array_equal(fast, gold)
