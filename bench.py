@@ -85,6 +85,14 @@ def _program_flops(fn, *args) -> float | None:
 
 def main() -> None:
     import jax
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    if smoke:
+        # Harness shakeout on CPU (same code path, tiny shapes): proves the
+        # whole measurement pipeline end-to-end without spending TPU time.
+        # Pin the platform before first backend touch (the ambient
+        # sitecustomize preimports jax on the tunneled TPU).
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")
@@ -107,26 +115,35 @@ def main() -> None:
     num_clients = 2
     # >= 5 rounds so "steady" is a min over >= 3 genuinely-warm samples
     # (round 1 still carries one-time trickle costs; VERDICT r2 weak #3).
-    rounds = max(1, int(os.environ.get("BENCH_ROUNDS", "5")))
+    rounds = max(1, int(os.environ.get("BENCH_ROUNDS", "2" if smoke else "5")))
     seed = int(os.environ.get("BENCH_SEED", "0"))
     dev = jax.devices()[0]
     log(f"devices: {jax.devices()} (cache_warm={cache_warm})")
 
     # --- data (not timed: the reference reads pre-existing files on disk) ---
-    (x, y), (xt, yt), _ = make_dataset("medical", seed=0)
+    if smoke:
+        (x, y), (xt, yt), _ = make_dataset("mnist", seed=0, n_train=64, n_test=32)
+    else:
+        (x, y), (xt, yt), _ = make_dataset("medical", seed=0)
     xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
     log(f"data: train {x.shape} -> {xs.shape} federated, test {xt.shape}")
 
     # BENCH_SEED varies model init AND all training/augment/encryption keys,
     # so a multi-seed sweep is a genuine robustness check.
-    module, params = create_model("medcnn", rng=jax.random.key(seed + 123))
-    assert count_params(params) == 222_722
-    # Reference defaults (10 epochs, bs 32, augment, ES/plateau) plus a
-    # 2-epoch linear lr warmup — stabilizes bf16 training of the deep
-    # 256x256 CNN without touching the reference's lr=1e-3 target.
-    cfg = TrainConfig(warmup_steps=44)
+    if smoke:
+        module, params = create_model("smallcnn", rng=jax.random.key(seed + 123))
+        cfg = TrainConfig(epochs=1, batch_size=8, num_classes=10,
+                          val_fraction=0.25)
+        ctx = CkksContext.create(n=512)
+    else:
+        module, params = create_model("medcnn", rng=jax.random.key(seed + 123))
+        assert count_params(params) == 222_722
+        # Reference defaults (10 epochs, bs 32, augment, ES/plateau) plus a
+        # 2-epoch linear lr warmup — stabilizes bf16 training of the deep
+        # 256x256 CNN without touching the reference's lr=1e-3 target.
+        cfg = TrainConfig(warmup_steps=44)
+        ctx = CkksContext.create()  # N=4096 -> 55 cts for 222,722 params
     mesh = make_mesh(num_clients)
-    ctx = CkksContext.create()  # N=4096 -> 55 ciphertexts for 222,722 params
     sk, pk = keygen(ctx, jax.random.key(99))
     pack = PackSpec.for_params(params, ctx.n)
     log(f"CKKS: N={ctx.n}, L={ctx.num_primes}, n_ct={pack.n_ct}")
@@ -141,7 +158,7 @@ def main() -> None:
     fwd_flops = _program_flops(
         lambda p, xb: module.apply({"params": p}, xb),
         params,
-        jnp.zeros((cfg.batch_size, 256, 256, 3), jnp.float32),
+        jnp.zeros((cfg.batch_size, *x.shape[1:]), jnp.float32),
     )
     train_flops = (
         3.0 * fwd_flops * steps_per_epoch * cfg.epochs * num_clients
@@ -272,6 +289,10 @@ def main() -> None:
         json.dumps(
             {
                 "metric": "encrypted_fedavg_pipeline_wallclock",
+                # Smoke runs keep the schema but must be filterable: their
+                # vs_baseline/accuracy compare a tiny CPU config against the
+                # medical-TPU reference numbers (results.py skips them).
+                **({"smoke": True} if smoke else {}),
                 "value": round(cold["total"], 3),
                 "unit": "s",
                 "vs_baseline": round(BASELINE_TOTAL_S / cold["total"], 2),

@@ -179,13 +179,11 @@ def encrypted_mlp(
     b1 = np.asarray(b1, np.float64)
     w2 = np.asarray(w2, np.float64)
     b2 = np.asarray(b2, np.float64)
-    # Validate ALL shapes BEFORE the expensive HE work (H rotate-and-sums,
-    # H squarings with key-switches, rescales): malformed input should fail
-    # in microseconds, not mid-circuit.
+    # Validate the OUTPUT layer's shapes up front (w1/b1 are validated by
+    # encrypted_linear itself before any ciphertext op): malformed input
+    # should fail in microseconds, not after H squarings + rescales.
     if w1.ndim != 2:
         raise ValueError(f"w1 must be [H, d], got {w1.shape}")
-    if b1.shape != (w1.shape[0],):
-        raise ValueError(f"b1 must be [{w1.shape[0]}], got {b1.shape}")
     if w2.ndim != 2 or w2.shape[1] != w1.shape[0]:
         raise ValueError(f"w2 must be [K, {w1.shape[0]}], got {w2.shape}")
     if b2.shape != (w2.shape[0],):

@@ -145,6 +145,8 @@ def load_seed_runs() -> list[dict]:
                 line = f.read().strip().splitlines()
             if line:
                 rec = json.loads(line[0])
+                if rec.get("smoke"):
+                    continue   # BENCH_SMOKE shakeout run, not a flagship result
                 rec["_seed_file"] = pth
                 rows.append(rec)
         except (OSError, json.JSONDecodeError):
