@@ -23,6 +23,8 @@ class count are static).
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -80,6 +82,26 @@ def rotate_and_sum(
     return ct
 
 
+@functools.lru_cache(maxsize=16)
+def _linear_program(ctx: CkksContext, pt_scale: float):
+    """ONE jitted program scoring all K classes: vmapped ct x plaintext
+    multiply + the shared rotate-and-sum ladder + bias add. Replaces
+    K x log2(slots) x ~4 separate op dispatches with a single compiled
+    dispatch — the difference between a host-driven loop and a device
+    program on a (possibly tunneled) TPU."""
+
+    @jax.jit
+    def run(ct_x: Ciphertext, w_res, b_res, gks):
+        def one(w, b):
+            ct = ops.ct_mul_plain_poly(ctx, ct_x, w, pt_scale)
+            ct = rotate_and_sum(ctx, ct, gks)
+            return ops.ct_add_plain(ctx, ct, b)
+
+        return jax.vmap(one)(w_res, b_res)
+
+    return run
+
+
 def encrypted_linear(
     ctx: CkksContext,
     ct_x: Ciphertext,
@@ -93,7 +115,7 @@ def encrypted_linear(
     weights: float[K, d] (d <= slots), bias: float[K]. Returns K ciphertexts,
     each carrying its score replicated across all slots at scale
     ct_x.scale * pt_scale. The caller owns neither x nor sk; only the
-    plaintext model.
+    plaintext model. All K classes run as one jitted device program.
     """
     slots = encoding.num_slots(ctx.ntt)
     weights = np.asarray(weights, np.float64)
@@ -102,18 +124,24 @@ def encrypted_linear(
         raise ValueError(f"weights must be [K, d<= {slots}], got {weights.shape}")
     if bias.shape != (weights.shape[0],):
         raise ValueError(f"bias must be [{weights.shape[0]}], got {bias.shape}")
-    out = []
-    for k in range(weights.shape[0]):
-        wz = np.zeros(slots, np.float64)
-        wz[: weights.shape[1]] = weights[k]
-        w_res = jnp.asarray(encoding.encode_slots(ctx.ntt, wz, pt_scale))
-        ct = ops.ct_mul_plain_poly(ctx, ct_x, w_res, pt_scale)
-        ct = rotate_and_sum(ctx, ct, gks)
-        b_res = jnp.asarray(
-            encoding.encode_slots_const(ctx.ntt, float(bias[k]), ct.scale)
-        )
-        out.append(ops.ct_add_plain(ctx, ct, b_res))
-    return out
+    wz = np.zeros((weights.shape[0], slots), np.float64)
+    wz[:, : weights.shape[1]] = weights
+    w_res = jnp.asarray(encoding.encode_slots(ctx.ntt, wz, pt_scale))
+    b_res = jnp.stack(
+        [
+            jnp.asarray(
+                encoding.encode_slots_const(
+                    ctx.ntt, float(b), ct_x.scale * pt_scale
+                )
+            )
+            for b in bias
+        ]
+    )
+    batched = _linear_program(ctx, pt_scale)(ct_x, w_res, b_res, gks)
+    return [
+        Ciphertext(c0=batched.c0[k], c1=batched.c1[k], scale=batched.scale)
+        for k in range(weights.shape[0])
+    ]
 
 
 def decrypt_scores(
