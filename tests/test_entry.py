@@ -30,6 +30,17 @@ def test_dryrun_multichip_8():
     __graft_entry__.dryrun_multichip(8)
 
 
+def test_probed_device_count_tiers(monkeypatch):
+    # Tier 1: the escape hatch forces the virtual path unconditionally.
+    monkeypatch.setenv("HEFL_DRYRUN_FORCE_VIRTUAL", "1")
+    assert __graft_entry__._probed_device_count() == 0
+    monkeypatch.delenv("HEFL_DRYRUN_FORCE_VIRTUAL")
+    # Tier 2: once the (conftest-pinned, 8-device CPU) backend is live
+    # in-process, the count comes from it — no subprocess, no tunnel touch.
+    assert len(jax.devices()) == 8  # initialize the pinned backend
+    assert __graft_entry__._probed_device_count() == 8
+
+
 def test_dryrun_subprocess_reexec():
     # Force the subprocess path even though this process has 8 devices:
     # ask for more devices than exist. The child must self-provision a
