@@ -1,0 +1,150 @@
+"""Private-inference serving benchmark: encrypted linear + depth-2 MLP.
+
+Measures the steady-state serving cost of the precompiled scorers
+(`he_inference.LinearScorer` / `MlpScorer`): compile time once, then warm
+per-sample latency → scores/sec. Both configurations sit within the
+128-bit-security envelope (linear: N=4096 / 3×27-bit primes, log2(q)=81
+≤ 109; MLP: N=8192 / 5 primes, log2(q)=135 ≤ 218).
+
+The reference has no private-inference capability at all (its model always
+runs on plaintext, /root/reference/FLPyfhelin.py:366-390), so these rows
+are beyond-parity: there is no baseline number to compare against.
+
+Output: a markdown table on stdout (the TPU suite redirects it to
+INFERENCE_TABLE.md) with one machine-readable JSON line per row at the end.
+
+INFERENCE_SMOKE=1 pins CPU and shrinks rings for a pipeline shakeout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+SMOKE = os.environ.get("INFERENCE_SMOKE") == "1"
+if SMOKE:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import jax
+
+REPS = int(os.environ.get("INFERENCE_REPS", "20"))
+
+
+def _bench_scorer(name, scorer, ctx, sk, pk, make_x, want_fn, decrypt_ctx, dec_sk):
+    from hefl_tpu import he_inference as hei
+
+    rng = np.random.default_rng(0)
+    x = make_x(rng)
+    ct_x = hei.encrypt_features(ctx, pk, x, jax.random.key(100))
+
+    t0 = time.perf_counter()
+    out = scorer.score_batched(ct_x)
+    jax.block_until_ready((out.c0, out.c1))
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = scorer.score_batched(ct_x)
+    jax.block_until_ready((out.c0, out.c1))
+    warm_s = (time.perf_counter() - t0) / REPS
+
+    got = hei.decrypt_scores(
+        decrypt_ctx,
+        dec_sk,
+        [
+            hei.Ciphertext(c0=out.c0[k], c1=out.c1[k], scale=out.scale)
+            for k in range(scorer.num_classes)
+        ],
+    )
+    err = float(np.max(np.abs(got - want_fn(x))))
+    return {
+        "row": name,
+        "compile_s": round(compile_s, 3),
+        "warm_latency_ms": round(warm_s * 1e3, 3),
+        "scores_per_s": round(1.0 / warm_s, 2),
+        "max_abs_err": err,
+        "argmax_ok": bool(np.argmax(got) == np.argmax(want_fn(x))),
+    }
+
+
+def main():
+    from hefl_tpu import he_inference as hei
+    from hefl_tpu.ckks import encoding
+    from hefl_tpu.ckks.keys import CkksContext, gen_relin_key, keygen
+
+    backend = jax.devices()[0]
+    rows = []
+    rng = np.random.default_rng(42)
+
+    # --- Row 1: encrypted linear, full-width features -------------------
+    n_lin = 256 if SMOKE else 4096
+    ctx = CkksContext.create(n=n_lin)
+    sk, pk = keygen(ctx, jax.random.key(0))
+    gks = hei.gen_rotation_keys(ctx, sk, jax.random.key(1))
+    d = encoding.num_slots(ctx.ntt)  # every slot carries a feature
+    K = 10
+    W = rng.normal(0, 0.3, (K, d))
+    b = rng.normal(0, 0.2, K)
+    scorer = hei.LinearScorer(ctx, W, b, gks)
+    rows.append(
+        _bench_scorer(
+            f"linear N={n_lin} d={d} K={K}",
+            scorer,
+            ctx,
+            sk,
+            pk,
+            lambda r: r.normal(0, 0.5, d),
+            lambda x: x @ W.T + b,
+            ctx,
+            sk,
+        )
+    )
+
+    # --- Row 2: depth-2 MLP (square activation) -------------------------
+    n_mlp = 512 if SMOKE else 8192
+    ctx2 = CkksContext.create(n=n_mlp, num_primes=5)
+    sk2, pk2 = keygen(ctx2, jax.random.key(10))
+    gks2 = hei.gen_rotation_keys(ctx2, sk2, jax.random.key(11))
+    rlk2 = gen_relin_key(ctx2, sk2, jax.random.key(12))
+    d2, H = (16, 4) if SMOKE else (64, 16)
+    w1 = rng.normal(0, 0.3, (H, d2))
+    b1 = rng.normal(0, 0.2, H)
+    w2 = rng.normal(0, 0.3, (K, H))
+    b2 = rng.normal(0, 0.2, K)
+    mlp = hei.MlpScorer(ctx2, w1, b1, w2, b2, gks2, rlk2)
+    sk_dec = hei.slice_secret_key(sk2, mlp.sub_ctx.num_primes)
+    rows.append(
+        _bench_scorer(
+            f"mlp N={n_mlp} d={d2} H={H} K={K}",
+            mlp,
+            ctx2,
+            sk2,
+            pk2,
+            lambda r: r.normal(0, 0.4, d2),
+            lambda x: ((x @ w1.T + b1) ** 2) @ w2.T + b2,
+            mlp.sub_ctx,
+            sk_dec,
+        )
+    )
+
+    print(f"# Private-inference serving bench ({backend.device_kind}, reps={REPS})")
+    print()
+    print("| config | compile (s) | warm latency (ms) | scores/s | max |err| | argmax ok |")
+    print("|---|---|---|---|---|---|")
+    for r in rows:
+        print(
+            f"| {r['row']} | {r['compile_s']} | {r['warm_latency_ms']} "
+            f"| {r['scores_per_s']} | {r['max_abs_err']:.2e} | {r['argmax_ok']} |"
+        )
+    print()
+    for r in rows:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -334,7 +334,10 @@ def rescale(ctx: CkksContext, a: Ciphertext) -> tuple["CkksContext", Ciphertext]
         return modular.mont_mul(diff, inv_mont, p_head, pinv_head)
 
     sub_ctx = CkksContext(
-        ntt=head_tables, scale=ctx.scale, sigma=ctx.sigma
+        ntt=head_tables,
+        scale=ctx.scale,
+        sigma=ctx.sigma,
+        ksk_digit_bits=ctx.ksk_digit_bits,
     )
     return sub_ctx, Ciphertext(
         c0=_drop(a.c0), c1=_drop(a.c1), scale=a.scale / p_last

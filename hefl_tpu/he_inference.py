@@ -102,6 +102,80 @@ def _linear_program(ctx: CkksContext, pt_scale: float):
     return run
 
 
+def _encode_linear_model(
+    ctx: CkksContext,
+    weights: np.ndarray,
+    bias: np.ndarray,
+    ct_scale: float,
+    pt_scale: float,
+) -> tuple[jax.Array, jax.Array]:
+    """Validate + slot-encode a plaintext linear model (weights [K, d<=slots],
+    bias [K]) for scoring ciphertexts of scale `ct_scale`."""
+    slots = encoding.num_slots(ctx.ntt)
+    weights = np.asarray(weights, np.float64)
+    bias = np.asarray(bias, np.float64)
+    if weights.ndim != 2 or weights.shape[1] > slots:
+        raise ValueError(f"weights must be [K, d<= {slots}], got {weights.shape}")
+    if bias.shape != (weights.shape[0],):
+        raise ValueError(f"bias must be [{weights.shape[0]}], got {bias.shape}")
+    wz = np.zeros((weights.shape[0], slots), np.float64)
+    wz[:, : weights.shape[1]] = weights
+    w_res = jnp.asarray(encoding.encode_slots(ctx.ntt, wz, pt_scale))
+    b_res = jnp.stack(
+        [
+            jnp.asarray(
+                encoding.encode_slots_const(ctx.ntt, float(b), ct_scale * pt_scale)
+            )
+            for b in bias
+        ]
+    )
+    return w_res, b_res
+
+
+class LinearScorer:
+    """Precompiled private-inference server for a FIXED plaintext linear model.
+
+    Hoists everything per-model out of the per-sample path: weight/bias slot
+    encoding (host FFTs) happens once here, and every `score` call is a
+    single cached jitted device dispatch. This is the steady-state serving
+    shape — `encrypted_linear` is the one-shot convenience wrapper over it.
+    """
+
+    def __init__(
+        self,
+        ctx: CkksContext,
+        weights: np.ndarray,
+        bias: np.ndarray,
+        gks: dict[int, GaloisKey],
+        pt_scale: float = 2.0**14,
+        ct_scale: float | None = None,
+    ):
+        self.ctx = ctx
+        self.pt_scale = pt_scale
+        self.ct_scale = ctx.scale if ct_scale is None else ct_scale
+        self.gks = gks
+        self.num_classes = int(np.asarray(weights).shape[0])
+        self._w_res, self._b_res = _encode_linear_model(
+            ctx, weights, bias, self.ct_scale, pt_scale
+        )
+        self._run = _linear_program(ctx, pt_scale)
+
+    def score_batched(self, ct_x: Ciphertext) -> Ciphertext:
+        """K class scores as ONE batched ciphertext (leading axis K)."""
+        if ct_x.scale != self.ct_scale:
+            raise ValueError(
+                f"scorer was built for ct scale {self.ct_scale}, got {ct_x.scale}"
+            )
+        return self._run(ct_x, self._w_res, self._b_res, self.gks)
+
+    def score(self, ct_x: Ciphertext) -> list[Ciphertext]:
+        batched = self.score_batched(ct_x)
+        return [
+            Ciphertext(c0=batched.c0[k], c1=batched.c1[k], scale=batched.scale)
+            for k in range(self.num_classes)
+        ]
+
+
 def encrypted_linear(
     ctx: CkksContext,
     ct_x: Ciphertext,
@@ -116,32 +190,11 @@ def encrypted_linear(
     each carrying its score replicated across all slots at scale
     ct_x.scale * pt_scale. The caller owns neither x nor sk; only the
     plaintext model. All K classes run as one jitted device program.
+    For repeated scoring with a fixed model, build a `LinearScorer` once.
     """
-    slots = encoding.num_slots(ctx.ntt)
-    weights = np.asarray(weights, np.float64)
-    bias = np.asarray(bias, np.float64)
-    if weights.ndim != 2 or weights.shape[1] > slots:
-        raise ValueError(f"weights must be [K, d<= {slots}], got {weights.shape}")
-    if bias.shape != (weights.shape[0],):
-        raise ValueError(f"bias must be [{weights.shape[0]}], got {bias.shape}")
-    wz = np.zeros((weights.shape[0], slots), np.float64)
-    wz[:, : weights.shape[1]] = weights
-    w_res = jnp.asarray(encoding.encode_slots(ctx.ntt, wz, pt_scale))
-    b_res = jnp.stack(
-        [
-            jnp.asarray(
-                encoding.encode_slots_const(
-                    ctx.ntt, float(b), ct_x.scale * pt_scale
-                )
-            )
-            for b in bias
-        ]
-    )
-    batched = _linear_program(ctx, pt_scale)(ct_x, w_res, b_res, gks)
-    return [
-        Ciphertext(c0=batched.c0[k], c1=batched.c1[k], scale=batched.scale)
-        for k in range(weights.shape[0])
-    ]
+    return LinearScorer(
+        ctx, weights, bias, gks, pt_scale, ct_scale=ct_x.scale
+    ).score(ct_x)
 
 
 def decrypt_scores(
@@ -163,6 +216,81 @@ def decrypt_scores(
 def slice_secret_key(sk: SecretKey, num_primes: int) -> SecretKey:
     """Drop RNS limbs from sk to match a rescaled (shrunken) context."""
     return SecretKey(s_mont=sk.s_mont[:num_primes])
+
+
+def _const_eval_residues(ctx: CkksContext, c: np.ndarray, scale: float) -> np.ndarray:
+    """Eval-domain RNS residues of constant-in-every-slot plaintexts.
+
+    A constant polynomial evaluates to its constant at every NTT point, so
+    the eval-domain representation of encode_slots_const(c, scale) is just
+    round(c*scale) mod p_i broadcast over all N points — built here as a
+    [..., L, 1] table in one vectorized host pass, no NTT anywhere. The
+    whole constant table for an output layer (K·H entries) costs K·H·L
+    integer ops on the host.
+    """
+    coeffs = np.round(np.asarray(c, np.float64) * scale).astype(np.int64)
+    p = np.asarray(ctx.ntt.p)[:, 0].astype(np.int64)
+    q = ctx.modulus
+    if np.any(2 * np.abs(coeffs.astype(object)) >= q):
+        raise ValueError(
+            f"constant plaintext saturates: |round(c*scale)| up to "
+            f"{np.max(np.abs(coeffs))} must stay below q/2 (q~2**{q.bit_length()})"
+        )
+    return np.mod(coeffs[..., None], p)[..., None].astype(np.uint32)  # [..., L, 1]
+
+
+def _const_eval_mont(ctx: CkksContext, c: np.ndarray, scale: float) -> np.ndarray:
+    """Montgomery lift of `_const_eval_residues` (x * 2**32 mod p), uint32[..., L, 1]."""
+    res = _const_eval_residues(ctx, c, scale).astype(np.int64)
+    p = np.asarray(ctx.ntt.p)[:, 0].astype(np.int64)[:, None]
+    return ((res << 32) % p).astype(np.uint32)  # residues < 2**27: int64-safe
+
+
+def _sliced_context(ctx: CkksContext) -> CkksContext:
+    """The statically-known context `ops.rescale` will return: one limb fewer."""
+    return CkksContext(
+        ntt=ctx.ntt.slice_limbs(0, ctx.num_primes - 1),
+        scale=ctx.scale,
+        sigma=ctx.sigma,
+        ksk_digit_bits=ctx.ksk_digit_bits,
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _mlp_tail_program(ctx: CkksContext, pt_scale: float, rescales: int):
+    """ONE jitted program for everything after the hidden linear layer:
+    square activation (batched ct×ct + relin), `rescales` rescale stages,
+    and the full output layer scores_k = Σ_j w2[k,j]·h²_j + b2[k].
+
+    The output layer exploits that each h²_j already holds its value in
+    every slot: multiplying by the CONSTANT w2[k,j] is a Montgomery
+    pointwise multiply by the broadcast eval-domain constant — no NTT, no
+    rotation — and the Σ_j is a modular contraction over the hidden axis.
+    This replaces the former K×H-dispatch host loop (plus K×H host
+    encodes) with a single compiled device program, the same treatment
+    `_linear_program` gives the linear path.
+    """
+    from hefl_tpu.ckks import modular
+
+    @jax.jit
+    def run(h: Ciphertext, rlk, w2m, b2e):
+        sq = ops.ct_mul(ctx, h, h, rlk)        # batched over the H axis
+        cur = ctx
+        for _ in range(rescales):
+            cur, sq = ops.rescale(cur, sq)
+        p = jnp.asarray(cur.ntt.p)
+        pinv = jnp.asarray(cur.ntt.pinv_neg)
+        # [K,H,L,1] consts × [1,H,L,N] limbs → [K,H,L,N], contract H mod p.
+        t0 = modular.mont_mul(sq.c0[None], w2m, p, pinv)
+        t1 = modular.mont_mul(sq.c1[None], w2m, p, pinv)
+        c0, c1 = t0[:, 0], t1[:, 0]
+        for j in range(1, t0.shape[1]):        # static H: unrolled modular sum
+            c0 = modular.add_mod(c0, t0[:, j], p)
+            c1 = modular.add_mod(c1, t1[:, j], p)
+        c0 = modular.add_mod(c0, jnp.broadcast_to(b2e, c0.shape), p)
+        return Ciphertext(c0=c0, c1=c1, scale=sq.scale * pt_scale)
+
+    return run
 
 
 def encrypted_mlp(
@@ -194,46 +322,104 @@ def encrypted_mlp(
                                   output layer and the f64 slot decode stay
                                   in exact range;
       4. output layer             scores_k = Σ_j W2[k,j]·h²_j + b2[k] as
-                                  ct × replicated-plaintext + adds — no
-                                  rotations (each h²_j already holds its
-                                  value in every slot).
+                                  eval-domain constant multiplies + a
+                                  modular contraction over H — no rotations
+                                  (each h²_j already holds its value in
+                                  every slot), no NTTs (a constant
+                                  polynomial is constant at every NTT
+                                  point).
+
+    Steps 2–4 run as ONE jitted device program (`_mlp_tail_program`); the
+    hidden layer is `_linear_program` — two dispatches total per sample,
+    independent of H and K.
 
     Returns (shrunken context, K score ciphertexts); decrypt with
     `decrypt_scores(sub_ctx, slice_secret_key(sk, sub_ctx.num_primes), ...)`.
     The server holds only (ctx, rotation keys, rlk) and its plaintext
     weights; it never sees x, h, or the scores.
     """
-    w1 = np.asarray(w1, np.float64)
-    b1 = np.asarray(b1, np.float64)
-    w2 = np.asarray(w2, np.float64)
-    b2 = np.asarray(b2, np.float64)
-    # Validate the OUTPUT layer's shapes up front (w1/b1 are validated by
-    # encrypted_linear itself before any ciphertext op): malformed input
-    # should fail in microseconds, not after H squarings + rescales.
-    if w1.ndim != 2:
-        raise ValueError(f"w1 must be [H, d], got {w1.shape}")
-    if w2.ndim != 2 or w2.shape[1] != w1.shape[0]:
-        raise ValueError(f"w2 must be [K, {w1.shape[0]}], got {w2.shape}")
-    if b2.shape != (w2.shape[0],):
-        raise ValueError(f"b2 must be [{w2.shape[0]}], got {b2.shape}")
-    h = encrypted_linear(ctx, ct_x, w1, b1, gks, pt_scale)
-    h2 = [ops.ct_mul(ctx, c, c, rlk) for c in h]
-    cur = ctx
-    for _ in range(rescales):
-        rescaled = [ops.rescale(cur, c) for c in h2]
-        cur = rescaled[0][0]
-        h2 = [c for _, c in rescaled]
-    out = []
-    for k in range(w2.shape[0]):
-        acc = None
-        for j in range(w2.shape[1]):
-            w_res = jnp.asarray(
-                encoding.encode_slots_const(cur.ntt, w2[k, j], pt_scale)
-            )
-            term = ops.ct_mul_plain_poly(cur, h2[j], w_res, pt_scale)
-            acc = term if acc is None else ops.ct_add(cur, acc, term)
-        b_res = jnp.asarray(
-            encoding.encode_slots_const(cur.ntt, float(b2[k]), acc.scale)
+    scorer = MlpScorer(
+        ctx, w1, b1, w2, b2, gks, rlk, pt_scale, rescales, ct_scale=ct_x.scale
+    )
+    return scorer.sub_ctx, scorer.score(ct_x)
+
+
+class MlpScorer:
+    """Precompiled private-inference server for a FIXED depth-2 MLP.
+
+    The MlpScorer analog of `LinearScorer`: all per-model work — hidden
+    layer slot encodes, the statically-derived post-rescale context, and
+    the output layer's eval-domain constant tables — happens once at
+    construction; every `score` call is exactly two cached jitted device
+    dispatches (`_linear_program` + `_mlp_tail_program`), independent of
+    d, H, and K. Decrypt results against `self.sub_ctx` with
+    `slice_secret_key(sk, self.sub_ctx.num_primes)`.
+    """
+
+    def __init__(
+        self,
+        ctx: CkksContext,
+        w1: np.ndarray,
+        b1: np.ndarray,
+        w2: np.ndarray,
+        b2: np.ndarray,
+        gks: dict[int, GaloisKey],
+        rlk,
+        pt_scale: float = 2.0**14,
+        rescales: int = 2,
+        ct_scale: float | None = None,
+    ):
+        w1 = np.asarray(w1, np.float64)
+        w2 = np.asarray(w2, np.float64)
+        b2 = np.asarray(b2, np.float64)
+        # Validate the OUTPUT layer's shapes up front (w1/b1 are validated
+        # by _encode_linear_model before any ciphertext op): malformed input
+        # should fail in microseconds, not after H squarings + rescales.
+        if w1.ndim != 2:
+            raise ValueError(f"w1 must be [H, d], got {w1.shape}")
+        if w2.ndim != 2 or w2.shape[1] != w1.shape[0]:
+            raise ValueError(f"w2 must be [K, {w1.shape[0]}], got {w2.shape}")
+        if b2.shape != (w2.shape[0],):
+            raise ValueError(f"b2 must be [{w2.shape[0]}], got {b2.shape}")
+        self.ctx = ctx
+        self.pt_scale = pt_scale
+        self.ct_scale = ctx.scale if ct_scale is None else ct_scale
+        self.gks = gks
+        self.rlk = rlk
+        self.num_classes = int(w2.shape[0])
+        self._w1_res, self._b1_res = _encode_linear_model(
+            ctx, w1, b1, self.ct_scale, pt_scale
         )
-        out.append(ops.ct_add_plain(cur, acc, b_res))
-    return cur, out
+        # Statically derive the post-rescale context and scales so the
+        # output layer's constants are host-encoded at exactly the
+        # levels/scales the device program will produce.
+        cur = ctx
+        h_scale = self.ct_scale * pt_scale
+        sq_scale = h_scale * h_scale
+        p_np = np.asarray(ctx.ntt.p)[:, 0]
+        for i in range(rescales):
+            sq_scale /= float(p_np[ctx.num_primes - 1 - i])
+            cur = _sliced_context(cur)
+        self.sub_ctx = cur
+        self._w2m = jnp.asarray(_const_eval_mont(cur, w2, pt_scale))  # [K,H,L',1]
+        self._b2e = jnp.asarray(
+            _const_eval_residues(cur, b2, sq_scale * pt_scale)        # [K,L',1]
+        )
+        self._lin = _linear_program(ctx, pt_scale)
+        self._tail = _mlp_tail_program(ctx, pt_scale, rescales)
+
+    def score_batched(self, ct_x: Ciphertext) -> Ciphertext:
+        """K class scores as ONE batched ciphertext at `self.sub_ctx`'s level."""
+        if ct_x.scale != self.ct_scale:
+            raise ValueError(
+                f"scorer was built for ct scale {self.ct_scale}, got {ct_x.scale}"
+            )
+        h = self._lin(ct_x, self._w1_res, self._b1_res, self.gks)
+        return self._tail(h, self.rlk, self._w2m, self._b2e)
+
+    def score(self, ct_x: Ciphertext) -> list[Ciphertext]:
+        batched = self.score_batched(ct_x)
+        return [
+            Ciphertext(c0=batched.c0[k], c1=batched.c1[k], scale=batched.scale)
+            for k in range(self.num_classes)
+        ]

@@ -63,6 +63,22 @@ def test_feature_overflow_rejected(setup):
         )
 
 
+def test_linear_scorer_reuse_matches_oneshot(setup):
+    # The precompiled serving path (LinearScorer: encode once, many
+    # score() calls) must agree with encrypted_linear for every sample.
+    ctx, sk, pk, gks = setup
+    rng = np.random.default_rng(7)
+    d, num_classes = 64, 4
+    W = rng.normal(0, 0.3, (num_classes, d))
+    b = rng.normal(0, 0.2, num_classes)
+    scorer = hei.LinearScorer(ctx, W, b, gks)
+    for i in range(3):
+        x = rng.normal(0, 0.5, d)
+        ct_x = hei.encrypt_features(ctx, pk, x, jax.random.key(20 + i))
+        got = hei.decrypt_scores(ctx, sk, scorer.score(ct_x))
+        np.testing.assert_allclose(got, x @ W.T + b, atol=0.05)
+
+
 def test_encrypted_mlp_matches_plaintext():
     # Depth-2 homomorphic circuit: scores = W2 (W1 x + b1)^2 + b2 under
     # encryption (square activation a la CryptoNets: ct x ct + relin, then
