@@ -240,15 +240,26 @@ def encode_slots_const(ctx: NTTContext, c: float, scale: float) -> np.ndarray:
     polynomial (coefficient 0 = round(c·scale), all others 0), so the
     residues can be written directly in O(L) work instead of
     encode_slots' O(N log N) host FFT — the serving-path win for
-    ct × scalar-constant multiplies (he_inference's output layers encode
-    K·H such constants per scored sample). Matches
-    encode_slots(ctx, full(N/2, c), scale) exactly: the FFT's float
-    roundoff there is ~1e-13·N·|c|·scale, far below the 0.5 rounding
-    threshold at any scale this library uses.
+    ct × scalar-constant multiplies and bias adds on the serving path.
+    Matches encode_slots(ctx, full(N/2, c), scale) bit-exactly while
+    |c|·scale stays below ~0.5/(1e-13·N) (the FFT path's float roundoff is
+    ~1e-13·N·|c|·scale; past that threshold the two paths may round the
+    integer coefficient differently — this direct path is the exact one).
     """
     p = np.asarray(ctx.p)[:, 0].astype(np.int64)
+    coeff = int(round(c * scale))
+    q = 1
+    for pi in p:
+        q *= int(pi)
+    # Saturation guard (cheap, O(1)): a coefficient past q/2 wraps mod q and
+    # decodes to an uncorrelated value with no error signal downstream.
+    if 2 * abs(coeff) >= q:
+        raise ValueError(
+            f"encode_slots_const saturates: |round(c*scale)|={abs(coeff):.3e} "
+            f"must stay below q/2~{q / 2:.3e}; lower the scale or add primes"
+        )
     res = np.zeros((len(p), ctx.n), np.int64)
-    res[:, 0] = np.mod(int(round(c * scale)), p)
+    res[:, 0] = np.mod(coeff, p)
     return res.astype(np.uint32)
 
 

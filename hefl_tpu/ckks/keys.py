@@ -77,6 +77,21 @@ class CkksContext:
                 f"256*scale (scale=2**{int(scale).bit_length() - 1}); "
                 "add RNS primes or lower the scale"
             )
+        # 128-bit-security ceiling on log2(q) per ring dimension
+        # (HomomorphicEncryption.org standard, classical, ternary secret).
+        # Rings below N=1024 are test-only toys with no security claim at
+        # all, so only production-size rings are checked.
+        bound = {1024: 27, 2048: 54, 4096: 109, 8192: 218, 16384: 438}.get(n)
+        if bound is not None and q.bit_length() > bound:
+            import warnings
+
+            warnings.warn(
+                f"log2(q)~{q.bit_length()} exceeds the 128-bit-security "
+                f"ceiling of {bound} bits for N={n}; use a larger N (e.g. "
+                f"N=8192 for a 5-prime depth-2 chain) or fewer/narrower "
+                "primes if 128-bit security is required",
+                stacklevel=2,
+            )
         return cls(ntt=NTTContext.build(prime_list, n), scale=scale, sigma=sigma)
 
     @property

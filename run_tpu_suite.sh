@@ -1,8 +1,9 @@
 #!/bin/bash
-# Serial TPU measurement suite for round 3. Run when the axon tunnel is up:
+# Serial TPU measurement suite. Run when the axon tunnel is up:
 #   bash run_tpu_suite.sh 2>&1 | tee tpu_suite.log
-# Each stage is independent; a failure skips to the next so one tunnel
-# hiccup doesn't lose the rest.
+# Each stage is independent AND time-bounded: the tunneled TPU platform's
+# documented failure mode is an indefinite hang on backend touch, so every
+# stage runs under `timeout` — one wedge costs minutes, not the window.
 set -x
 cd /root/repo
 
@@ -11,30 +12,39 @@ echo "=== stage 1: NTT microbenchmark + on-hardware Pallas parity gate"
 # on real hardware. If the Mosaic-compiled kernel is broken under the
 # tunneled platform, fall back to the XLA NTT for every later stage rather
 # than corrupt the flagship numbers.
-if python bench_ntt.py > NTT_TABLE.md 2> ntt_err.log; then
+if timeout 900 python bench_ntt.py > NTT_TABLE.md 2> ntt_err.log; then
   cat NTT_TABLE.md
 else
-  echo "NTT bench/parity FAILED - forcing HEFL_NTT=xla for remaining stages"
+  echo "NTT bench/parity FAILED or timed out - forcing HEFL_NTT=xla for remaining stages"
   tail -5 ntt_err.log
   export HEFL_NTT=xla
 fi
 
 echo "=== stage 2: flagship bench seed sweep"
 for s in 0 1 2; do
-  BENCH_SEED=$s python bench.py > seeds_$s.json 2> seeds_err_$s.log
+  timeout 1800 env BENCH_SEED=$s python bench.py > seeds_$s.json 2> seeds_err_$s.log \
+    || echo "seed $s FAILED or timed out (rc=$?)"
   tail -2 seeds_err_$s.log
 done
 
 echo "=== stage 3: phase attribution"
-python profile_round.py > PROFILE.md 2> profile_err.log
+timeout 1800 python profile_round.py > PROFILE.md 2> profile_err.log \
+  || echo "profile FAILED or timed out (rc=$?)"
 cat PROFILE.md
 
 echo "=== stage 4: preset table"
-python results.py 2> results_err.log
+timeout 2400 python results.py 2> results_err.log \
+  || echo "presets FAILED or timed out (rc=$?)"
 tail -3 results_err.log
 
 echo "=== stage 5: convergence curves"
-python results.py --convergence 2> conv_err.log
+timeout 3600 python results.py --convergence 2> conv_err.log \
+  || echo "convergence FAILED or timed out (rc=$?)"
 tail -3 conv_err.log
+
+echo "=== stage 6: private-inference serving bench"
+timeout 900 python bench_inference.py > INFERENCE_TABLE.md 2> inference_err.log \
+  || echo "inference bench FAILED or timed out (rc=$?)"
+cat INFERENCE_TABLE.md
 
 echo "=== done"
