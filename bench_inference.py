@@ -72,6 +72,40 @@ def _bench_scorer(name, scorer, ctx, sk, pk, make_x, want_fn, decrypt_ctx, dec_s
     }
 
 
+def _bench_batched(name, scorer, ctx, pk, make_xs, want_fn, decrypt_ctx, dec_sk):
+    """Throughput row: score_many over a batch in one dispatch."""
+    from hefl_tpu import he_inference as hei
+
+    rng = np.random.default_rng(1)
+    xs = make_xs(rng)
+    ct_xs = hei.encrypt_features(ctx, pk, xs, jax.random.key(200))
+
+    t0 = time.perf_counter()
+    out = scorer.score_many(ct_xs)
+    jax.block_until_ready((out.c0, out.c1))
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = scorer.score_many(ct_xs)
+    jax.block_until_ready((out.c0, out.c1))
+    warm_s = (time.perf_counter() - t0) / REPS
+
+    got = hei.decrypt_score_matrix(decrypt_ctx, dec_sk, out)
+    err = float(np.max(np.abs(got - want_fn(xs))))
+    b = xs.shape[0]
+    return {
+        "row": name,
+        "compile_s": round(compile_s, 3),
+        "warm_latency_ms": round(warm_s * 1e3, 3),
+        "scores_per_s": round(b / warm_s, 2),
+        "max_abs_err": err,
+        "argmax_ok": bool(
+            np.all(np.argmax(got, -1) == np.argmax(want_fn(xs), -1))
+        ),
+    }
+
+
 def main():
     from hefl_tpu import he_inference as hei
     from hefl_tpu.ckks import encoding
@@ -105,6 +139,20 @@ def main():
         )
     )
 
+    B_lin = 4 if SMOKE else 16
+    rows.append(
+        _bench_batched(
+            f"linear N={n_lin} d={d} K={K} B={B_lin}",
+            scorer,
+            ctx,
+            pk,
+            lambda r: r.normal(0, 0.5, (B_lin, d)),
+            lambda xs: xs @ W.T + b,
+            ctx,
+            sk,
+        )
+    )
+
     # --- Row 2: depth-2 MLP (square activation) -------------------------
     n_mlp = 512 if SMOKE else 8192
     ctx2 = CkksContext.create(n=n_mlp, num_primes=5)
@@ -127,6 +175,20 @@ def main():
             pk2,
             lambda r: r.normal(0, 0.4, d2),
             lambda x: ((x @ w1.T + b1) ** 2) @ w2.T + b2,
+            mlp.sub_ctx,
+            sk_dec,
+        )
+    )
+
+    B_mlp = 2 if SMOKE else 8
+    rows.append(
+        _bench_batched(
+            f"mlp N={n_mlp} d={d2} H={H} K={K} B={B_mlp}",
+            mlp,
+            ctx2,
+            pk2,
+            lambda r: r.normal(0, 0.4, (B_mlp, d2)),
+            lambda xs: ((xs @ w1.T + b1) ** 2) @ w2.T + b2,
             mlp.sub_ctx,
             sk_dec,
         )

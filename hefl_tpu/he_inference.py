@@ -82,22 +82,44 @@ def rotate_and_sum(
     return ct
 
 
+def _linear_apply(ctx: CkksContext, pt_scale: float, ct_x: Ciphertext, w_res, b_res, gks):
+    """Score one encrypted sample against all K classes: vmapped ct x
+    plaintext multiply + the shared rotate-and-sum ladder + bias add."""
+
+    def one(w, b):
+        ct = ops.ct_mul_plain_poly(ctx, ct_x, w, pt_scale)
+        ct = rotate_and_sum(ctx, ct, gks)
+        return ops.ct_add_plain(ctx, ct, b)
+
+    return jax.vmap(one)(w_res, b_res)
+
+
 @functools.lru_cache(maxsize=16)
 def _linear_program(ctx: CkksContext, pt_scale: float):
-    """ONE jitted program scoring all K classes: vmapped ct x plaintext
-    multiply + the shared rotate-and-sum ladder + bias add. Replaces
+    """ONE jitted program scoring all K classes of one sample. Replaces
     K x log2(slots) x ~4 separate op dispatches with a single compiled
     dispatch — the difference between a host-driven loop and a device
     program on a (possibly tunneled) TPU."""
 
     @jax.jit
     def run(ct_x: Ciphertext, w_res, b_res, gks):
-        def one(w, b):
-            ct = ops.ct_mul_plain_poly(ctx, ct_x, w, pt_scale)
-            ct = rotate_and_sum(ctx, ct, gks)
-            return ops.ct_add_plain(ctx, ct, b)
+        return _linear_apply(ctx, pt_scale, ct_x, w_res, b_res, gks)
 
-        return jax.vmap(one)(w_res, b_res)
+    return run
+
+
+@functools.lru_cache(maxsize=16)
+def _linear_batch_program(ctx: CkksContext, pt_scale: float):
+    """The batched-serving variant: ONE jitted program scoring a whole
+    batch of encrypted samples (leading axis B on the ciphertext) — the
+    throughput shape, amortizing dispatch and letting XLA tile the B×K
+    lanes together."""
+
+    @jax.jit
+    def run(ct_xs: Ciphertext, w_res, b_res, gks):
+        return jax.vmap(
+            lambda ct: _linear_apply(ctx, pt_scale, ct, w_res, b_res, gks)
+        )(ct_xs)
 
     return run
 
@@ -175,6 +197,24 @@ class LinearScorer:
             for k in range(self.num_classes)
         ]
 
+    def score_many(self, ct_xs: Ciphertext) -> Ciphertext:
+        """Score a whole BATCH of encrypted samples (ct_xs has a leading
+        batch axis, e.g. from `encrypt_features(ctx, pk, x[B, d], key)`) in
+        one device dispatch -> [B, K] batched score ciphertext. Decrypt
+        with `decrypt_score_matrix`."""
+        if ct_xs.scale != self.ct_scale:
+            raise ValueError(
+                f"scorer was built for ct scale {self.ct_scale}, got {ct_xs.scale}"
+            )
+        if ct_xs.c0.ndim != 3:
+            raise ValueError(
+                f"score_many needs a batched ciphertext [B, L, N], got limbs of "
+                f"shape {ct_xs.c0.shape}; use score() for a single sample"
+            )
+        return _linear_batch_program(self.ctx, self.pt_scale)(
+            ct_xs, self._w_res, self._b_res, self.gks
+        )
+
 
 def encrypted_linear(
     ctx: CkksContext,
@@ -211,6 +251,17 @@ def decrypt_scores(
         z = encoding.decode_slots(ctx.ntt, res, ct.scale)
         scores.append(float(np.real(z[..., 0])))
     return np.asarray(scores)
+
+
+def decrypt_score_matrix(
+    ctx: CkksContext, sk: SecretKey, ct: Ciphertext
+) -> np.ndarray:
+    """Owner-side: a batched score ciphertext (any leading axes, e.g.
+    [B, K] from `score_many`) -> real scores of the same leading shape
+    (slot 0 of every ciphertext), in one decrypt."""
+    res = np.asarray(ops.decrypt(ctx, sk, ct))
+    z = encoding.decode_slots(ctx.ntt, res, ct.scale)
+    return np.real(z[..., 0])
 
 
 def slice_secret_key(sk: SecretKey, num_primes: int) -> SecretKey:
@@ -256,9 +307,8 @@ def _sliced_context(ctx: CkksContext) -> CkksContext:
     )
 
 
-@functools.lru_cache(maxsize=16)
-def _mlp_tail_program(ctx: CkksContext, pt_scale: float, rescales: int):
-    """ONE jitted program for everything after the hidden linear layer:
+def _mlp_tail_apply(ctx: CkksContext, pt_scale: float, rescales: int, h, rlk, w2m, b2e):
+    """Everything after the hidden linear layer, for one sample:
     square activation (batched ct×ct + relin), `rescales` rescale stages,
     and the full output layer scores_k = Σ_j w2[k,j]·h²_j + b2[k].
 
@@ -266,29 +316,49 @@ def _mlp_tail_program(ctx: CkksContext, pt_scale: float, rescales: int):
     every slot: multiplying by the CONSTANT w2[k,j] is a Montgomery
     pointwise multiply by the broadcast eval-domain constant — no NTT, no
     rotation — and the Σ_j is a modular contraction over the hidden axis.
-    This replaces the former K×H-dispatch host loop (plus K×H host
-    encodes) with a single compiled device program, the same treatment
-    `_linear_program` gives the linear path.
     """
     from hefl_tpu.ckks import modular
 
+    sq = ops.ct_mul(ctx, h, h, rlk)        # batched over the H axis
+    cur = ctx
+    for _ in range(rescales):
+        cur, sq = ops.rescale(cur, sq)
+    p = jnp.asarray(cur.ntt.p)
+    pinv = jnp.asarray(cur.ntt.pinv_neg)
+    # [K,H,L,1] consts × [1,H,L,N] limbs → [K,H,L,N], contract H mod p.
+    t0 = modular.mont_mul(sq.c0[None], w2m, p, pinv)
+    t1 = modular.mont_mul(sq.c1[None], w2m, p, pinv)
+    c0, c1 = t0[:, 0], t1[:, 0]
+    for j in range(1, t0.shape[1]):        # static H: unrolled modular sum
+        c0 = modular.add_mod(c0, t0[:, j], p)
+        c1 = modular.add_mod(c1, t1[:, j], p)
+    c0 = modular.add_mod(c0, jnp.broadcast_to(b2e, c0.shape), p)
+    return Ciphertext(c0=c0, c1=c1, scale=sq.scale * pt_scale)
+
+
+@functools.lru_cache(maxsize=16)
+def _mlp_tail_program(ctx: CkksContext, pt_scale: float, rescales: int):
+    """ONE jitted program for the per-sample MLP tail — this replaces the
+    former K×H-dispatch host loop (plus K×H host encodes), the same
+    treatment `_linear_program` gives the linear path."""
+
     @jax.jit
     def run(h: Ciphertext, rlk, w2m, b2e):
-        sq = ops.ct_mul(ctx, h, h, rlk)        # batched over the H axis
-        cur = ctx
-        for _ in range(rescales):
-            cur, sq = ops.rescale(cur, sq)
-        p = jnp.asarray(cur.ntt.p)
-        pinv = jnp.asarray(cur.ntt.pinv_neg)
-        # [K,H,L,1] consts × [1,H,L,N] limbs → [K,H,L,N], contract H mod p.
-        t0 = modular.mont_mul(sq.c0[None], w2m, p, pinv)
-        t1 = modular.mont_mul(sq.c1[None], w2m, p, pinv)
-        c0, c1 = t0[:, 0], t1[:, 0]
-        for j in range(1, t0.shape[1]):        # static H: unrolled modular sum
-            c0 = modular.add_mod(c0, t0[:, j], p)
-            c1 = modular.add_mod(c1, t1[:, j], p)
-        c0 = modular.add_mod(c0, jnp.broadcast_to(b2e, c0.shape), p)
-        return Ciphertext(c0=c0, c1=c1, scale=sq.scale * pt_scale)
+        return _mlp_tail_apply(ctx, pt_scale, rescales, h, rlk, w2m, b2e)
+
+    return run
+
+
+@functools.lru_cache(maxsize=16)
+def _mlp_tail_batch_program(ctx: CkksContext, pt_scale: float, rescales: int):
+    """Batched-serving MLP tail: one jitted program over a whole batch of
+    hidden-layer ciphertexts (leading axis B)."""
+
+    @jax.jit
+    def run(hs: Ciphertext, rlk, w2m, b2e):
+        return jax.vmap(
+            lambda h: _mlp_tail_apply(ctx, pt_scale, rescales, h, rlk, w2m, b2e)
+        )(hs)
 
     return run
 
@@ -387,6 +457,7 @@ class MlpScorer:
         self.gks = gks
         self.rlk = rlk
         self.num_classes = int(w2.shape[0])
+        self._rescales = rescales
         self._w1_res, self._b1_res = _encode_linear_model(
             ctx, w1, b1, self.ct_scale, pt_scale
         )
@@ -423,3 +494,24 @@ class MlpScorer:
             Ciphertext(c0=batched.c0[k], c1=batched.c1[k], scale=batched.scale)
             for k in range(self.num_classes)
         ]
+
+    def score_many(self, ct_xs: Ciphertext) -> Ciphertext:
+        """Score a whole BATCH of encrypted samples in two device
+        dispatches -> [B, K] batched score ciphertext at `self.sub_ctx`'s
+        level. Decrypt with `decrypt_score_matrix` against
+        `slice_secret_key(sk, self.sub_ctx.num_primes)`."""
+        if ct_xs.scale != self.ct_scale:
+            raise ValueError(
+                f"scorer was built for ct scale {self.ct_scale}, got {ct_xs.scale}"
+            )
+        if ct_xs.c0.ndim != 3:
+            raise ValueError(
+                f"score_many needs a batched ciphertext [B, L, N], got limbs of "
+                f"shape {ct_xs.c0.shape}; use score() for a single sample"
+            )
+        hs = _linear_batch_program(self.ctx, self.pt_scale)(
+            ct_xs, self._w1_res, self._b1_res, self.gks
+        )
+        return _mlp_tail_batch_program(self.ctx, self.pt_scale, self._rescales)(
+            hs, self.rlk, self._w2m, self._b2e
+        )

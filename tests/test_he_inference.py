@@ -79,6 +79,26 @@ def test_linear_scorer_reuse_matches_oneshot(setup):
         np.testing.assert_allclose(got, x @ W.T + b, atol=0.05)
 
 
+def test_score_many_matches_per_sample(setup):
+    # Batched serving (score_many: [B] cts in, [B, K] scores out, one
+    # dispatch) must agree with per-sample score() on every sample.
+    ctx, sk, pk, gks = setup
+    rng = np.random.default_rng(8)
+    d, num_classes, batch = 32, 3, 4
+    W = rng.normal(0, 0.3, (num_classes, d))
+    b = rng.normal(0, 0.2, num_classes)
+    xs = rng.normal(0, 0.5, (batch, d))
+    scorer = hei.LinearScorer(ctx, W, b, gks)
+    ct_xs = hei.encrypt_features(ctx, pk, xs, jax.random.key(30))
+    got = hei.decrypt_score_matrix(ctx, sk, scorer.score_many(ct_xs))
+    assert got.shape == (batch, num_classes)
+    np.testing.assert_allclose(got, xs @ W.T + b, atol=0.05)
+    for i in range(batch):
+        ct_i = hei.encrypt_features(ctx, pk, xs[i], jax.random.key(40 + i))
+        one = hei.decrypt_scores(ctx, sk, scorer.score(ct_i))
+        np.testing.assert_allclose(got[i], one, atol=0.1)
+
+
 def test_encrypted_mlp_matches_plaintext():
     # Depth-2 homomorphic circuit: scores = W2 (W1 x + b1)^2 + b2 under
     # encryption (square activation a la CryptoNets: ct x ct + relin, then
@@ -110,3 +130,15 @@ def test_encrypted_mlp_matches_plaintext():
     want = h @ w2.T + b2
     np.testing.assert_allclose(got, want, atol=0.05)
     assert np.argmax(got) == np.argmax(want)
+
+    # Batched MLP serving: score_many on [B] samples, one decrypt.
+    xs = rng.normal(0, 0.4, (3, d))
+    scorer = hei.MlpScorer(ctx, w1, b1, w2, b2, gks, rlk)
+    ct_xs = hei.encrypt_features(ctx, pk, xs, jax.random.key(15))
+    got_b = hei.decrypt_score_matrix(
+        scorer.sub_ctx,
+        hei.slice_secret_key(sk, scorer.sub_ctx.num_primes),
+        scorer.score_many(ct_xs),
+    )
+    want_b = ((xs @ w1.T + b1) ** 2) @ w2.T + b2
+    np.testing.assert_allclose(got_b, want_b, atol=0.05)
