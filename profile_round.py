@@ -20,6 +20,14 @@ per-phase prints (encrypt/export/aggregate/decrypt,
 All timings are min-over-reps of warm (compiled) executions on the bench
 configuration (2 clients, 10 local epochs, medical 256x256). Writes a
 markdown table + one JSON line to stdout.
+
+Methodology caveat (printed with the table): the in-round attributions are
+SUBTRACTIONS ACROSS SEPARATELY-COMPILED PROGRAMS — each ablated variant is
+its own XLA program and may fuse differently, so "full − train_only = HE
+cost" is an estimate, not a measurement of the fused program's internals.
+The standalone encrypt/aggregate rows are the cross-check; for a
+trace-level ground truth run the experiment CLI with `--profile` in the
+same TPU window and compare.
 """
 
 from __future__ import annotations
@@ -200,6 +208,14 @@ def main() -> None:
         "device": getattr(jax.devices()[0], "device_kind", "cpu"),
     }
 
+    print(
+        "Attribution method: ablation — each row below the total is the "
+        "difference between two separately-compiled program variants "
+        "(estimates; XLA may fuse each variant differently). Standalone "
+        "encrypt/aggregate rows cross-check the HE estimate; `--profile` "
+        "traces are the fused program's ground truth."
+    )
+    print()
     print("| phase | seconds | share of fused round |")
     print("|---|---|---|")
     rows = [
