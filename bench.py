@@ -93,6 +93,13 @@ def main() -> None:
         # Pin the platform before first backend touch (the ambient
         # sitecustomize preimports jax on the tunneled TPU).
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # Fast-fail instead of hanging on a wedged tunnel (BENCH_r03 was
+        # lost to exactly this): probe the backend in a bounded subprocess
+        # before this process' first backend touch.
+        from hefl_tpu.utils.probe import require_live_backend
+
+        require_live_backend("bench.py")
     import jax.numpy as jnp
 
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")

@@ -32,6 +32,10 @@ if SMOKE:
 else:
     import jax
 
+    from hefl_tpu.utils.probe import require_live_backend
+
+    require_live_backend("bench_inference.py")
+
 REPS = int(os.environ.get("INFERENCE_REPS", "20"))
 
 

@@ -47,6 +47,10 @@ def main() -> None:
         # sitecustomize preimports jax on the tunneled TPU; a wedged tunnel
         # would hang the smoke run that exists to avoid wasting TPU time).
         jax.config.update("jax_platforms", "cpu")
+    else:
+        from hefl_tpu.utils.probe import require_live_backend
+
+        require_live_backend("bench_ntt.py")
     import jax.numpy as jnp
 
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")

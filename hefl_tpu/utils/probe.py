@@ -1,0 +1,77 @@
+"""Hang-proof JAX backend probing for host tooling.
+
+The tunneled single-TPU platform this framework is benchmarked on has one
+documented failure mode: the FIRST backend touch (`jax.devices()`) in a
+process blocks indefinitely while the tunnel is wedged. Every measurement
+driver and the multichip dryrun therefore decides "is a backend actually
+reachable?" WITHOUT touching the current process' uninitialized backend:
+
+  1. `HEFL_DRYRUN_FORCE_VIRTUAL=1` -> report 0 devices (escape hatch);
+  2. backend already live in this process -> read its device count
+     directly (no new backend touch can hang);
+  3. otherwise `jax.devices()` runs in a `timeout`-bounded SUBPROCESS with
+     this process' ambient config (the sitecustomize platform pin applies
+     there too, so it counts the same devices the parent would see).
+     Timeout, crash, or unparsable output all count as 0.
+
+A wedge then costs `timeout_s`, not a measurement window.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def probed_device_count(
+    timeout_s: float = 30.0, honor_force_virtual: bool = True
+) -> int:
+    """Device count the current process WOULD see, without hang risk.
+
+    `honor_force_virtual=False` skips the tier-1 escape hatch: used by
+    `require_live_backend`, for which HEFL_DRYRUN_FORCE_VIRTUAL (meaning
+    "dryrun: use a virtual mesh") must not read as "backend dead".
+    """
+    if honor_force_virtual and os.environ.get("HEFL_DRYRUN_FORCE_VIRTUAL") == "1":
+        return 0
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge._backends:
+            import jax
+
+            return len(jax.devices())
+    except Exception:
+        pass
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        if proc.returncode == 0:
+            return int(proc.stdout.strip().splitlines()[-1])
+    except Exception:
+        pass
+    return 0
+
+
+def require_live_backend(script: str, timeout_s: float = 30.0) -> None:
+    """Fast-fail guard for measurement drivers: exit 1 with a clear message
+    if no backend is reachable, instead of hanging on first touch until an
+    outer `timeout` kills the stage. Set HEFL_NO_PROBE=1 to skip (and
+    accept the hang risk, e.g. to wait out a tunnel blip under a driver
+    that handles timeouts itself)."""
+    if os.environ.get("HEFL_NO_PROBE") == "1":
+        return
+    if probed_device_count(timeout_s, honor_force_virtual=False) == 0:
+        print(
+            f"{script}: no JAX backend reachable (device probe failed or "
+            f"timed out after {timeout_s:.0f}s — wedged TPU tunnel?); "
+            "exiting instead of hanging. HEFL_NO_PROBE=1 overrides.",
+            file=sys.stderr,
+            flush=True,
+        )
+        sys.exit(1)

@@ -46,6 +46,9 @@ PRESET_LABELS = {
 def _jax_setup():
     import jax
 
+    from hefl_tpu.utils.probe import require_live_backend
+
+    require_live_backend("results.py")
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return jax

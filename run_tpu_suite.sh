@@ -7,6 +7,19 @@
 set -x
 cd /root/repo
 
+echo "=== stage 0: backend reachability probe"
+# One probe for the whole window: if the backend answers now, skip the
+# per-stage fast-fail probes (each would pay a redundant serial TPU init in
+# a subprocess; the per-stage `timeout`s still bound a mid-window wedge).
+# If it does NOT answer, keep per-stage probes on so every stage fails in
+# ~30 s instead of burning its full timeout.
+if timeout 60 python -c "import jax; assert jax.devices()"; then
+  export HEFL_NO_PROBE=1
+  echo "backend up - per-stage probes disabled for this window"
+else
+  echo "backend probe failed - stages will fast-fail individually"
+fi
+
 echo "=== stage 1: NTT microbenchmark + on-hardware Pallas parity gate"
 # Runs FIRST: it bit-exact-compares the Pallas kernel against the XLA path
 # on real hardware. If the Mosaic-compiled kernel is broken under the
