@@ -148,8 +148,10 @@ def load_seed_runs() -> list[dict]:
                 line = f.read().strip().splitlines()
             if line:
                 rec = json.loads(line[0])
-                if rec.get("smoke"):
-                    continue   # BENCH_SMOKE shakeout run, not a flagship result
+                if rec.get("smoke") or rec.get("platform_pinned"):
+                    # BENCH_SMOKE shakeout or BENCH_PLATFORM accuracy-evidence
+                    # run — not a TPU flagship timing result.
+                    continue
                 rec["_seed_file"] = pth
                 rows.append(rec)
         except (OSError, json.JSONDecodeError):
@@ -268,6 +270,11 @@ def write_markdown(data: dict) -> str:
             with open("ntt_bench.json") as f:
                 nb = json.load(f)
         except (OSError, json.JSONDecodeError):
+            nb = None
+        # Same rule as the platform_pinned seed filter: an interpreted /
+        # off-TPU NTT smoke run must never stand in for the hardware
+        # kernel comparison this section exists to document.
+        if nb and nb.get("pallas_mode") != "compiled":
             nb = None
         if nb and nb.get("rows"):
             lines += [
