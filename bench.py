@@ -87,15 +87,15 @@ def main() -> None:
     import jax
 
     smoke = os.environ.get("BENCH_SMOKE") == "1"
-    platform = os.environ.get("BENCH_PLATFORM")
+    platform = None if smoke else os.environ.get("BENCH_PLATFORM")
     if smoke:
         # Harness shakeout on CPU (same code path, tiny shapes): proves the
         # whole measurement pipeline end-to-end without spending TPU time.
         # Pin the platform before first backend touch (the ambient
         # sitecustomize preimports jax on the tunneled TPU).
         jax.config.update("jax_platforms", "cpu")
-    elif platform:
-        # FULL flagship shapes on a pinned platform (BENCH_PLATFORM=cpu):
+    elif platform == "cpu":
+        # FULL flagship shapes pinned to CPU (BENCH_PLATFORM=cpu):
         # accuracy, fidelity, and encode-overflow evidence is
         # device-independent, so this mode measures it while the TPU
         # tunnel is down. Timing fields are still emitted but carry the
@@ -104,10 +104,14 @@ def main() -> None:
     else:
         # Fast-fail instead of hanging on a wedged tunnel (BENCH_r03 was
         # lost to exactly this): probe the backend in a bounded subprocess
-        # before this process' first backend touch.
+        # before this process' first backend touch. Applies to any
+        # hardware platform pin too — BENCH_PLATFORM=tpu must not
+        # reintroduce the hang.
         from hefl_tpu.utils.probe import require_live_backend
 
         require_live_backend("bench.py")
+        if platform:
+            jax.config.update("jax_platforms", platform)
     import jax.numpy as jnp
 
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")

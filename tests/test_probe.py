@@ -1,0 +1,58 @@
+"""Unit tests for the hang-proof backend probe (hefl_tpu.utils.probe).
+
+Only the cheap tiers are exercised here: tier 1 (env escape hatch) and
+tier 2 (already-initialized backend). Tier 3 (the subprocess probe) is
+deliberately NOT driven in CI — under a wedged tunnel it would cost its
+full timeout per test; it is exercised end-to-end by the dryrun re-exec
+test and by every measurement driver's fast-fail path.
+"""
+
+import pytest
+
+import jax
+
+from hefl_tpu.utils import probe
+
+
+@pytest.fixture(autouse=True)
+def _live_backend():
+    # conftest pins an 8-device CPU platform; touching it makes tier 2
+    # deterministic for every test in this file.
+    assert len(jax.devices()) == 8
+
+
+def test_force_virtual_hatch_reports_zero(monkeypatch):
+    monkeypatch.setenv("HEFL_DRYRUN_FORCE_VIRTUAL", "1")
+    assert probe.probed_device_count() == 0
+
+
+def test_live_backend_counted_without_subprocess(monkeypatch):
+    monkeypatch.delenv("HEFL_DRYRUN_FORCE_VIRTUAL", raising=False)
+    assert probe.probed_device_count() == 8
+
+
+def test_guard_ignores_force_virtual(monkeypatch):
+    # The dryrun's "use a virtual mesh" sentinel must not read as
+    # "backend dead" to the measurement drivers' guard.
+    monkeypatch.setenv("HEFL_DRYRUN_FORCE_VIRTUAL", "1")
+    assert probe.probed_device_count(honor_force_virtual=False) == 8
+    probe.require_live_backend("test")  # must NOT exit
+
+
+def test_guard_passes_on_live_backend(monkeypatch):
+    monkeypatch.delenv("HEFL_DRYRUN_FORCE_VIRTUAL", raising=False)
+    probe.require_live_backend("test")  # must NOT exit
+
+
+def test_no_probe_env_skips_guard(monkeypatch):
+    monkeypatch.setenv("HEFL_NO_PROBE", "1")
+    probe.require_live_backend("test")  # must NOT exit (even if it would fail)
+
+
+def test_guard_exits_when_no_devices(monkeypatch, capsys):
+    monkeypatch.delenv("HEFL_NO_PROBE", raising=False)
+    monkeypatch.setattr(probe, "probed_device_count", lambda *a, **k: 0)
+    with pytest.raises(SystemExit) as exc:
+        probe.require_live_backend("somedriver.py")
+    assert exc.value.code == 1
+    assert "somedriver.py" in capsys.readouterr().err
