@@ -109,7 +109,7 @@ def main() -> None:
         # reintroduce the hang.
         from hefl_tpu.utils.probe import require_live_backend
 
-        require_live_backend("bench.py")
+        require_live_backend("bench.py", platform=platform)
         if platform:
             jax.config.update("jax_platforms", platform)
     import jax.numpy as jnp
@@ -226,64 +226,79 @@ def main() -> None:
         cur = new_params
 
     # --- cell-6 comparison artifact ---------------------------------------
-    # (a) plaintext_round_s: one REAL plaintext FedAvg round (train + pmean),
-    # the cost denominator for "what does encryption add per round".
-    k_train, _ = jax.random.split(last_key)
-    # Warm-up (untimed): the plaintext program has never run in this
-    # process, and a cold timing would fold its XLA compile into the
-    # "what does encryption add per round" denominator, which is compared
-    # against WARM encrypted rounds.
-    jax.block_until_ready(
-        fedavg_round(module, cfg, mesh, last_start, xs_d, ys_d, k_train)[0]
-    )
-    tp0 = time.perf_counter()
-    plain_params, _ = fedavg_round(
-        module, cfg, mesh, last_start, xs_d, ys_d, k_train
-    )
-    jax.block_until_ready(plain_params)
-    plaintext_round_s = time.perf_counter() - tp0
-    # (b) fidelity: the PRODUCTION encrypted round (same program family:
-    # train + encrypt + hierarchical psum-of-limbs) run once in
-    # with_plain_reference mode, which additionally emits the plaintext
-    # FedAvg mean of the SAME in-program trained weights. decrypt vs that
-    # reference isolates pure CKKS encode/encrypt/aggregate/decrypt error
-    # at flagship scale THROUGH the production collective. (Comparing
-    # against (a)'s weights instead would measure training chaos: a second
-    # XLA program is not bit-reproducible, and fusion-level float
-    # differences flip the discrete best-epoch restore.)
-    # Measurement-only cost: the with_plain_reference variant is its own
-    # XLA program (one extra flagship-shape compile, ~44 s cold on TPU,
-    # persistent-cached afterwards) — it is NOT part of any timed round
-    # above, so do not read its wall-clock as a perf regression.
-    ct_diag, _, ov_diag, plain_ref = secure_fedavg_round(
-        module, cfg, mesh, ctx, pk, last_start, xs_d, ys_d, last_key,
-        with_plain_reference=True,
-    )
-    cell6_overflow = int(np.sum(np.asarray(ov_diag)))
-    enc_avg = decrypt_average(ctx, sk, ct_diag, num_clients, pack)
-    diffs = jax.tree_util.tree_map(
-        lambda a, b: float(jnp.max(jnp.abs(a - b))), enc_avg, plain_ref
-    )
-    max_diff = max(jax.tree_util.tree_leaves(diffs))
-    # Same comparison through the exact bignum/C++ CRT decode: isolates pure
-    # HE noise (encrypt/aggregate/decrypt) from the jittable f32 decode's
-    # recombination error.
-    enc_exact = decrypt_average(ctx, sk, ct_diag, num_clients, pack, exact=True)
-    diffs_exact = jax.tree_util.tree_map(
-        lambda a, b: float(jnp.max(jnp.abs(a - b))), enc_exact, plain_ref
-    )
-    max_diff_exact = max(jax.tree_util.tree_leaves(diffs_exact))
+    # BENCH_SKIP_CELL6=1 skips the whole diagnostic tail (3 extra
+    # round-equivalents of compute: plaintext warmup + timed plaintext
+    # round + the with_plain_reference round). Meant for accuracy-evidence
+    # runs on slow backends (BENCH_PLATFORM=cpu) where the tail would
+    # multiply a multi-hour run; the JSON then carries nulls for the
+    # cell-6 fields rather than numbers from a config that never ran.
+    skip_cell6 = os.environ.get("BENCH_SKIP_CELL6") == "1"
+    plaintext_round_s = max_diff = max_diff_exact = cell6_overflow = None
     ct_bytes = (last_ct_sum.c0.size + last_ct_sum.c1.size) * 4
     param_bytes = count_params(params) * 4
     expansion = ct_bytes / param_bytes
-    log(
-        f"cell-6 artifact: plaintext round {plaintext_round_s:.2f}s, "
-        f"max |enc_avg - plain_avg| = {max_diff:.2e} (f32 decode) / "
-        f"{max_diff_exact:.2e} (exact decode), "
-        f"ciphertext {ct_bytes / 1e6:.1f} MB vs plain {param_bytes / 1e6:.1f} MB "
-        f"({expansion:.1f}x expansion)"
-        + (f" | ENCODE OVERFLOW: {cell6_overflow}" if cell6_overflow else "")
-    )
+    if skip_cell6:
+        log("cell-6 artifact skipped (BENCH_SKIP_CELL6=1)")
+    else:
+        # (a) plaintext_round_s: one REAL plaintext FedAvg round (train +
+        # pmean), the cost denominator for "what does encryption add per
+        # round".
+        k_train, _ = jax.random.split(last_key)
+        # Warm-up (untimed): the plaintext program has never run in this
+        # process, and a cold timing would fold its XLA compile into the
+        # "what does encryption add per round" denominator, which is
+        # compared against WARM encrypted rounds.
+        jax.block_until_ready(
+            fedavg_round(module, cfg, mesh, last_start, xs_d, ys_d, k_train)[0]
+        )
+        tp0 = time.perf_counter()
+        plain_params, _ = fedavg_round(
+            module, cfg, mesh, last_start, xs_d, ys_d, k_train
+        )
+        jax.block_until_ready(plain_params)
+        plaintext_round_s = time.perf_counter() - tp0
+        # (b) fidelity: the PRODUCTION encrypted round (same program family:
+        # train + encrypt + hierarchical psum-of-limbs) run once in
+        # with_plain_reference mode, which additionally emits the plaintext
+        # FedAvg mean of the SAME in-program trained weights. decrypt vs
+        # that reference isolates pure CKKS encode/encrypt/aggregate/decrypt
+        # error at flagship scale THROUGH the production collective.
+        # (Comparing against (a)'s weights instead would measure training
+        # chaos: a second XLA program is not bit-reproducible, and
+        # fusion-level float differences flip the discrete best-epoch
+        # restore.)
+        # Measurement-only cost: the with_plain_reference variant is its own
+        # XLA program (one extra flagship-shape compile, ~44 s cold on TPU,
+        # persistent-cached afterwards) — it is NOT part of any timed round
+        # above, so do not read its wall-clock as a perf regression.
+        ct_diag, _, ov_diag, plain_ref = secure_fedavg_round(
+            module, cfg, mesh, ctx, pk, last_start, xs_d, ys_d, last_key,
+            with_plain_reference=True,
+        )
+        cell6_overflow = int(np.sum(np.asarray(ov_diag)))
+        enc_avg = decrypt_average(ctx, sk, ct_diag, num_clients, pack)
+        diffs = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), enc_avg, plain_ref
+        )
+        max_diff = max(jax.tree_util.tree_leaves(diffs))
+        # Same comparison through the exact bignum/C++ CRT decode: isolates
+        # pure HE noise (encrypt/aggregate/decrypt) from the jittable f32
+        # decode's recombination error.
+        enc_exact = decrypt_average(
+            ctx, sk, ct_diag, num_clients, pack, exact=True
+        )
+        diffs_exact = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), enc_exact, plain_ref
+        )
+        max_diff_exact = max(jax.tree_util.tree_leaves(diffs_exact))
+        log(
+            f"cell-6 artifact: plaintext round {plaintext_round_s:.2f}s, "
+            f"max |enc_avg - plain_avg| = {max_diff:.2e} (f32 decode) / "
+            f"{max_diff_exact:.2e} (exact decode), "
+            f"ciphertext {ct_bytes / 1e6:.1f} MB vs plain "
+            f"{param_bytes / 1e6:.1f} MB ({expansion:.1f}x expansion)"
+            + (f" | ENCODE OVERFLOW: {cell6_overflow}" if cell6_overflow else "")
+        )
 
     cold = round_stats[0]
     warm = round_stats[1:]
@@ -336,9 +351,11 @@ def main() -> None:
                 "acc_vs_reference": round(
                     history[0]["accuracy"] - BASELINE_ACC, 4
                 ),
-                "plaintext_round_s": round(plaintext_round_s, 3),
+                "plaintext_round_s": plaintext_round_s
+                and round(plaintext_round_s, 3),
                 "enc_plain_max_abs_diff": max_diff,
                 "enc_plain_max_abs_diff_exact_decode": max_diff_exact,
+                **({"cell6_skipped": True} if skip_cell6 else {}),
                 # Saturation guard (VERDICT r2 weak #1): per-client weights
                 # clipped at the CKKS encode envelope across ALL rounds —
                 # 0 proves the fidelity number above is unclipped.
@@ -348,10 +365,14 @@ def main() -> None:
                 "encode_overflow_count": overflow_total,
                 # Same guard for the cell-6 artifact's own (re-)training.
                 "cell6_encode_overflow_count": cell6_overflow,
+                # Source: the cell-6 plaintext round's weights when it ran,
+                # else the final decrypted encrypted-average model.
                 "max_abs_trained_weight": round(
                     max(
                         float(jnp.max(jnp.abs(v)))
-                        for v in jax.tree_util.tree_leaves(plain_params)
+                        for v in jax.tree_util.tree_leaves(
+                            cur if skip_cell6 else plain_params
+                        )
                     ),
                     4,
                 ),

@@ -25,13 +25,22 @@ import sys
 
 
 def probed_device_count(
-    timeout_s: float = 30.0, honor_force_virtual: bool = True
+    timeout_s: float = 30.0,
+    honor_force_virtual: bool = True,
+    platform: str | None = None,
 ) -> int:
     """Device count the current process WOULD see, without hang risk.
 
     `honor_force_virtual=False` skips the tier-1 escape hatch: used by
     `require_live_backend`, for which HEFL_DRYRUN_FORCE_VIRTUAL (meaning
     "dryrun: use a virtual mesh") must not read as "backend dead".
+
+    `platform` forwards an intended platform pin (e.g. "tpu") into the
+    tier-3 probe subprocess via JAX_PLATFORMS, so the probe counts devices
+    on the platform the CALLER will actually pin — not the ambient default,
+    which may be healthy while the pinned one is wedged. (Tier 2 reflects
+    the already-live backend regardless: if one is initialized, a later pin
+    in this process is impossible anyway.)
     """
     if honor_force_virtual and os.environ.get("HEFL_DRYRUN_FORCE_VIRTUAL") == "1":
         return 0
@@ -45,11 +54,15 @@ def probed_device_count(
     except Exception:
         pass
     try:
+        env = dict(os.environ)
+        if platform:
+            env["JAX_PLATFORMS"] = platform
         proc = subprocess.run(
             [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
             capture_output=True,
             text=True,
             timeout=timeout_s,
+            env=env,
         )
         if proc.returncode == 0:
             return int(proc.stdout.strip().splitlines()[-1])
@@ -58,15 +71,21 @@ def probed_device_count(
     return 0
 
 
-def require_live_backend(script: str, timeout_s: float = 30.0) -> None:
+def require_live_backend(
+    script: str, timeout_s: float = 30.0, platform: str | None = None
+) -> None:
     """Fast-fail guard for measurement drivers: exit 1 with a clear message
     if no backend is reachable, instead of hanging on first touch until an
-    outer `timeout` kills the stage. Set HEFL_NO_PROBE=1 to skip (and
-    accept the hang risk, e.g. to wait out a tunnel blip under a driver
-    that handles timeouts itself)."""
+    outer `timeout` kills the stage. `platform` is the pin the caller is
+    about to apply — the probe tests THAT platform. Set HEFL_NO_PROBE=1 to
+    skip (and accept the hang risk, e.g. to wait out a tunnel blip under a
+    driver that handles timeouts itself)."""
     if os.environ.get("HEFL_NO_PROBE") == "1":
         return
-    if probed_device_count(timeout_s, honor_force_virtual=False) == 0:
+    if (
+        probed_device_count(timeout_s, honor_force_virtual=False, platform=platform)
+        == 0
+    ):
         print(
             f"{script}: no JAX backend reachable (device probe failed or "
             f"timed out after {timeout_s:.0f}s — wedged TPU tunnel?); "

@@ -239,12 +239,14 @@ def write_markdown(data: dict) -> str:
             "|---|---|---|---|---|---|---|",
         ]
         for s in seeds:
+            diff = s.get("enc_plain_max_abs_diff")
             lines.append(
                 f"| {s['_seed_file']} | {s['value']} | "
                 f"{s.get('steady_round_s')} | "
                 f"{s.get('rounds_per_sec_per_chip')} | "
                 f"{s.get('accuracy_by_round')} | "
-                f"{s.get('enc_plain_max_abs_diff'):.2e} | "
+                # null when the run skipped the cell-6 tail (BENCH_SKIP_CELL6)
+                f"{f'{diff:.2e}' if diff is not None else 'skipped'} | "
                 f"{s.get('encode_overflow_count', 'n/a')} |"
             )
     if conv:
