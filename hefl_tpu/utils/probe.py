@@ -50,6 +50,17 @@ def probed_device_count(
         if xla_bridge._backends:
             import jax
 
+            if platform is not None:
+                # A live backend of the WRONG platform must read as 0: a
+                # later jax_platforms pin would be a silent no-op, and the
+                # caller would run (and label) its measurement on the wrong
+                # device. Tunneled TPU plugins report their own platform
+                # name while their devices are TPU chips, so "tpu" also
+                # matches by device_kind.
+                live = jax.default_backend()
+                kind = getattr(jax.devices()[0], "device_kind", "").lower()
+                if live != platform and not (platform == "tpu" and "tpu" in kind):
+                    return 0
             return len(jax.devices())
     except Exception:
         pass

@@ -49,6 +49,14 @@ def test_no_probe_env_skips_guard(monkeypatch):
     probe.require_live_backend("test")  # must NOT exit (even if it would fail)
 
 
+def test_live_backend_of_wrong_platform_reads_zero(monkeypatch):
+    # conftest's live backend is CPU; asking for a tpu pin must NOT be
+    # green-lit by it (the pin would be a silent no-op after backend init).
+    monkeypatch.delenv("HEFL_DRYRUN_FORCE_VIRTUAL", raising=False)
+    assert probe.probed_device_count(platform="tpu") == 0
+    assert probe.probed_device_count(platform="cpu") == 8
+
+
 def test_guard_exits_when_no_devices(monkeypatch, capsys):
     monkeypatch.delenv("HEFL_NO_PROBE", raising=False)
     monkeypatch.setattr(probe, "probed_device_count", lambda *a, **k: 0)
