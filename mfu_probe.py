@@ -1,0 +1,154 @@
+"""Train-step MFU probe: is the MedCNN SGD step compute- or latency-bound?
+
+VERDICT r3 next #7 asks either for a measured speedup of the steady train
+phase or a trace-backed explanation of why MFU sits near 0.02. This harness
+answers it directly: it times ONE jitted train step (grad + Adam, the exact
+math `fl/client.py:train_step` runs inside its lax.scan) across a batch-size
+ladder and reports images/s and MFU per point, using XLA's own
+`cost_analysis()['flops']` for the numerator rather than a hand FLOP model.
+
+The diagnostic logic: the reference trains at batch 32
+(/root/reference/FLPyfhelin.py:184-196 via model.fit defaults in the driver).
+If step latency is ~flat from batch 8 to 256 while images/s scales ~linearly,
+the step is dispatch/bandwidth-latency bound at small batch and MFU at
+batch 32 is a property of the problem size, not a kernel deficiency; if
+images/s is flat, the step is compute-bound and worth kernel work.
+
+Usage: python mfu_probe.py            (markdown table to stdout, mfu_probe.json)
+       MFU_SMOKE=1 python mfu_probe.py   (CPU shakeout, tiny ladder)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# TPU v5e (lite) peak bf16 throughput, FLOP/s — for the absolute-MFU column.
+PEAK_FLOPS = {"TPU v5 lite": 394e12 / 2, "cpu": 1e11}
+
+
+def _peak(device_kind: str) -> float:
+    for k, v in PEAK_FLOPS.items():
+        if k.lower() in device_kind.lower():
+            return v
+    print(
+        f"WARNING: no peak-FLOPs entry for device kind {device_kind!r}; "
+        "using the CPU placeholder — absolute MFU values are meaningless, "
+        "only the batch-scaling shape is",
+        file=sys.stderr,
+    )
+    return PEAK_FLOPS["cpu"]
+
+
+def main() -> None:
+    smoke = os.environ.get("MFU_SMOKE") == "1"
+    import jax
+
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from hefl_tpu.utils.probe import require_live_backend
+
+        require_live_backend("mfu_probe.py")
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+
+    from hefl_tpu.data.augment import random_augment, rescale
+    from hefl_tpu.fl.config import TrainConfig
+    from hefl_tpu.fl.loss import loss_fn
+    from hefl_tpu.fl.optimizer import adam_init, adam_update
+    from hefl_tpu.models.cnn import MedCNN
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", str(dev))
+    peak = _peak(kind)
+    print(f"device: {kind} (peak bf16 ~{peak / 1e12:.0f} TFLOP/s)", file=sys.stderr)
+
+    module = MedCNN()
+    cfg = TrainConfig()
+    key = jax.random.PRNGKey(0)
+    hw = 256  # 6 pool stages need the full input; smaller collapses to 0
+    params = module.init(key, jnp.zeros((1, hw, hw, 3), jnp.float32))["params"]
+
+    ladder = [2, 4] if smoke else [8, 16, 32, 64, 128, 256]
+    rows = []
+    for bs in ladder:
+        x_u8 = jnp.asarray(
+            np.random.default_rng(0).integers(0, 256, (bs, hw, hw, 3), np.uint8)
+        )
+        y = jnp.asarray(np.random.default_rng(1).integers(0, 2, (bs,), np.int32))
+
+        def step(p, opt, x_u8, y, k):
+            xb = random_augment(
+                k, rescale(x_u8), shear=cfg.aug_shear, zoom=cfg.aug_zoom,
+                flip=cfg.aug_flip,
+            )
+            oh = jax.nn.one_hot(y, cfg.num_classes, dtype=jnp.float32)
+            grads, _ = jax.grad(
+                lambda q: loss_fn(module, q, xb, oh, p, cfg.prox_mu), has_aux=True
+            )(p)
+            return adam_update(grads, opt, p, cfg.lr, cfg.lr_decay, jnp.float32(1.0))
+
+        opt = adam_init(params)
+        # ONE compile per ladder point: AOT-compile the donated jit and use
+        # the compiled object for both cost analysis and the timed loop (a
+        # second donation-free jit would recompile the whole step just to
+        # read its FLOP count).
+        compiled = (
+            jax.jit(step, donate_argnums=(0, 1))
+            .lower(params, opt, x_u8, y, key)
+            .compile()
+        )
+        flops = float(compiled.cost_analysis().get("flops", 0.0))
+        jstep = compiled
+
+        p, o = jax.tree_util.tree_map(jnp.copy, (params, opt))
+        for _ in range(2):  # warmup
+            p, o = jstep(p, o, x_u8, y, key)
+        jax.block_until_ready(p)
+        reps = 1 if smoke else 30
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            p, o = jstep(p, o, x_u8, y, key)
+        jax.block_until_ready(p)
+        dt = (time.perf_counter() - t0) / reps
+        rows.append(
+            {
+                "batch": bs,
+                "step_ms": round(dt * 1e3, 3),
+                "images_per_s": round(bs / dt, 1),
+                "xla_flops": flops,
+                "mfu": round(flops / dt / peak, 4),
+            }
+        )
+        print(f"  batch {bs}: {dt * 1e3:.2f} ms", file=sys.stderr)
+
+    print("| batch | step (ms) | images/s | XLA GFLOP/step | MFU |")
+    print("|---|---|---|---|---|")
+    for r in rows:
+        print(
+            f"| {r['batch']} | {r['step_ms']:.3f} | {r['images_per_s']:.0f} "
+            f"| {r['xla_flops'] / 1e9:.1f} | {r['mfu']:.3f} |"
+        )
+    lat = rows[0]["step_ms"]
+    big = rows[-1]["step_ms"]
+    verdict = (
+        "latency-bound at small batch (step time grows "
+        f"{big / lat:.1f}x over a {rows[-1]['batch'] // rows[0]['batch']}x "
+        "batch ladder)"
+        if big / lat < rows[-1]["batch"] / rows[0]["batch"] / 2
+        else "compute-bound (step time tracks batch size)"
+    )
+    print(f"\nverdict: {verdict}")
+    with open("mfu_probe.json", "w") as f:
+        json.dump({"device": kind, "peak_flops": peak, "rows": rows,
+                   "verdict": verdict}, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

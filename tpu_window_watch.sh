@@ -1,0 +1,38 @@
+#!/bin/bash
+# Watchdog: probe the tunneled TPU every few minutes; whenever it answers,
+# run a (resumable) pass of run_tpu_suite.sh. Stops when every stage marker
+# exists or after MAX_HOURS. Survives tunnel flaps: each pass only measures
+# the stages that still lack evidence (see run_tpu_suite.sh markers).
+#   nohup bash tpu_window_watch.sh > tpu_watch.log 2>&1 &
+cd /root/repo
+MAX_HOURS=${MAX_HOURS:-10}
+deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
+want="stage1.done seed0.done seed1.done seed2.done stage3.done stage4.done stage5.done stage6.done stage7.done"
+
+complete() {
+  for m in $want; do [ -f suite_state/$m ] || return 1; done
+  return 0
+}
+
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  if complete; then
+    echo "$(date -u +%H:%M:%S) all evidence present - watchdog done"
+    exit 0
+  fi
+  # Reuse the framework's hang-proof probe (handles the tunneled plugin
+  # registering as 'axon' while its devices are TPU chips, and bounds the
+  # first-backend-touch hang in a subprocess).
+  if timeout 120 python -c "
+from hefl_tpu.utils.probe import probed_device_count
+import sys
+sys.exit(0 if probed_device_count(timeout_s=90, honor_force_virtual=False) > 0 else 1)
+" 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) tunnel healthy - starting suite pass"
+    bash run_tpu_suite.sh >> tpu_suite.log 2>&1
+    echo "$(date -u +%H:%M:%S) suite pass ended with markers: $(ls suite_state 2>/dev/null | tr '\n' ' ')"
+  else
+    echo "$(date -u +%H:%M:%S) tunnel down"
+  fi
+  sleep 240
+done
+echo "$(date -u +%H:%M:%S) watchdog deadline reached with markers: $(ls suite_state 2>/dev/null | tr '\n' ' ')"
