@@ -25,15 +25,29 @@ import time
 import numpy as np
 
 
-def _time(fn, *args, reps: int = 20, warmup: int = 3) -> float:
-    import jax
+def _time(fn, a, reps: int = 50) -> float:
+    """Per-op device time via a DEVICE-SIDE rep loop.
 
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+    A host-side rep loop measures tunnel dispatch as much as compute on the
+    tunneled platform (first committed table: 0.024 ms at [55,3,4096] vs
+    7.4 ms at the smaller [18,3,4096] — the big shape's dispatches
+    pipelined, the small ones drained per-call). Chaining reps with
+    lax.fori_loop keeps the whole measurement on-device: each iteration
+    feeds its output to the next (mod-p arithmetic is closed, so values
+    stay in range and shapes/dtypes are fixed points of both transforms),
+    so XLA can neither elide nor overlap iterations, and one dispatch
+    amortizes over all reps.
+    """
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def loop(x):
+        return lax.fori_loop(0, reps, lambda i, v: fn(v), x)
+
+    jax.block_until_ready(loop(a))  # compile + warm
     t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    jax.block_until_ready(out)
+    jax.block_until_ready(loop(a))
     return (time.perf_counter() - t0) / reps
 
 
@@ -79,8 +93,6 @@ def main() -> None:
     def xla_inv(a):
         return ntt_mod.ntt_inverse(ctx.ntt, a)
 
-    import os
-
     prev = ntt_mod._BACKEND
     rows = []
     shapes = [(55, 3, 4096), (18, 3, 4096), (2, 3, 4096)]
@@ -103,15 +115,23 @@ def main() -> None:
 
             pl_fwd = jax.jit(lambda v: pallas_ntt.ntt_forward_pallas(nttc, v))
             pl_inv = jax.jit(lambda v: pallas_ntt.ntt_inverse_pallas(nttc, v))
-            t_fp = _time(pl_fwd, a, reps=20 if on_tpu else 1, warmup=3 if on_tpu else 1)
+            pl_reps = 50 if on_tpu else 1  # interpreted-mode pallas is slow
+            t_fp = _time(pl_fwd, a, reps=pl_reps)
             ev_p = pl_fwd(a)
-            t_ip = _time(pl_inv, ev, reps=20 if on_tpu else 1, warmup=3 if on_tpu else 1)
+            t_ip = _time(pl_inv, ev, reps=pl_reps)
 
-            # Bit-exact cross-backend parity (forward and inverse).
-            np.testing.assert_array_equal(np.asarray(ev), np.asarray(ev_p))
-            np.testing.assert_array_equal(
-                np.asarray(inv_x(ev)), np.asarray(pl_inv(ev))
-            )
+            # Bit-exact cross-backend parity (forward and inverse). A
+            # mismatch is a DETERMINISTIC kernel failure, not a tunnel
+            # blip: exit 42 so the suite can mark the gate terminally
+            # failed instead of re-running it every watchdog pass.
+            try:
+                np.testing.assert_array_equal(np.asarray(ev), np.asarray(ev_p))
+                np.testing.assert_array_equal(
+                    np.asarray(inv_x(ev)), np.asarray(pl_inv(ev))
+                )
+            except AssertionError as e:
+                print(f"PARITY MISMATCH at {shape}: {e}", file=sys.stderr)
+                sys.exit(42)
             rows.append(
                 (shape, t_fx * 1e3, t_fp * 1e3, t_fx / t_fp,
                  t_ix * 1e3, t_ip * 1e3, t_ix / t_ip)
@@ -142,6 +162,8 @@ def main() -> None:
              "backend": jax.default_backend(),
              "pallas_mode": "compiled" if on_tpu else "interpreted",
              "parity": "bit-exact fwd+inv at all shapes",
+             "timing_method": "device-side fori_loop rep chain "
+                              "(one dispatch amortized over all reps)",
              "rows": recs},
             f, indent=2,
         )

@@ -104,7 +104,8 @@ def main() -> None:
             .lower(params, opt, x_u8, y, key)
             .compile()
         )
-        flops = float(compiled.cost_analysis().get("flops", 0.0))
+        # cost_analysis() may be None on nonstandard PJRT backends
+        flops = float((compiled.cost_analysis() or {}).get("flops", 0.0))
         jstep = compiled
 
         p, o = jax.tree_util.tree_map(jnp.copy, (params, opt))

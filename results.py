@@ -341,10 +341,15 @@ def main() -> None:
         ]
         data["presets"] = [old[k] for k in order if k in old]
 
-    with open("RESULTS.json", "w") as f:
+    # Atomic replace: a suite `timeout` kill mid-dump must not truncate the
+    # merged evidence file (a half-written RESULTS.json would silently drop
+    # the presets section on the next merge).
+    with open("RESULTS.json.tmp", "w") as f:
         json.dump(data, f, indent=2)
-    with open("RESULTS.md", "w") as f:
+    os.replace("RESULTS.json.tmp", "RESULTS.json")
+    with open("RESULTS.md.tmp", "w") as f:
         f.write(write_markdown(data))
+    os.replace("RESULTS.md.tmp", "RESULTS.md")
     ok = [r for r in data["presets"] + data["convergence"] if "error" not in r]
     print(json.dumps({"measured": len(ok)}))
 

@@ -1,7 +1,7 @@
 #!/bin/bash
 # Serial TPU measurement suite. Run when the axon tunnel is up:
 #   bash run_tpu_suite.sh 2>&1 | tee -a tpu_suite.log
-# Resumable: every stage writes suite_state/stageN.done on success and SKIPS
+# Resumable: every stage writes suite_state/<name>.done on success and SKIPS
 # itself when its marker exists, so the suite can be re-launched after a
 # mid-window tunnel wedge and only the missing evidence is re-measured
 # (rm -rf suite_state to force a full re-measure).
@@ -18,24 +18,78 @@ set -x
 cd /root/repo
 mkdir -p suite_state
 
+# One evidence set, one NTT backend: the mode (default=Pallas vs forced
+# xla) is persisted the first time any stage stamps evidence, and never
+# overwritten — so a re-launched pass cannot mix XLA-NTT and Pallas-NTT
+# numbers, while a transient stage-1 failure that stamps NOTHING leaves
+# the mode undecided for the next pass.
+record_mode() {
+  [ -f suite_state/ntt_mode ] || echo "${HEFL_NTT:-default}" > suite_state/ntt_mode
+}
+
+# run_stage NAME TIMEOUT ARTIFACT ERRLOG CMD...
+#   ARTIFACT "" => the command manages its own output files.
+#   On failure the artifact is restored from git (prior windows' committed
+#   evidence) or removed — a partial file must not pass for evidence.
+run_stage() {
+  local name=$1 tmo=$2 art=$3 err=$4; shift 4
+  if [ -f "suite_state/$name.done" ] || [ -f "suite_state/$name.skip" ]; then
+    echo "$name resolved - skipping"
+    return 0
+  fi
+  local rc=0
+  if [ -n "$art" ]; then
+    timeout "$tmo" "$@" > "$art" 2> "$err" || rc=$?
+  else
+    timeout "$tmo" "$@" 2> "$err" || rc=$?
+  fi
+  if [ "$rc" = 0 ]; then
+    [ -n "$art" ] && cat "$art"
+    record_mode
+    touch "suite_state/$name.done"
+  else
+    echo "$name FAILED (rc=$rc)"
+    tail -5 "$err"
+    [ -n "$art" ] && { git checkout -- "$art" 2>/dev/null || rm -f "$art"; }
+  fi
+  return $rc
+}
+
 echo "=== stage 1: NTT microbenchmark + on-hardware Pallas parity gate"
 # Runs FIRST: it bit-exact-compares the Pallas kernel against the XLA path
-# on real hardware. If the Mosaic-compiled kernel is broken under the
-# tunneled platform, fall back to the XLA NTT for every later stage rather
-# than corrupt the flagship numbers. The decided mode is PERSISTED
-# (suite_state/ntt_mode) so a re-launched pass keeps measuring with the
-# same NTT backend as the stages already stamped .done — one evidence set,
-# one backend.
-if [ -f suite_state/stage1.done ]; then
-  echo "stage 1 done - skipping"
+# on real hardware. If the kernel is broken (exit 42: deterministic parity
+# mismatch, not a tunnel blip), record the failure as the stage-1 evidence,
+# mark the gate terminally resolved, and force the XLA NTT for every later
+# stage rather than corrupt the flagship numbers.
+if [ -f suite_state/stage1.done ] || [ -f suite_state/stage1.skip ]; then
+  echo "stage1 resolved - skipping"
 elif timeout 900 python bench_ntt.py > NTT_TABLE.md 2> ntt_err.log; then
-  cat NTT_TABLE.md && touch suite_state/stage1.done
-  echo default > suite_state/ntt_mode
+  cat NTT_TABLE.md
+  record_mode
+  touch suite_state/stage1.done
 else
-  rm -f NTT_TABLE.md  # a partial table must not pass for evidence
-  echo "NTT bench/parity FAILED or timed out - forcing HEFL_NTT=xla"
+  rc=$?
+  echo "NTT bench/parity FAILED (rc=$rc) - forcing HEFL_NTT=xla"
   tail -5 ntt_err.log
-  echo xla > suite_state/ntt_mode
+  if [ "$rc" = 42 ]; then
+    # The mismatch IS the stage-1 result: the evidence artifact must say
+    # so, not revert to a stale PASSED table.
+    {
+      echo "# NTT on-hardware parity gate — FAILED"
+      echo
+      echo "The Pallas kernel did NOT match the XLA path bit-exactly on"
+      echo "hardware this window; the suite fell back to HEFL_NTT=xla for"
+      echo "all measurements. bench_ntt.py stderr tail:"
+      echo '```'
+      tail -10 ntt_err.log
+      echo '```'
+    } > NTT_TABLE.md
+    touch suite_state/stage1.skip
+  else
+    # Transient (timeout/unreachable): keep the last committed table.
+    git checkout -- NTT_TABLE.md 2>/dev/null || rm -f NTT_TABLE.md
+  fi
+  export HEFL_NTT=xla
 fi
 if [ "$(cat suite_state/ntt_mode 2>/dev/null)" = xla ]; then
   export HEFL_NTT=xla
@@ -43,72 +97,24 @@ fi
 
 echo "=== stage 2: flagship bench seed sweep"
 for s in 0 1 2; do
-  if [ -f suite_state/seed$s.done ]; then
-    echo "seed $s done - skipping"
-    continue
-  fi
-  if timeout 1800 env BENCH_SEED=$s python bench.py > seeds_$s.json 2> seeds_err_$s.log
-  then
-    touch suite_state/seed$s.done
-  else
-    rm -f seeds_$s.json
-    echo "seed $s FAILED or timed out"
-  fi
-  tail -2 seeds_err_$s.log
+  run_stage "seed$s" 1800 "seeds_$s.json" "seeds_err_$s.log" \
+    env BENCH_SEED=$s python bench.py
 done
 
 echo "=== stage 3: phase attribution"
-if [ -f suite_state/stage3.done ]; then
-  echo "stage 3 done - skipping"
-elif timeout 1800 python profile_round.py > PROFILE.md 2> profile_err.log; then
-  cat PROFILE.md && touch suite_state/stage3.done
-else
-  rm -f PROFILE.md
-  echo "profile FAILED or timed out"
-  tail -3 profile_err.log
-fi
+run_stage stage3 1800 PROFILE.md profile_err.log python profile_round.py
 
 echo "=== stage 4: preset table"
-if [ -f suite_state/stage4.done ]; then
-  echo "stage 4 done - skipping"
-elif timeout 2400 python results.py 2> results_err.log; then
-  touch suite_state/stage4.done
-else
-  echo "presets FAILED or timed out"
-  tail -3 results_err.log
-fi
+run_stage stage4 2400 "" results_err.log python results.py
 
 echo "=== stage 5: convergence curves"
-if [ -f suite_state/stage5.done ]; then
-  echo "stage 5 done - skipping"
-elif timeout 3600 python results.py --convergence 2> conv_err.log; then
-  touch suite_state/stage5.done
-else
-  echo "convergence FAILED or timed out"
-  tail -3 conv_err.log
-fi
+run_stage stage5 3600 "" conv_err.log python results.py --convergence
 
 echo "=== stage 6: private-inference serving bench"
-if [ -f suite_state/stage6.done ]; then
-  echo "stage 6 done - skipping"
-elif timeout 900 python bench_inference.py > INFERENCE_TABLE.md 2> inference_err.log
-then
-  cat INFERENCE_TABLE.md && touch suite_state/stage6.done
-else
-  rm -f INFERENCE_TABLE.md
-  echo "inference bench FAILED or timed out"
-  tail -3 inference_err.log
-fi
+run_stage stage6 900 INFERENCE_TABLE.md inference_err.log python bench_inference.py
 
 echo "=== stage 7: train-step MFU probe (batch-scaling diagnosis)"
-if [ -f suite_state/stage7.done ]; then
-  echo "stage 7 done - skipping"
-elif timeout 900 python mfu_probe.py > MFU_TABLE.md 2> mfu_err.log; then
-  cat MFU_TABLE.md && touch suite_state/stage7.done
-else
-  rm -f mfu_probe.json MFU_TABLE.md
-  echo "mfu probe FAILED or timed out"
-  tail -3 mfu_err.log
-fi
+run_stage stage7 900 MFU_TABLE.md mfu_err.log python mfu_probe.py \
+  || rm -f mfu_probe.json
 
 echo "=== suite pass complete: $(ls suite_state)"
