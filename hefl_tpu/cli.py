@@ -10,9 +10,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 
 from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
-from hefl_tpu.fl import TrainConfig
+from hefl_tpu.fl import DpConfig, TrainConfig
 from hefl_tpu.models import MODEL_REGISTRY
 
 
@@ -68,6 +69,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the first round to DIR")
     p.add_argument("--json", action="store_true", help="emit history as JSON lines")
+    p.add_argument("--dp-noise", type=float, default=0.0, metavar="SIGMA",
+                   help="DP-FedAvg central noise multiplier (0 = off): clip "
+                        "client deltas and add distributed Gaussian noise "
+                        "inside the encrypted round (fl/dp.py); per-round "
+                        "epsilon is reported in the history")
+    p.add_argument("--dp-clip", type=float, default=1.0, metavar="C",
+                   help="DP-FedAvg L2 clip bound on a client's model delta")
+    p.add_argument("--dp-delta", type=float, default=1e-5,
+                   help="target delta for the (epsilon, delta) accountant")
     return p
 
 
@@ -104,10 +114,28 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         profile_dir=args.profile,
         save_model_path=args.save_model,
         centralized=args.centralized,
+        dp=(
+            DpConfig(
+                clip_norm=args.dp_clip,
+                noise_multiplier=args.dp_noise,
+                delta=args.dp_delta,
+            )
+            if args.dp_noise > 0
+            else None
+        ),
     )
 
 
 def main(argv: list[str] | None = None) -> int:
+    # Persistent XLA compilation cache (same default as bench.py/results.py):
+    # the flagship round program costs ~40 s to compile; repeated CLI runs
+    # must not re-pay it. HEFL_COMPILE_CACHE= (empty) disables.
+    cache_dir = os.environ.get("HEFL_COMPILE_CACHE", ".jax_cache")
+    if cache_dir:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     args = build_parser().parse_args(argv)
     if args.preset is not None:
         from hefl_tpu.presets import PRESETS

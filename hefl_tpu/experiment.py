@@ -29,8 +29,10 @@ from hefl_tpu.data import (
     stack_federated,
 )
 from hefl_tpu.fl import (
+    DpConfig,
     TrainConfig,
     decrypt_average,
+    epsilon_spent,
     evaluate,
     fedavg_round,
     secure_fedavg_round,
@@ -91,6 +93,10 @@ class ExperimentConfig:
     # (FLPyfhelin.py:161-177) on the whole training set instead of the FL
     # loop — measures what federation costs in accuracy.
     centralized: bool = False
+    # DP-FedAvg (beyond parity, fl/dp.py): clip client deltas and add
+    # distributed Gaussian noise INSIDE the encrypted round program. None
+    # keeps the reference's HE-only behavior.
+    dp: "DpConfig | None" = None
 
 
 def _partition(cfg: ExperimentConfig, y: np.ndarray) -> list[np.ndarray]:
@@ -111,6 +117,15 @@ def run_experiment(
     DataFrames as one record per round.
     """
     say = print if verbose else (lambda *_: None)
+    if cfg.dp is not None and (not cfg.encrypted or cfg.centralized):
+        # Silently dropping a requested privacy mechanism would be the
+        # worst possible failure mode: the user believes the release is DP
+        # and it is not. The sanitizer lives inside the encrypted round
+        # program (fl/secure.py), so that is the only path that honors it.
+        raise ValueError(
+            "dp is only applied on the encrypted federated path; remove "
+            "--plaintext/--centralized or drop the dp config"
+        )
     train_cfg = cfg.train
     if cfg.data_dir is not None:
         # The reference's primary workflow: point the tool at a folder of
@@ -202,7 +217,8 @@ def run_experiment(
         if cfg.encrypted:
             with timer.phase("train+encrypt+aggregate"):
                 ct_sum, metrics, overflow = secure_fedavg_round(
-                    module, train_cfg, mesh, ctx, pk, params, xs_d, ys_d, k_round
+                    module, train_cfg, mesh, ctx, pk, params, xs_d, ys_d,
+                    k_round, dp=cfg.dp,
                 )
                 jax.block_until_ready((ct_sum.c0, ct_sum.c1, metrics))
             with timer.phase("decrypt"):
@@ -224,6 +240,15 @@ def run_experiment(
             say(f"profiler trace written to {cfg.profile_dir}")
         record = {
             "round": r,
+            **(
+                {
+                    "dp_epsilon": epsilon_spent(
+                        r + 1, cfg.dp.noise_multiplier, cfg.dp.delta
+                    )
+                }
+                if cfg.dp is not None and cfg.encrypted
+                else {}
+            ),
             "phases": timer.summary(),
             "val_loss": np.asarray(metrics)[:, -1, 0].tolist(),
             "val_acc": np.asarray(metrics)[:, -1, 1].tolist(),

@@ -1,0 +1,130 @@
+"""Differentially-private federated averaging under secure aggregation.
+
+The reference pipeline (FLPyfhelin.py:200-228,366-389) protects client
+updates from the *server* with HE, but the decrypted average itself can
+still leak training data (membership inference on the released model).
+This module adds the standard complement — DP-FedAvg in the
+distributed-noise-under-secure-aggregation arrangement:
+
+  1. each client computes its model delta vs the round's global weights,
+  2. clips the delta to L2 norm `clip_norm` (bounds one client's
+     influence on the aggregate: the mechanism's sensitivity),
+  3. adds Gaussian noise N(0, (noise_multiplier * clip_norm / sqrt(K))^2)
+     per coordinate BEFORE encryption,
+  4. the K per-client noise shares sum (under the encrypted aggregation)
+     to exactly the central Gaussian mechanism's
+     N(0, (noise_multiplier * clip_norm)^2) on the SUM of clipped deltas.
+
+Because the server only ever sees the encrypted sum (fl/secure.py), no
+party observes any client's update with less than its local noise share,
+and the released decrypted average carries the full central-DP guarantee.
+(The usual caveat applies and is stated here rather than hidden: the
+central guarantee computed by `epsilon_spent` assumes all K clients add
+their share honestly; against a coalition of K-1 colluders the honest
+client retains only its local share's protection.)
+
+Everything is a pure jax transform on pytrees — it vmaps across the
+client axis and runs inside the shard_mapped round program on the client
+mesh (dp noise costs one fused elementwise pass over 222,722 weights,
+invisible next to training).
+
+Accounting: rounds compose. Full participation each round means the
+release is a composition of `rounds` Gaussian mechanisms, accounted in
+Renyi-DP: RDP(alpha) = rounds * alpha / (2 * noise_multiplier^2),
+converted to (epsilon, delta) by the standard bound
+epsilon = min_alpha [ RDP(alpha) + log(1/delta) / (alpha - 1) ].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DpConfig:
+    """Frozen (hashable) so it can key the round program's compile cache.
+
+    clip_norm:        L2 bound C on one client's model delta.
+    noise_multiplier: sigma of the CENTRAL mechanism in units of C
+                      (per-client share is sigma*C/sqrt(K)).
+    delta:            target delta for `epsilon_spent`.
+    """
+
+    clip_norm: float = 1.0
+    noise_multiplier: float = 1.0
+    delta: float = 1e-5
+
+
+def global_l2_norm(tree) -> jax.Array:
+    """L2 norm over every leaf of a pytree, as one scalar."""
+    sq = sum(
+        jnp.sum(jnp.square(x.astype(jnp.float32)))
+        for x in jax.tree_util.tree_leaves(tree)
+    )
+    return jnp.sqrt(sq)
+
+
+def clip_by_global_norm(tree, clip_norm: float):
+    """Scale the whole pytree by min(1, clip_norm/||tree||) (never amplifies)."""
+    norm = global_l2_norm(tree)
+    factor = jnp.minimum(1.0, clip_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree_util.tree_map(lambda x: x * factor, tree), norm
+
+
+def dp_sanitize(
+    key: jax.Array,
+    global_params,
+    trained_params,
+    dp: DpConfig,
+    num_clients: int,
+):
+    """One client's DP step: clip its delta, add its distributed noise share.
+
+    Returns (sanitized_params, pre_clip_norm). sanitized = global +
+    clip(delta) + N(0, (sigma*C/sqrt(K))^2) per coordinate — the value the
+    client then encrypts (fl/secure.py). The K noise shares sum to
+    N(0, (sigma*C)^2) on the aggregate: the central Gaussian mechanism
+    with sensitivity C and multiplier sigma, which is exactly what
+    `epsilon_spent` accounts.
+    """
+    delta = jax.tree_util.tree_map(
+        lambda t, g: t - g, trained_params, global_params
+    )
+    clipped, norm = clip_by_global_norm(delta, dp.clip_norm)
+    share = dp.noise_multiplier * dp.clip_norm / math.sqrt(num_clients)
+    leaves, treedef = jax.tree_util.tree_flatten(clipped)
+    keys = jax.random.split(key, len(leaves))
+    noised = [
+        x + share * jax.random.normal(k, x.shape, jnp.float32)
+        for x, k in zip(leaves, keys)
+    ]
+    sane = jax.tree_util.tree_unflatten(treedef, noised)
+    out = jax.tree_util.tree_map(lambda g, d: g + d, global_params, sane)
+    return out, norm
+
+
+def epsilon_spent(
+    rounds: int, noise_multiplier: float, delta: float = 1e-5
+) -> float:
+    """(epsilon, delta)-DP spent after `rounds` full-participation rounds.
+
+    Renyi accounting of the composed Gaussian mechanism (no subsampling:
+    every client participates every round, like the reference's FL loop),
+    optimized over an alpha grid. Monotone in rounds, decreasing in sigma.
+    """
+    if noise_multiplier <= 0:
+        return float("inf")
+    if rounds <= 0:
+        return 0.0
+    best = float("inf")
+    # Dense low alphas (optimum for small sigma) + sparse high tail.
+    alphas = [1.0 + x / 10.0 for x in range(1, 400)] + list(range(41, 512))
+    for a in alphas:
+        rdp = rounds * a / (2.0 * noise_multiplier**2)
+        eps = rdp + math.log(1.0 / delta) / (a - 1.0)
+        best = min(best, eps)
+    return best

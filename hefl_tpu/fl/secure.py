@@ -136,6 +136,7 @@ def secure_fedavg_round(
     ys: jax.Array,
     key: jax.Array,
     with_plain_reference: bool = False,
+    dp=None,
 ) -> tuple:
     """One encrypted FedAvg round: local training + encrypt + psum, jitted.
 
@@ -167,32 +168,57 @@ def secure_fedavg_round(
     n_dev = client_mesh_size(mesh)
     if num_clients % n_dev != 0:
         raise ValueError(f"{num_clients} clients on {n_dev} devices: must divide")
-    k_train, k_enc = jax.random.split(key)
+    # dp=None keeps the historical 2-way split so existing seeds reproduce.
+    if dp is None:
+        k_train, k_enc = jax.random.split(key)
+    else:
+        k_train, k_enc, k_dp = jax.random.split(key, 3)
     train_keys = jax.random.split(k_train, num_clients)
     enc_keys = jax.random.split(k_enc, num_clients)
     # Canonicalize the replicated-global-params sharding so round 1 (params
     # now a decrypt_average output) reuses round 0's executable — see
     # fedavg.replicate_on.
     gp = replicate_on(mesh, global_params)
-    return _build_secure_round_fn(module, cfg, mesh, ctx, with_plain_reference)(
-        gp, pk, xs, ys, train_keys, enc_keys
+    if dp is None:
+        # Keep the historical 5-arg cache key: dp-off rounds of any client
+        # count share one compiled program per configuration.
+        fn = _build_secure_round_fn(module, cfg, mesh, ctx, with_plain_reference)
+        return fn(gp, pk, xs, ys, train_keys, enc_keys)
+    fn = _build_secure_round_fn(
+        module, cfg, mesh, ctx, with_plain_reference, dp, num_clients
     )
+    dp_keys = jax.random.split(k_dp, num_clients)
+    return fn(gp, pk, xs, ys, train_keys, enc_keys, dp_keys)
 
 
 @functools.lru_cache(maxsize=32)
 def _build_secure_round_fn(
     module, cfg: TrainConfig, mesh, ctx: CkksContext,
     with_plain_reference: bool = False,
+    dp=None,
+    num_clients: int = 0,
 ):
     """Compile-once factory for the encrypted round program (same rationale
     as fedavg._build_round_fn: one trace/compile per configuration, reused
     across all rounds). `pk` is a traced, mesh-replicated argument so key
-    rotation does not retrigger compilation."""
+    rotation does not retrigger compilation.
+
+    `dp` (a frozen fl.dp.DpConfig, hashable, part of the cache key) turns
+    on per-client clip-and-noise between training and encryption: the
+    DP-FedAvg sanitizer runs inside this same SPMD program, so the
+    plaintext clipped-but-unnoised update never leaves the device either.
+    """
 
     axes = client_axes(mesh)   # ("clients",) or ("hosts", "clients")
 
-    def body(gp, pk, x_blk, y_blk, kt_blk, ke_blk):
+    def body(gp, pk, x_blk, y_blk, kt_blk, ke_blk, kd_blk=None):
         p_out, mets = vmapped_train(module, cfg, gp, x_blk, y_blk, kt_blk)
+        if dp is not None:
+            from hefl_tpu.fl.dp import dp_sanitize
+
+            p_out, _ = jax.vmap(
+                lambda k, t: dp_sanitize(k, gp, t, dp, num_clients)
+            )(kd_blk, p_out)
         # Saturation diagnostic on exactly what gets encoded (the packed
         # blocks); XLA CSEs the duplicate pack with encrypt_params' own.
         ov_one = lambda prm: encoding.encode_overflow_count(  # noqa: E731
@@ -227,10 +253,13 @@ def _build_secure_round_fn(
     out_specs = (P(), P(axes), P(axes))
     if with_plain_reference:
         out_specs = out_specs + (P(),)
+    in_specs = (P(), P(), P(axes), P(axes), P(axes), P(axes))
+    if dp is not None:
+        in_specs = in_specs + (P(axes),)   # per-client dp noise keys
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(), P(), P(axes), P(axes), P(axes), P(axes)),
+        in_specs=in_specs,
         out_specs=out_specs,
         check_vma=False,
     )

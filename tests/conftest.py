@@ -71,6 +71,8 @@ _SLOW_PATTERNS = (
     "test_models.py::test_resnet20",
     "test_utils.py::test_galois_key_roundtrip",
     "test_entry.py::test_entry_compiles",
+    "test_dp.py::test_secure_dp_round",
+    "test_experiment.py::test_cli_dp_experiment",
 )
 
 

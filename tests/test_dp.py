@@ -1,0 +1,147 @@
+"""DP-FedAvg tests: clipping, distributed noise calibration, the Renyi
+accountant, and the sanitized encrypted round against its own in-program
+plaintext reference.
+
+The reference pipeline has no DP (FLPyfhelin.py releases the decrypted
+average as-is); fl/dp.py is a beyond-parity subsystem, so these tests pin
+its *mathematical* contract rather than reference behavior.
+"""
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.fl import DpConfig, clip_by_global_norm, dp_sanitize, epsilon_spent
+from hefl_tpu.fl.dp import global_l2_norm
+
+
+def _tree(key, scale):
+    k1, k2 = jax.random.split(key)
+    return {
+        "a": jax.random.normal(k1, (64, 8)) * scale,
+        "b": {"w": jax.random.normal(k2, (128,)) * scale},
+    }
+
+
+def test_clip_reduces_to_bound_preserving_direction():
+    t = _tree(jax.random.key(0), scale=3.0)
+    clipped, norm = clip_by_global_norm(t, 1.0)
+    assert float(norm) > 1.0
+    np.testing.assert_allclose(float(global_l2_norm(clipped)), 1.0, rtol=1e-5)
+    # direction preserved: every leaf scaled by the same factor
+    f = np.asarray(clipped["a"]) / np.asarray(t["a"])
+    np.testing.assert_allclose(f, f.ravel()[0], rtol=1e-5)
+
+
+def test_clip_is_noop_under_bound():
+    t = _tree(jax.random.key(1), scale=1e-3)
+    clipped, norm = clip_by_global_norm(t, 1.0)
+    assert float(norm) < 1.0
+    for a, b in zip(jax.tree_util.tree_leaves(clipped), jax.tree_util.tree_leaves(t)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_sanitize_noise_is_calibrated_to_share():
+    # trained == global -> delta 0 -> the output minus global is EXACTLY the
+    # client's noise share N(0, (sigma*C/sqrt(K))^2) per coordinate.
+    g = _tree(jax.random.key(2), scale=0.5)
+    dp = DpConfig(clip_norm=2.0, noise_multiplier=1.5)
+    K = 16
+    keys = jax.random.split(jax.random.key(3), 64)
+    samples = []
+    for k in keys:
+        out, norm = dp_sanitize(k, g, g, dp, K)
+        assert float(norm) < 1e-6
+        samples.append(
+            np.concatenate(
+                [
+                    (np.asarray(a) - np.asarray(b)).ravel()
+                    for a, b in zip(
+                        jax.tree_util.tree_leaves(out),
+                        jax.tree_util.tree_leaves(g),
+                    )
+                ]
+            )
+        )
+    flat = np.concatenate(samples)          # 64 draws x 640 coords
+    want = dp.noise_multiplier * dp.clip_norm / math.sqrt(K)
+    np.testing.assert_allclose(flat.std(), want, rtol=0.02)
+    np.testing.assert_allclose(flat.mean(), 0.0, atol=want * 0.02)
+
+
+def test_sanitize_bounds_influence():
+    # A pathological client (huge delta) moves the aggregate by at most
+    # clip_norm + noise — the sensitivity bound DP needs.
+    g = _tree(jax.random.key(4), scale=0.1)
+    attacker = jax.tree_util.tree_map(lambda x: x + 100.0, g)
+    dp = DpConfig(clip_norm=0.5, noise_multiplier=0.0)  # noise off: pure clip
+    out, norm = dp_sanitize(jax.random.key(5), g, attacker, dp, 4)
+    assert float(norm) > 100.0
+    moved = global_l2_norm(
+        jax.tree_util.tree_map(lambda a, b: a - b, out, g)
+    )
+    np.testing.assert_allclose(float(moved), 0.5, rtol=1e-4)
+
+
+def test_epsilon_accountant_contract():
+    # Single Gaussian mechanism at sigma=1, delta=1e-5: the optimized RDP
+    # bound lands near 5.3 (alpha* ~ 5.8); pin the band, not the digit.
+    e1 = epsilon_spent(1, 1.0, 1e-5)
+    assert 4.0 < e1 < 6.0
+    # composition grows, more noise shrinks, edge cases
+    assert epsilon_spent(8, 1.0) > e1
+    assert epsilon_spent(1, 4.0) < e1
+    assert epsilon_spent(0, 1.0) == 0.0
+    assert math.isinf(epsilon_spent(5, 0.0))
+    # sublinear growth in rounds (RDP composes in alpha, not epsilon)
+    assert epsilon_spent(16, 1.0) < 16 * e1
+
+
+def test_secure_dp_round_matches_its_plain_reference():
+    # Full SPMD program on the CPU mesh with DP on: train + clip + noise +
+    # encrypt + psum + owner decrypt must equal the IN-PROGRAM plaintext
+    # mean of the same sanitized weights (with_plain_reference), proving
+    # the HE path is transparent to the DP mechanism.
+    from hefl_tpu.ckks.keys import CkksContext, keygen
+    from hefl_tpu.ckks.packing import PackSpec
+    from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+    from hefl_tpu.fl import TrainConfig, decrypt_average, secure_fedavg_round
+    from hefl_tpu.models import SmallCNN
+    from hefl_tpu.parallel import make_mesh
+
+    num_clients = 4
+    (x, y), _, _ = make_dataset("mnist", seed=0, n_train=num_clients * 24, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    cfg = TrainConfig(epochs=1, batch_size=8, num_classes=10, augment=False,
+                      val_fraction=0.25)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create()
+    sk, pk = keygen(ctx, jax.random.key(99))
+    spec = PackSpec.for_params(params, ctx.n)
+    dp = DpConfig(clip_norm=0.5, noise_multiplier=0.2)
+
+    ct_sum, metrics, overflow, plain_ref = secure_fedavg_round(
+        model, cfg, mesh, ctx, pk, params, jnp.asarray(xs), jnp.asarray(ys),
+        jax.random.key(5), with_plain_reference=True, dp=dp,
+    )
+    assert int(np.sum(np.asarray(overflow))) == 0
+    enc_avg = decrypt_average(ctx, sk, ct_sum, num_clients, spec)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(enc_avg), jax.tree_util.tree_leaves(plain_ref)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+    # and the DP aggregate's step away from init respects its two bounded
+    # parts: |mean(clipped deltas)| <= C, plus the mean noise whose global
+    # L2 concentrates at (sigma*C/K)*sqrt(d) over d coordinates
+    from hefl_tpu.fl.dp import global_l2_norm as gn
+    from hefl_tpu.models import count_params
+
+    d = count_params(params)
+    noise_l2 = dp.noise_multiplier * dp.clip_norm / num_clients * math.sqrt(d)
+    step = gn(jax.tree_util.tree_map(lambda a, b: a - b, enc_avg, params))
+    assert float(step) < dp.clip_norm + 1.3 * noise_l2

@@ -218,3 +218,25 @@ def test_centralized_baseline(tmp_path):
     import os
 
     assert os.path.exists(path)
+
+
+def test_cli_dp_experiment_reports_epsilon(capsys):
+    # DP-FedAvg end-to-end through the CLI: the encrypted round runs the
+    # clip+noise sanitizer and the history carries the accountant's epsilon.
+    from hefl_tpu.cli import main
+
+    rc = main(
+        [
+            "--model", "smallcnn", "--dataset", "mnist", "--num-clients", "2",
+            "--rounds", "2", "--epochs", "1", "--batch-size", "8",
+            "--n-train", "64", "--n-test", "32", "--he-n", "256",
+            "--no-augment", "--json", "--no-save-model",
+            "--dp-noise", "2.0", "--dp-clip", "0.8",
+        ]
+    )
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.strip().splitlines() if l.startswith("{")]
+    recs = [json.loads(l) for l in lines]
+    eps = [r["dp_epsilon"] for r in recs if "dp_epsilon" in r]
+    assert len(eps) == 2
+    assert 0 < eps[0] < eps[1]  # composition: privacy spend grows per round
