@@ -224,6 +224,33 @@ def main() -> None:
             + (f" | ENCODE OVERFLOW: {ov} weights clipped" if ov else ""))
         last_ct_sum, last_start, last_key = ct_sum, cur, k_round
         cur = new_params
+        # Rolling partial artifact (atomic): a timeout/wedge after round r
+        # must not cost the whole run's evidence — the r4 TPU window lost a
+        # 30-minute seed to exactly that. The suite rescues this file when
+        # a seed stage dies.
+        partial = {
+            "partial": True,
+            "seed": seed,
+            "device": getattr(dev, "device_kind", str(dev)),
+            "rounds_completed": r + 1,
+            "rounds_planned": rounds,
+            "accuracy_by_round": [h["accuracy"] for h in history],
+            "f1_by_round": [h["f1"] for h in history],
+            "round_stats": round_stats,
+            "encode_overflow_count": overflow_total,
+            **({"smoke": True} if smoke else {}),
+            **({"platform_pinned": platform} if platform else {}),
+        }
+        # Namespaced by platform pin: a CPU-pinned evidence run and the TPU
+        # suite can run the same seed concurrently on this box — they must
+        # not clobber each other's rescue file.
+        ptag = "smoke" if smoke else (platform or "hw")
+        with open(f"bench_partial_{ptag}_{seed}.json.tmp", "w") as f:
+            json.dump(partial, f)
+        os.replace(
+            f"bench_partial_{ptag}_{seed}.json.tmp",
+            f"bench_partial_{ptag}_{seed}.json",
+        )
 
     # --- cell-6 comparison artifact ---------------------------------------
     # BENCH_SKIP_CELL6=1 skips the whole diagnostic tail (3 extra
@@ -343,6 +370,7 @@ def main() -> None:
                 and round(1.0 / steady_round_s, 4),
                 "train_mfu": mfu and round(mfu, 4),
                 "device": getattr(dev, "device_kind", str(dev)),
+                "seed": seed,
                 # `accuracy` pairs with `value`: both are the round-0
                 # pipeline (the reference-equivalent single pass). Later
                 # rounds' accuracies are in accuracy_by_round.

@@ -173,6 +173,28 @@ def load_seed_runs() -> list[dict]:
     ]
 
 
+def load_partial_runs(complete_runs: list[dict] | None = None) -> list[dict]:
+    """Rolling per-round artifacts (bench_partial_<platform>_<seed>.json)
+    from bench runs that died mid-measurement (tunnel wedge / stage
+    timeout). Only surfaced for (seed, platform-pin) pairs with no COMPLETE
+    artifact — a partial must never shadow a finished run, but a finished
+    CPU-pinned run must not hide a rescued TPU partial of the same seed
+    (they key on different platform pins)."""
+    if complete_runs is None:
+        complete_runs = load_seed_runs() + load_pinned_runs()
+    complete = {
+        (r.get("seed"), r.get("platform_pinned"))
+        for r in complete_runs
+        if r.get("seed") is not None
+    }
+    return [
+        r
+        for r in _load_bench_records("bench_partial_*.json")
+        if not r.get("smoke")
+        and (r.get("seed"), r.get("platform_pinned")) not in complete
+    ]
+
+
 def load_pinned_runs() -> list[dict]:
     """BENCH_PLATFORM accuracy-evidence runs (acc_cpu_seed<N>.json plus any
     platform_pinned seeds_*.json).
@@ -306,6 +328,28 @@ def write_markdown(data: dict) -> str:
                 f"{s.get('accuracy')} | "
                 f"{s.get('acc_vs_reference', 'n/a')} | "
                 f"{f'{diff:.2e}' if diff is not None else 'skipped'} | "
+                f"{s.get('encode_overflow_count', 'n/a')} |"
+            )
+    partials = load_partial_runs(complete_runs=seeds + pinned)
+    if partials:
+        lines += [
+            "",
+            "## Partial runs — rescued per-round evidence",
+            "",
+            "Benches that died mid-measurement (tunnel wedge / stage "
+            "timeout); `bench.py` checkpoints per-round results so the "
+            "completed rounds survive. A partial is listed only when the "
+            "seed has no complete artifact.",
+            "",
+            "| run | device | rounds done/planned | accuracy by round | "
+            "encode overflow |",
+            "|---|---|---|---|---|",
+        ]
+        for s in partials:
+            lines.append(
+                f"| {s['_seed_file']} | {s.get('device')} | "
+                f"{s.get('rounds_completed')}/{s.get('rounds_planned')} | "
+                f"{s.get('accuracy_by_round')} | "
                 f"{s.get('encode_overflow_count', 'n/a')} |"
             )
     if conv:
