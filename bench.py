@@ -408,6 +408,13 @@ def main() -> None:
             }
         )
     )
+    # The run completed and printed its full JSON: the rolling partial is
+    # superseded — leaving it behind would let a later rename/removal of
+    # the complete artifact resurrect it as bogus "rescued" evidence.
+    try:
+        os.remove(f"bench_partial_{ptag}_{seed}.json")
+    except OSError:
+        pass
 
 
 if __name__ == "__main__":

@@ -128,13 +128,37 @@ def convergence_configs() -> dict:
             "16-client encrypted ResNet-20 CIFAR-10, 10 rounds",
             dataclasses.replace(PRESETS["cifar-resnet16"], rounds=10),
         ),
+        # CPU-tractable curve: minutes per round on the 1-core driver box,
+        # so multi-round convergence evidence exists even when the TPU
+        # tunnel is down for a whole window (the flagship curves above are
+        # hardware-scale).
+        "mnist-enc-10r": (
+            "4-client encrypted SmallCNN MNIST, 10 rounds",
+            ExperimentConfig(
+                model="smallcnn", dataset="mnist", num_clients=4, rounds=10,
+                encrypted=True, n_train=512, n_test=256,
+                train=TrainConfig(epochs=2, batch_size=16, num_classes=10),
+                he=HEConfig(), seed=0,
+            ),
+        ),
     }
 
 
-def run_convergence() -> list[dict]:
+def run_convergence(names: list[str] | None = None) -> list[dict]:
+    # Validate names BEFORE touching any backend: a typo must report the
+    # available configs, not a tunnel probe failure.
+    configs = convergence_configs()
+    unknown = [n for n in (names or []) if n not in configs]
+    if unknown:
+        raise SystemExit(
+            f"unknown convergence config(s) {unknown}; "
+            f"available: {sorted(configs)}"
+        )
     _jax_setup()
     records = []
-    for name, (label, cfg) in convergence_configs().items():
+    for name, (label, cfg) in configs.items():
+        if names and name not in names:
+            continue
         try:
             records.append(_measure(name, label, cfg))
         except Exception as e:
@@ -361,13 +385,14 @@ def write_markdown(data: dict) -> str:
             "§2.11); the rebuild's round loop must show accuracy climbing "
             "across rounds where the task has headroom.",
             "",
-            "| config | rounds | accuracy by round | final acc | F1 | "
-            "steady round (s) |",
-            "|---|---|---|---|---|---|",
+            "| config | device | rounds | accuracy by round | final acc "
+            "| F1 | steady round (s) |",
+            "|---|---|---|---|---|---|---|",
         ]
         for r in conv:
             lines.append(
-                f"| {r['label']} | {r['rounds']} | {r['accuracy_by_round']} "
+                f"| {r['label']} | {r.get('device', '?')} | {r['rounds']} "
+                f"| {r['accuracy_by_round']} "
                 f"| {r['accuracy']} | {r['f1']} | {r['warm_round_s']} |"
             )
     if os.path.exists("ntt_bench.json"):
@@ -420,7 +445,18 @@ def main() -> None:
     if render_only:
         pass  # re-render from on-disk artifacts; no measurement, no backend
     elif convergence:
-        data["convergence"] = run_convergence()
+        # Merge like presets: a selective re-measure replaces same-name
+        # rows and keeps the rest; a failure never clobbers a good row.
+        new = run_convergence(names or None)
+        old = {r.get("preset"): r for r in data.get("convergence", [])}
+        for r in new:
+            prev = old.get(r.get("preset"))
+            if "error" in r and prev is not None and "error" not in prev:
+                print(f"{r['preset']}: keeping previous good record",
+                      file=sys.stderr)
+                continue
+            old[r.get("preset")] = r
+        data["convergence"] = list(old.values())
     else:
         from hefl_tpu.presets import PRESETS
 

@@ -97,17 +97,24 @@ fi
 
 echo "=== stage 2: flagship bench seed sweep"
 for s in 0 1 2; do
-  # A stale partial from a previous window must not pass for this run's
-  # rescued evidence (the bench only writes it after its first round).
-  [ -f "suite_state/seed$s.done" ] || rm -f "bench_partial_hw_$s.json"
+  # A stale partial from a previous pass must not pass for THIS run's
+  # rescued evidence — but it must not be destroyed either until the new
+  # attempt produces something (a keygen wedge writes no partial at all):
+  # move it aside, restore it if the retry yields nothing better.
+  part="bench_partial_hw_$s.json"
+  [ -f "suite_state/seed$s.done" ] || { [ -f "$part" ] && mv "$part" "$part.prev"; }
   if run_stage "seed$s" 1800 "seeds_$s.json" "seeds_err_$s.log" \
     env BENCH_SEED=$s python bench.py
-  then :
-  elif [ -f "bench_partial_hw_$s.json" ]; then
-    # bench.py writes a rolling per-round artifact; a wedge mid-run keeps
-    # the completed rounds' evidence (results.py renders partials).
+  then
+    rm -f "$part.prev"   # complete artifact supersedes any old partial
+  elif [ -f "$part" ]; then
+    rm -f "$part.prev"
     echo "seed $s: rescued partial evidence:"
-    cat "bench_partial_hw_$s.json"
+    cat "$part"
+  elif [ -f "$part.prev" ]; then
+    mv "$part.prev" "$part"
+    echo "seed $s: retry produced nothing; keeping previous pass's partial:"
+    cat "$part"
   fi
 done
 
