@@ -133,7 +133,8 @@ def convergence_configs() -> dict:
         # tunnel is down for a whole window (the flagship curves above are
         # hardware-scale).
         "mnist-enc-10r": (
-            "4-client encrypted SmallCNN MNIST, 10 rounds",
+            "4-client encrypted SmallCNN MNIST (reduced recipe: 2 epochs, "
+            "batch 16, 512 samples), 10 rounds",
             ExperimentConfig(
                 model="smallcnn", dataset="mnist", num_clients=4, rounds=10,
                 encrypted=True, n_train=512, n_test=256,
@@ -234,6 +235,21 @@ def load_pinned_runs() -> list[dict]:
     ]
 
 
+def _merge_records(old_list: list[dict], new_list: list[dict]) -> list[dict]:
+    """Merge measurement records by preset name: re-measured rows replace
+    same-name rows, others are kept, and a failed re-measure never clobbers
+    a previously good row."""
+    old = {r.get("preset"): r for r in old_list}
+    for r in new_list:
+        prev = old.get(r.get("preset"))
+        if "error" in r and prev is not None and "error" not in prev:
+            print(f"{r['preset']}: keeping previous good record",
+                  file=sys.stderr)
+            continue
+        old[r.get("preset")] = r
+    return list(old.values())
+
+
 def load_results() -> dict:
     if not os.path.exists("RESULTS.json"):
         return {"presets": [], "convergence": []}
@@ -269,9 +285,10 @@ def write_markdown(data: dict) -> str:
         "`__graft_entry__.dryrun_multichip`).",
         "",
         "Reference's only measured config (2-client medical, CPU): "
-        "6583.6 s total, acc 0.8425 (BASELINE.md). All rows below use the "
-        "reference's local-training recipe: 10 local epochs, batch 32, "
-        "Adam(1e-3, decay 1e-4), EarlyStopping/ReduceLROnPlateau. The "
+        "6583.6 s total, acc 0.8425 (BASELINE.md). Rows use the "
+        "reference's local-training recipe — 10 local epochs, batch 32, "
+        "Adam(1e-3, decay 1e-4), EarlyStopping/ReduceLROnPlateau — except "
+        "rows whose label states its own reduced recipe. The "
         "synthetic medical task is difficulty-tuned so accuracy has real "
         "headroom (hefl_tpu/data/synthetic.py); encode_overflow counts "
         "CKKS encoder saturation events (must be 0).",
@@ -445,18 +462,9 @@ def main() -> None:
     if render_only:
         pass  # re-render from on-disk artifacts; no measurement, no backend
     elif convergence:
-        # Merge like presets: a selective re-measure replaces same-name
-        # rows and keeps the rest; a failure never clobbers a good row.
-        new = run_convergence(names or None)
-        old = {r.get("preset"): r for r in data.get("convergence", [])}
-        for r in new:
-            prev = old.get(r.get("preset"))
-            if "error" in r and prev is not None and "error" not in prev:
-                print(f"{r['preset']}: keeping previous good record",
-                      file=sys.stderr)
-                continue
-            old[r.get("preset")] = r
-        data["convergence"] = list(old.values())
+        data["convergence"] = _merge_records(
+            data.get("convergence", []), run_convergence(names or None)
+        )
     else:
         from hefl_tpu.presets import PRESETS
 
@@ -468,20 +476,13 @@ def main() -> None:
             except Exception as e:
                 print(f"{name} FAILED: {e}", file=sys.stderr, flush=True)
                 records.append({"preset": name, "error": str(e)})
-        # merge: re-measured presets replace same-name rows, others kept;
-        # a failed re-measure never clobbers a previously good row
-        old = {r.get("preset"): r for r in data.get("presets", [])}
-        for r in records:
-            prev = old.get(r.get("preset"))
-            if "error" in r and prev is not None and "error" not in prev:
-                print(f"{r['preset']}: keeping previous good record",
-                      file=sys.stderr)
-                continue
-            old[r.get("preset")] = r
+        merged = {r.get("preset"): r for r in _merge_records(
+            data.get("presets", []), records
+        )}
         order = list(PRESET_LABELS) + [
-            k for k in old if k not in PRESET_LABELS
+            k for k in merged if k not in PRESET_LABELS
         ]
-        data["presets"] = [old[k] for k in order if k in old]
+        data["presets"] = [merged[k] for k in order if k in merged]
 
     # Atomic replace: a suite `timeout` kill mid-dump must not truncate the
     # merged evidence file (a half-written RESULTS.json would silently drop

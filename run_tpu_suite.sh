@@ -108,8 +108,20 @@ for s in 0 1 2; do
   then
     rm -f "$part.prev"   # complete artifact supersedes any old partial
   elif [ -f "$part" ]; then
-    rm -f "$part.prev"
-    echo "seed $s: rescued partial evidence:"
+    # Keep whichever partial carries MORE completed rounds: a retry that
+    # wedged after round 1 must not replace 7 rounds of prior evidence.
+    if [ -f "$part.prev" ] && python - "$part" "$part.prev" <<'PY'
+import json, sys
+rc = lambda p: json.load(open(p)).get("rounds_completed", 0)
+sys.exit(0 if rc(sys.argv[2]) > rc(sys.argv[1]) else 1)
+PY
+    then
+      mv "$part.prev" "$part"
+      echo "seed $s: retry's partial has fewer rounds; keeping previous:"
+    else
+      rm -f "$part.prev"
+      echo "seed $s: rescued partial evidence:"
+    fi
     cat "$part"
   elif [ -f "$part.prev" ]; then
     mv "$part.prev" "$part"
