@@ -264,7 +264,12 @@ def run_experiment(
         history.append(record)
         say(
             f"round {r}: acc {record['accuracy']:.4f} f1 {record['f1']:.4f} "
-            f"({timer})"
+            + (
+                f"dp_eps {record['dp_epsilon']:.2f} "
+                if "dp_epsilon" in record
+                else ""
+            )
+            + f"({timer})"
         )
         if cfg.checkpoint_path:
             save_checkpoint(

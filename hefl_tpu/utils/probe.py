@@ -100,7 +100,10 @@ def require_live_backend(
         print(
             f"{script}: no JAX backend reachable (device probe failed or "
             f"timed out after {timeout_s:.0f}s — wedged TPU tunnel?); "
-            "exiting instead of hanging. HEFL_NO_PROBE=1 overrides.",
+            "exiting instead of hanging. HEFL_NO_PROBE=1 overrides. "
+            "See RESULTS.md / NTT_TABLE.md for whatever evidence earlier "
+            "windows committed, and `python -m pytest tests/ -q` for the "
+            "backend-free correctness suite.",
             file=sys.stderr,
             flush=True,
         )
