@@ -88,30 +88,17 @@ def main() -> None:
 
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     platform = None if smoke else os.environ.get("BENCH_PLATFORM")
-    if smoke:
-        # Harness shakeout on CPU (same code path, tiny shapes): proves the
-        # whole measurement pipeline end-to-end without spending TPU time.
-        # Pin the platform before first backend touch (the ambient
-        # sitecustomize preimports jax on the tunneled TPU).
-        jax.config.update("jax_platforms", "cpu")
-    elif platform == "cpu":
-        # FULL flagship shapes pinned to CPU (BENCH_PLATFORM=cpu):
-        # accuracy, fidelity, and encode-overflow evidence is
-        # device-independent, so this mode measures it while the TPU
-        # tunnel is down. Timing fields are still emitted but carry the
-        # pinned device name — never quote them as TPU numbers.
-        jax.config.update("jax_platforms", platform)
-    else:
-        # Fast-fail instead of hanging on a wedged tunnel (BENCH_r03 was
-        # lost to exactly this): probe the backend in a bounded subprocess
-        # before this process' first backend touch. Applies to any
-        # hardware platform pin too — BENCH_PLATFORM=tpu must not
-        # reintroduce the hang.
-        from hefl_tpu.utils.probe import require_live_backend
+    # BENCH_SMOKE: harness shakeout on CPU (same code path, tiny shapes).
+    # BENCH_PLATFORM=cpu: FULL flagship shapes pinned to CPU — accuracy,
+    # fidelity, and encode-overflow evidence is device-independent, so this
+    # mode measures it while the TPU tunnel is down; timing fields carry
+    # the pinned device name — never quote them as TPU numbers.
+    # Otherwise: probe-then-pin (fast-fail instead of hanging on a wedged
+    # tunnel; BENCH_r03 was lost to exactly that). Semantics single-sourced
+    # in utils.probe.setup_backend.
+    from hefl_tpu.utils.probe import setup_backend
 
-        require_live_backend("bench.py", platform=platform)
-        if platform:
-            jax.config.update("jax_platforms", platform)
+    setup_backend("bench.py", "cpu" if smoke else platform)
     import jax.numpy as jnp
 
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")

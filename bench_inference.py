@@ -25,16 +25,11 @@ import time
 import numpy as np
 
 SMOKE = os.environ.get("INFERENCE_SMOKE") == "1"
-if SMOKE:
-    import jax
+import jax
 
-    jax.config.update("jax_platforms", "cpu")
-else:
-    import jax
+from hefl_tpu.utils.probe import setup_backend
 
-    from hefl_tpu.utils.probe import require_live_backend
-
-    require_live_backend("bench_inference.py")
+setup_backend("bench_inference.py", "cpu" if SMOKE else None)
 
 REPS = int(os.environ.get("INFERENCE_REPS", "20"))
 

@@ -82,6 +82,34 @@ def probed_device_count(
     return 0
 
 
+def setup_backend(
+    script: str, platform: str | None = None, probe_timeout_s: float = 30.0
+) -> None:
+    """Single-sourced pin-or-probe for every measurement driver.
+
+    The contract (previously copy-pasted with drift across bench.py,
+    bench_ntt.py, profile_round.py, bench_inference.py, mfu_probe.py,
+    results.py):
+
+      * platform None  -> no pin; require a live ambient backend
+        (fast-fail instead of hanging on a wedged tunnel).
+      * platform "cpu" -> pin BEFORE first backend touch, no probe — the
+        host CPU is always reachable, and the ambient environment
+        preimports jax pinned to the tunneled TPU so an env-var pin alone
+        is not honored.
+      * other platform -> probe THAT platform in a bounded subprocess
+        first (a hardware pin must never reintroduce the hang), then pin.
+    """
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        return
+    require_live_backend(script, timeout_s=probe_timeout_s, platform=platform)
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+
 def require_live_backend(
     script: str, timeout_s: float = 30.0, platform: str | None = None
 ) -> None:

@@ -48,12 +48,9 @@ def main() -> None:
     smoke = os.environ.get("MFU_SMOKE") == "1"
     import jax
 
-    if smoke:
-        jax.config.update("jax_platforms", "cpu")
-    else:
-        from hefl_tpu.utils.probe import require_live_backend
+    from hefl_tpu.utils.probe import setup_backend
 
-        require_live_backend("mfu_probe.py")
+    setup_backend("mfu_probe.py", "cpu" if smoke else None)
     import jax.numpy as jnp
 
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")

@@ -62,15 +62,12 @@ def main() -> None:
 
     import jax
 
-    if os.environ.get("PROFILE_SMOKE") == "1":
-        # Harness shakeout: pin to CPU before any backend touch (the ambient
-        # sitecustomize preimports jax on the tunneled TPU; a wedged tunnel
-        # would hang the smoke run that exists to avoid wasting TPU time).
-        jax.config.update("jax_platforms", "cpu")
-    else:
-        from hefl_tpu.utils.probe import require_live_backend
+    from hefl_tpu.utils.probe import setup_backend
 
-        require_live_backend("profile_round.py")
+    setup_backend(
+        "profile_round.py",
+        "cpu" if os.environ.get("PROFILE_SMOKE") == "1" else None,
+    )
     import jax.numpy as jnp
 
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")

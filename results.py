@@ -20,6 +20,10 @@ Usage:
                                       touching no backend (safe while the
                                       TPU tunnel is wedged)
 
+RESULTS_PLATFORM=cpu pins the backend (bench.py's BENCH_PLATFORM contract)
+so CPU-tractable configs can be measured while the tunnel is down; pinned
+records carry their device label in every table.
+
 RESULTS.md additionally folds in two artifacts if present:
   * seeds_*.json   — flagship 3-seed bench sweep
                      (`for s in 0 1 2; do BENCH_SEED=$s python bench.py
@@ -50,9 +54,13 @@ PRESET_LABELS = {
 def _jax_setup():
     import jax
 
-    from hefl_tpu.utils.probe import require_live_backend
+    # RESULTS_PLATFORM=cpu measures on the pinned host platform while the
+    # tunnel is down (same contract as bench.py's BENCH_PLATFORM); pinned
+    # runs stamp their device into every record, so tables stay honestly
+    # labeled. Pin-or-probe semantics live in utils.probe.setup_backend.
+    from hefl_tpu.utils.probe import setup_backend
 
-    require_live_backend("results.py")
+    setup_backend("results.py", os.environ.get("RESULTS_PLATFORM") or None)
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return jax
@@ -133,12 +141,12 @@ def convergence_configs() -> dict:
         # tunnel is down for a whole window (the flagship curves above are
         # hardware-scale).
         "mnist-enc-10r": (
-            "4-client encrypted SmallCNN MNIST (reduced recipe: 2 epochs, "
-            "batch 16, 512 samples), 10 rounds",
+            "4-client encrypted SmallCNN MNIST (reduced recipe: 3 epochs, "
+            "batch 16, 1024 samples), 10 rounds",
             ExperimentConfig(
                 model="smallcnn", dataset="mnist", num_clients=4, rounds=10,
-                encrypted=True, n_train=512, n_test=256,
-                train=TrainConfig(epochs=2, batch_size=16, num_classes=10),
+                encrypted=True, n_train=1024, n_test=256,
+                train=TrainConfig(epochs=3, batch_size=16, num_classes=10),
                 he=HEConfig(), seed=0,
             ),
         ),
