@@ -103,6 +103,17 @@ def setup_backend(
     import jax
 
     if platform == "cpu":
+        # A pin after backend init is a silent no-op: if some pre-main
+        # import already initialized a non-cpu backend, this "CPU" run
+        # would actually execute on (and burn) the hardware. Fail loudly.
+        from jax._src import xla_bridge
+
+        if xla_bridge._backends and jax.default_backend() != "cpu":
+            raise RuntimeError(
+                f"{script}: cannot pin to cpu — the "
+                f"{jax.default_backend()!r} backend is already initialized "
+                "in this process; launch in a fresh process"
+            )
         jax.config.update("jax_platforms", "cpu")
         return
     require_live_backend(script, timeout_s=probe_timeout_s, platform=platform)

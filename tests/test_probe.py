@@ -64,3 +64,51 @@ def test_guard_exits_when_no_devices(monkeypatch, capsys):
         probe.require_live_backend("somedriver.py")
     assert exc.value.code == 1
     assert "somedriver.py" in capsys.readouterr().err
+
+
+def test_setup_backend_cpu_pins_without_probe(monkeypatch):
+    # cpu path must never probe (the probe could hang on a wedged tunnel);
+    # under the test harness the live backend IS cpu, so the pin is legal.
+    def boom(*a, **k):
+        raise AssertionError("cpu pin must not probe")
+
+    monkeypatch.setattr(probe, "require_live_backend", boom)
+    probe.setup_backend("t", "cpu")   # must not raise
+
+
+def test_setup_backend_none_probes_without_pin(monkeypatch):
+    import jax
+
+    calls = []
+    monkeypatch.setattr(
+        probe, "require_live_backend", lambda *a, **k: calls.append((a, k))
+    )
+    before = jax.config.jax_platforms
+    probe.setup_backend("t", None)
+    assert calls and jax.config.jax_platforms == before
+
+
+def test_setup_backend_hardware_pin_probes_that_platform(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        probe,
+        "require_live_backend",
+        lambda script, timeout_s=30.0, platform=None: calls.append(platform),
+    )
+    pins = []
+    import jax
+
+    monkeypatch.setattr(
+        jax.config, "update", lambda k, v: pins.append((k, v))
+    )
+    probe.setup_backend("t", "tpu")
+    assert calls == ["tpu"]                      # probed THAT platform...
+    assert ("jax_platforms", "tpu") in pins      # ...then pinned it
+
+
+def test_setup_backend_rejects_cpu_pin_over_live_wrong_backend(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    with pytest.raises(RuntimeError, match="already initialized"):
+        probe.setup_backend("t", "cpu")
