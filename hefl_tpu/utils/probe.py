@@ -106,13 +106,21 @@ def setup_backend(
         # A pin after backend init is a silent no-op: if some pre-main
         # import already initialized a non-cpu backend, this "CPU" run
         # would actually execute on (and burn) the hardware. Fail loudly.
-        from jax._src import xla_bridge
+        # Same private-API access (and the same unreadable-means-uninitialized
+        # fallback) as probed_device_count's tier 2.
+        live = None
+        try:
+            from jax._src import xla_bridge
 
-        if xla_bridge._backends and jax.default_backend() != "cpu":
+            if xla_bridge._backends:
+                live = jax.default_backend()
+        except Exception:
+            pass
+        if live is not None and live != "cpu":
             raise RuntimeError(
-                f"{script}: cannot pin to cpu — the "
-                f"{jax.default_backend()!r} backend is already initialized "
-                "in this process; launch in a fresh process"
+                f"{script}: cannot pin to cpu — the {live!r} backend is "
+                "already initialized in this process; launch in a fresh "
+                "process"
             )
         jax.config.update("jax_platforms", "cpu")
         return

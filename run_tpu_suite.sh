@@ -146,4 +146,9 @@ echo "=== stage 7: train-step MFU probe (batch-scaling diagnosis)"
 run_stage stage7 900 MFU_TABLE.md mfu_err.log python mfu_probe.py \
   || rm -f mfu_probe.json
 
+echo "=== stage 8: flagship-shape HE fidelity (3 seeds, on-hardware decode)"
+run_stage stage8 900 FIDELITY_TABLE.md fidelity_err.log python fidelity_check.py \
+  || git checkout -- fidelity_check.json 2>/dev/null \
+  || rm -f fidelity_check.json  # table and json must stay one consistent pair
+
 echo "=== suite pass complete: $(ls suite_state)"
