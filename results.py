@@ -93,6 +93,7 @@ def _measure(name: str, label: str, cfg) -> dict:
         "partition": cfg.partition,
         "prox_mu": cfg.train.prox_mu,
         "rounds": cfg.rounds,
+        "seed": cfg.seed,
         "wallclock_s": round(wall, 2),
         "cold_round_s": round(hist[0]["phases"]["total"], 2),
         "warm_round_s": warm and round(warm, 2),   # steady = min warm round
@@ -128,6 +129,16 @@ def convergence_configs() -> dict:
     from hefl_tpu.fl import DpConfig, TrainConfig
     from hefl_tpu.presets import PRESETS
 
+    # ONE base for every reduced-recipe MNIST variant below: seed/dp
+    # variants must stay "same experiment, different knob" by construction,
+    # or the cross-row comparisons the tables present would silently drift.
+    mnist_base = ExperimentConfig(
+        model="smallcnn", dataset="mnist", num_clients=4, rounds=10,
+        encrypted=True, n_train=1024, n_test=256,
+        train=TrainConfig(epochs=3, batch_size=16, num_classes=10),
+        he=HEConfig(), seed=0,
+    )
+
     return {
         "medical-flagship-8r": (
             "flagship 2-client encrypted medical, 8 rounds",
@@ -148,12 +159,7 @@ def convergence_configs() -> dict:
         "mnist-enc-10r": (
             "4-client encrypted SmallCNN MNIST (reduced recipe: 3 epochs, "
             "batch 16, 1024 samples), 10 rounds",
-            ExperimentConfig(
-                model="smallcnn", dataset="mnist", num_clients=4, rounds=10,
-                encrypted=True, n_train=1024, n_test=256,
-                train=TrainConfig(epochs=3, batch_size=16, num_classes=10),
-                he=HEConfig(), seed=0,
-            ),
+            mnist_base,
         ),
         # Same recipe with DP-FedAvg on, two noise levels. The utility cost
         # vs mnist-enc-10r's curve demonstrates the textbook cohort-size
@@ -165,22 +171,25 @@ def convergence_configs() -> dict:
         "mnist-enc-dp-10r": (
             "4-client encrypted SmallCNN MNIST + DP (C=1, sigma=1; same "
             "reduced recipe), 10 rounds",
-            ExperimentConfig(
-                model="smallcnn", dataset="mnist", num_clients=4, rounds=10,
-                encrypted=True, n_train=1024, n_test=256,
-                train=TrainConfig(epochs=3, batch_size=16, num_classes=10),
-                he=HEConfig(), seed=0, dp=DpConfig(),
-            ),
+            dataclasses.replace(mnist_base, dp=DpConfig()),
+        ),
+        # Seed variants of the committed curve ("one seed is not evidence"):
+        # same reduced recipe, different model init + every PRNG stream.
+        "mnist-enc-10r-s1": (
+            "4-client encrypted SmallCNN MNIST (reduced recipe), 10 rounds, "
+            "seed 1",
+            dataclasses.replace(mnist_base, seed=1),
+        ),
+        "mnist-enc-10r-s2": (
+            "4-client encrypted SmallCNN MNIST (reduced recipe), 10 rounds, "
+            "seed 2",
+            dataclasses.replace(mnist_base, seed=2),
         ),
         "mnist-enc-dplow-10r": (
             "4-client encrypted SmallCNN MNIST + DP (C=1, sigma=0.1; same "
             "reduced recipe), 10 rounds",
-            ExperimentConfig(
-                model="smallcnn", dataset="mnist", num_clients=4, rounds=10,
-                encrypted=True, n_train=1024, n_test=256,
-                train=TrainConfig(epochs=3, batch_size=16, num_classes=10),
-                he=HEConfig(), seed=0,
-                dp=DpConfig(noise_multiplier=0.1),
+            dataclasses.replace(
+                mnist_base, dp=DpConfig(noise_multiplier=0.1)
             ),
         ),
     }
