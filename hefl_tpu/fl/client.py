@@ -50,19 +50,34 @@ def _eval_metrics(module, params, x_u8, y_onehot):
     return cross_entropy(logits, y_onehot), accuracy(logits, y_onehot)
 
 
-def local_train(
+def init_client_state(global_params) -> ClientState:
+    """Fresh per-client training state at the round's global weights — the
+    carry of the pure epoch program (and the unit a chunk-resumable driver
+    checkpoints between epochs)."""
+    return ClientState(
+        params=global_params,
+        opt=adam_init(global_params),
+        lr_scale=jnp.float32(1.0),
+        best_params=global_params,
+        best_val_acc=jnp.float32(-jnp.inf),
+        best_val_loss=jnp.float32(jnp.inf),
+        wait_es=jnp.int32(0),
+        wait_plateau=jnp.int32(0),
+        stopped=jnp.bool_(False),
+    )
+
+
+def _epoch_step_fn(
     module,
     cfg: TrainConfig,
     global_params,
     x: jax.Array,
     y: jax.Array,
-    key: jax.Array,
 ):
-    """Train one client from the global weights.
-
-    x: uint8[m, H, W, C]; y: int32[m]; -> (best_params, metrics f32[E, 4])
-    with metrics columns (val_loss, val_acc, lr_scale, stopped).
-    """
+    """Build the pure per-epoch transition (SGD steps + validation +
+    callback logic) for one client's data. Shared by `local_train` (scan
+    over all epochs in one program) and `local_train_epochs` (scan over a
+    chunk of epochs from a checkpointed carry)."""
     m = int(x.shape[0])
     n_val = max(int(m * cfg.val_fraction), 1) if cfg.val_fraction > 0 else 0
     n_tr = m - n_val
@@ -158,19 +173,49 @@ def local_train(
         )
         return new_state, metrics
 
-    state0 = ClientState(
-        params=global_params,
-        opt=adam_init(global_params),
-        lr_scale=jnp.float32(1.0),
-        best_params=global_params,
-        best_val_acc=jnp.float32(-jnp.inf),
-        best_val_loss=jnp.float32(jnp.inf),
-        wait_es=jnp.int32(0),
-        wait_plateau=jnp.int32(0),
-        stopped=jnp.bool_(False),
-    )
+    return epoch_step
+
+
+def local_train_epochs(
+    module,
+    cfg: TrainConfig,
+    global_params,
+    x: jax.Array,
+    y: jax.Array,
+    state: ClientState,
+    epoch_keys: jax.Array,
+):
+    """Advance the client program by `len(epoch_keys)` epochs from `state`.
+
+    The chunk-resume primitive (VERDICT r4 item 3): a driver that cannot
+    afford the full `cfg.epochs` in one process slices the precomputed
+    per-epoch key array, checkpoints the returned ClientState between
+    invocations, and ends with exactly the same callback semantics
+    (`state.best_params` is the EarlyStopping/ModelCheckpoint restore).
+    -> (state, metrics f32[len(epoch_keys), 4]).
+    """
+    epoch_step = _epoch_step_fn(module, cfg, global_params, x, y)
+    return jax.lax.scan(epoch_step, state, epoch_keys)
+
+
+def local_train(
+    module,
+    cfg: TrainConfig,
+    global_params,
+    x: jax.Array,
+    y: jax.Array,
+    key: jax.Array,
+):
+    """Train one client from the global weights.
+
+    x: uint8[m, H, W, C]; y: int32[m]; -> (best_params, metrics f32[E, 4])
+    with metrics columns (val_loss, val_acc, lr_scale, stopped).
+    """
     epoch_keys = jax.random.split(key, cfg.epochs)
-    final, metrics = jax.lax.scan(epoch_step, state0, epoch_keys)
+    final, metrics = local_train_epochs(
+        module, cfg, global_params, x, y,
+        init_client_state(global_params), epoch_keys,
+    )
     # EarlyStopping(restore_best_weights=True): ship the best checkpoint.
     return final.best_params, metrics
 

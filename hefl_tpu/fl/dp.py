@@ -99,7 +99,16 @@ def dp_sanitize(
     leaves, treedef = jax.tree_util.tree_flatten(clipped)
     keys = jax.random.split(key, len(leaves))
     noised = [
-        x + share * jax.random.normal(k, x.shape, jnp.float32)
+        # Add in f32, then cast the SUM back to the leaf's dtype: the
+        # sanitized tree keeps its dtypes (a bf16 tree must come back bf16
+        # or the encrypted-round program's encode inputs change), but the
+        # noise is never quantized BEFORE the add — casting the noise alone
+        # would round shares below the leaf's ulp to zero and silently void
+        # the guarantee epsilon_spent accounts.
+        (
+            x.astype(jnp.float32)
+            + share * jax.random.normal(k, x.shape, jnp.float32)
+        ).astype(x.dtype)
         for x, k in zip(leaves, keys)
     ]
     sane = jax.tree_util.tree_unflatten(treedef, noised)

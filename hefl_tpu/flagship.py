@@ -1,0 +1,91 @@
+"""Single source for the flagship experiment setup and its PRNG streams.
+
+The flagship configuration is the reference's headline experiment — 2
+clients x 10 local epochs, one encrypted FedAvg round, the 222,722-param
+MedCNN on the medical task (BASELINE.md; model /root/reference/
+FLPyfhelin.py:118-146, recipe FLPyfhelin.py:179-198) — plus this repo's
+bf16-stabilizing 2-epoch lr warmup. Both measurement drivers (`bench.py`,
+which times it, and `flagship_acc.py`, which completes it chunk-resumably
+for the accuracy number) MUST measure the identical configuration and
+consume the identical key streams, or their artifacts stop being evidence
+for one another. They both build from here; do not fork these constants.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# The reference's headline numbers (BASELINE.md) — the bars every flagship
+# artifact compares itself against.
+BASELINE_TOTAL_S = 6583.6   # total pipeline wall-clock
+BASELINE_ACC = 0.8425       # test accuracy (weighted)
+
+
+def flagship_setup(seed: int, smoke: bool = False):
+    """-> dict(module, params, cfg, ctx, train=(x, y), test=(xt, yt)).
+
+    `smoke=True` is the tiny-shape shakeout variant (same code path,
+    SmallCNN/MNIST/N=512) used by BENCH_SMOKE and FLAGSHIP_SMOKE.
+    BENCH_SEED / FLAGSHIP_SEED vary model init and every training /
+    augmentation / encryption stream, so a multi-seed sweep is a genuine
+    robustness check.
+    """
+    from hefl_tpu.ckks.keys import CkksContext
+    from hefl_tpu.data import make_dataset
+    from hefl_tpu.fl import TrainConfig
+    from hefl_tpu.models import count_params, create_model
+
+    if smoke:
+        train, test, _ = make_dataset("mnist", seed=0, n_train=64, n_test=32)
+        module, params = create_model("smallcnn", rng=jax.random.key(seed + 123))
+        cfg = TrainConfig(epochs=1, batch_size=8, num_classes=10,
+                          val_fraction=0.25)
+        ctx = CkksContext.create(n=512)
+    else:
+        train, test, _ = make_dataset("medical", seed=0)
+        module, params = create_model("medcnn", rng=jax.random.key(seed + 123))
+        assert count_params(params) == 222_722
+        # Reference defaults (10 epochs, bs 32, augment, ES/plateau) plus a
+        # 2-epoch linear lr warmup — stabilizes bf16 training of the deep
+        # 256x256 CNN without touching the reference's lr=1e-3 target.
+        cfg = TrainConfig(warmup_steps=44)
+        ctx = CkksContext.create()  # N=4096 -> 55 cts for 222,722 params
+    return {
+        "module": module,
+        "params": params,
+        "cfg": cfg,
+        "ctx": ctx,
+        "train": train,
+        "test": test,
+    }
+
+
+def flagship_keygen_key() -> jax.Array:
+    """HE keygen stream (shared across seeds: the reference generates ONE
+    keypair for the experiment, notebook cell 1)."""
+    return jax.random.key(99)
+
+
+def flagship_round_key(seed: int, round_index: int) -> jax.Array:
+    """The per-round key bench.py feeds `secure_fedavg_round`."""
+    return jax.random.fold_in(jax.random.key(seed + 5), round_index)
+
+
+def round_key_streams(key: jax.Array, num_clients: int, epochs: int):
+    """Expand a round key into the exact per-client streams the dp=None
+    `secure_fedavg_round` program consumes: -> (epoch_keys [C, E],
+    enc_keys [C]).
+
+    Derivation pinned to fl/secure.py (split -> (train, enc); per-client
+    splits) composed with fl/client.py's `local_train` (per-epoch split of
+    the client key). A chunked driver slices `epoch_keys` and reproduces
+    the unchunked run's stream byte-for-byte.
+    """
+    k_train, k_enc = jax.random.split(key)
+    train_keys = jax.random.split(k_train, num_clients)
+    enc_keys = jax.random.split(k_enc, num_clients)
+    epoch_keys = jnp.stack(
+        [jax.random.split(k, epochs) for k in train_keys]
+    )
+    return epoch_keys, enc_keys

@@ -38,7 +38,7 @@ def _flatten_named(params) -> dict[str, np.ndarray]:
 def save_params(path: str, params) -> None:
     """Parameter pytree -> npz keyed by `scope/subscope/name` paths."""
     named = _flatten_named(params)
-    np.savez_compressed(_npz_path(path), **{f"param:{k}": v for k, v in named.items()})
+    _atomic_savez(path, **{f"param:{k}": v for k, v in named.items()})
 
 
 def load_params(path: str, template):
@@ -66,6 +66,41 @@ def _restore_into(template, named: dict[str, np.ndarray]):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def _atomic_savez(path: str, **arrays) -> None:
+    """npz write via tmp + rename: a kill mid-dump (e.g. the suite's
+    `timeout`) must never leave a truncated checkpoint that poisons the
+    next resume."""
+    import os
+
+    target = _npz_path(path)
+    tmp = target + ".tmp.npz"
+    np.savez_compressed(tmp, **arrays)
+    os.replace(tmp, target)
+
+
+def save_pytree(path: str, tree, meta: dict | None = None) -> None:
+    """Any pytree of arrays -> npz (+ JSON metadata), atomically.
+
+    Generalizes `save_params` to arbitrary state (e.g. the per-client
+    `ClientState` stack a chunk-resumable flagship run checkpoints between
+    epochs)."""
+    header = json.dumps({"meta": meta or {}, "version": 1})
+    _atomic_savez(
+        path,
+        header=np.frombuffer(header.encode(), dtype=np.uint8),
+        **{f"param:{k}": v for k, v in _flatten_named(tree).items()},
+    )
+
+
+def load_pytree(path: str, template):
+    """Restore a `save_pytree` artifact into `template`'s structure.
+    -> (tree, meta)."""
+    with np.load(_npz_path(path)) as z:
+        header = json.loads(bytes(z["header"]).decode())
+        named = {k[len("param:"):]: z[k] for k in z.files if k.startswith("param:")}
+    return _restore_into(template, named), header.get("meta", {})
+
+
 def save_checkpoint(
     path: str, params, round_index: int, rng_key: jax.Array, meta: dict | None = None
 ) -> None:
@@ -73,8 +108,8 @@ def save_checkpoint(
     header = json.dumps(
         {"round": int(round_index), "meta": meta or {}, "version": 1}
     )
-    np.savez_compressed(
-        _npz_path(path),
+    _atomic_savez(
+        path,
         header=np.frombuffer(header.encode(), dtype=np.uint8),
         rng_key=np.asarray(jax.random.key_data(rng_key)),
         **{f"param:{k}": v for k, v in _flatten_named(params).items()},

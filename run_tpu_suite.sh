@@ -85,6 +85,12 @@ else
       echo '```'
     } > NTT_TABLE.md
     touch suite_state/stage1.skip
+    # Persist the forced mode NOW (record_mode can't: HEFL_NTT is only
+    # exported below). Without this, a pass where every later stage also
+    # fails would leave ntt_mode undecided — and the NEXT pass, skipping
+    # stage 1 via the .skip marker, would measure everything with the
+    # Pallas kernel that just failed bit-exact parity.
+    [ -f suite_state/ntt_mode ] || echo xla > suite_state/ntt_mode
   else
     # Transient (timeout/unreachable): keep the last committed table.
     git checkout -- NTT_TABLE.md 2>/dev/null || rm -f NTT_TABLE.md
@@ -103,8 +109,11 @@ for s in 0 1 2; do
   # move it aside, restore it if the retry yields nothing better.
   part="bench_partial_hw_$s.json"
   [ -f "suite_state/seed$s.done" ] || { [ -f "$part" ] && mv "$part" "$part.prev"; }
+  # BENCH_NO_FALLBACK: under the suite a CPU-smoke fallback exiting 0 would
+  # stamp seed$s.done with smoke data and delete rescued hardware partials;
+  # here fast-fail (leave the stage unresolved for the next window) is right.
   if run_stage "seed$s" 1800 "seeds_$s.json" "seeds_err_$s.log" \
-    env BENCH_SEED=$s python bench.py
+    env BENCH_SEED=$s BENCH_NO_FALLBACK=1 python bench.py
   then
     rm -f "$part.prev"   # complete artifact supersedes any old partial
   elif [ -f "$part" ]; then
