@@ -163,7 +163,22 @@ def main() -> None:
     # --- the encrypted round tail: encrypt each client's best weights,
     # homomorphic sum, owner decrypt (FLPyfhelin.py:200-228,366-390,263-281
     # equivalents), then the reference's sklearn-style test metrics. ---
+    from hefl_tpu.ckks import encoding
+    from hefl_tpu.ckks.packing import pack_pytree
+
     t0 = time.perf_counter()
+    # Saturation guard (same diagnostic every encrypted-round artifact
+    # carries): count best weights clipped at the CKKS encode envelope —
+    # nonzero means the accuracy below was measured on clipped weights.
+    overflow = jax.vmap(
+        lambda prm: encoding.encode_overflow_count(
+            pack_pytree(prm, ctx.n), ctx.scale
+        )
+    )(state.best_params)
+    overflow_total = int(np.sum(np.asarray(overflow)))
+    if overflow_total:
+        log(f"WARNING: {overflow_total} weights clipped at the encoder "
+            "envelope; the accuracy below is measured on clipped weights")
     cts = encrypt_stack(ctx, pk, state.best_params, enc_keys)
     ct_sum = aggregate_encrypted(ctx, cts)
     jax.block_until_ready((ct_sum.c0, ct_sum.c1))
@@ -193,6 +208,7 @@ def main() -> None:
         "f1": round(float(results["f1"]), 4),
         "acc_vs_reference": round(float(results["accuracy"]) - BASELINE_ACC, 4),
         "val_curve": val_curve,
+        "encode_overflow_count": overflow_total,
         "he_tail_s": round(he_s, 2),
         "evaluate_s": round(eval_s, 2),
         "wallclock_s_total": round(spent_s, 1),

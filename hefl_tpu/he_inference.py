@@ -76,19 +76,82 @@ def rotate_and_sum(
     ctx: CkksContext, ct: Ciphertext, gks: dict[int, GaloisKey]
 ) -> Ciphertext:
     """Fold all slots into their total: after log2(slots) rotate+add stages
-    every slot holds sum_j z_j."""
+    every slot holds sum_j z_j. (Unrolled op-by-op form; the serving path
+    uses `rotate_and_sum_scan`, which is this ladder as one `lax.scan`.)"""
     for step in rotation_steps(encoding.num_slots(ctx.ntt)):
         ct = ops.ct_add(ctx, ct, ops.ct_rotate(ctx, ct, gks[step], step))
     return ct
 
 
-def _linear_apply(ctx: CkksContext, pt_scale: float, ct_x: Ciphertext, w_res, b_res, gks):
+def stack_rotation_ladder(ctx: CkksContext, gks: dict[int, GaloisKey]):
+    """Stack the ladder's per-stage automorphism tables and Galois keys
+    into scan-able arrays: -> (src i32[S, N], flip bool[S, N],
+    b_mont u32[S, C, L, N], a_mont u32[S, C, L, N]) for the S = log2(slots)
+    power-of-two stages. Key/element consistency is checked here once, so
+    the jitted program needs no per-stage validation."""
+    steps = rotation_steps(encoding.num_slots(ctx.ntt))
+    missing = [s for s in steps if s not in gks]
+    if missing:
+        raise ValueError(f"rotation keys missing for steps {missing}")
+    srcs, flips = [], []
+    for s in steps:
+        want = galois.galois_elt_rotation(ctx.n, s)
+        if gks[s].g != want:
+            raise ValueError(
+                f"galois key for step {s} has g={gks[s].g}, rotation needs "
+                f"g={want}"
+            )
+        src, flip = galois.automorphism_tables(ctx.n, want)
+        srcs.append(src)
+        flips.append(flip)
+    return (
+        jnp.asarray(np.stack(srcs)),
+        jnp.asarray(np.stack(flips)),
+        jnp.stack([gks[s].b_mont for s in steps]),
+        jnp.stack([gks[s].a_mont for s in steps]),
+    )
+
+
+def rotate_and_sum_scan(ctx: CkksContext, ct: Ciphertext, ladder) -> Ciphertext:
+    """`rotate_and_sum` as ONE `lax.scan` over the ladder stages.
+
+    The unrolled ladder inlines log2(slots) copies of the
+    rotate+key-switch body (each with its own NTT stack) into the HLO —
+    the 40-110 s serving compiles measured on CPU
+    (INFERENCE_SMOKE_CPU.md) were dominated by exactly that. The scan
+    compiles the stage body ONCE and feeds the per-stage automorphism
+    tables and Galois keys in as data (`stack_rotation_ladder`); the
+    automorphism was already a gather, so tables-as-data costs nothing
+    extra. Same arithmetic, same result — pinned by the parity test in
+    tests/test_he_inference.py."""
+    from hefl_tpu.ckks.modular import add_mod
+    from hefl_tpu.ckks.ntt import ntt_forward, ntt_inverse
+    from hefl_tpu.ckks.ops import _keyswitch_coeff
+
+    ntt = ctx.ntt
+    p = jnp.asarray(ntt.p)
+
+    def stage(carry, inp):
+        c0, c1 = carry
+        src, flip, b_mont, a_mont = inp
+        pc0 = galois.apply_automorphism(ntt_inverse(ntt, c0), p, src, flip)
+        pc1 = galois.apply_automorphism(ntt_inverse(ntt, c1), p, src, flip)
+        k0, k1 = _keyswitch_coeff(ctx, pc1, b_mont, a_mont)
+        rot0 = add_mod(ntt_forward(ntt, pc0), k0, p)
+        return (add_mod(c0, rot0, p), add_mod(c1, k1, p)), None
+
+    (c0, c1), _ = jax.lax.scan(stage, (ct.c0, ct.c1), ladder)
+    return Ciphertext(c0=c0, c1=c1, scale=ct.scale)
+
+
+def _linear_apply(ctx: CkksContext, pt_scale: float, ct_x: Ciphertext, w_res, b_res, ladder):
     """Score one encrypted sample against all K classes: vmapped ct x
-    plaintext multiply + the shared rotate-and-sum ladder + bias add."""
+    plaintext multiply + the shared scanned rotate-and-sum ladder + bias
+    add."""
 
     def one(w, b):
         ct = ops.ct_mul_plain_poly(ctx, ct_x, w, pt_scale)
-        ct = rotate_and_sum(ctx, ct, gks)
+        ct = rotate_and_sum_scan(ctx, ct, ladder)
         return ops.ct_add_plain(ctx, ct, b)
 
     return jax.vmap(one)(w_res, b_res)
@@ -102,8 +165,8 @@ def _linear_program(ctx: CkksContext, pt_scale: float):
     program on a (possibly tunneled) TPU."""
 
     @jax.jit
-    def run(ct_x: Ciphertext, w_res, b_res, gks):
-        return _linear_apply(ctx, pt_scale, ct_x, w_res, b_res, gks)
+    def run(ct_x: Ciphertext, w_res, b_res, ladder):
+        return _linear_apply(ctx, pt_scale, ct_x, w_res, b_res, ladder)
 
     return run
 
@@ -116,9 +179,9 @@ def _linear_batch_program(ctx: CkksContext, pt_scale: float):
     lanes together."""
 
     @jax.jit
-    def run(ct_xs: Ciphertext, w_res, b_res, gks):
+    def run(ct_xs: Ciphertext, w_res, b_res, ladder):
         return jax.vmap(
-            lambda ct: _linear_apply(ctx, pt_scale, ct, w_res, b_res, gks)
+            lambda ct: _linear_apply(ctx, pt_scale, ct, w_res, b_res, ladder)
         )(ct_xs)
 
     return run
@@ -175,7 +238,10 @@ class LinearScorer:
         self.ctx = ctx
         self.pt_scale = pt_scale
         self.ct_scale = ctx.scale if ct_scale is None else ct_scale
-        self.gks = gks
+        # Only the stacked ladder is retained: also holding the gks dict
+        # would keep a second full copy of the Galois key material alive
+        # for the scorer's lifetime.
+        self._ladder = stack_rotation_ladder(ctx, gks)
         self.num_classes = int(np.asarray(weights).shape[0])
         self._w_res, self._b_res = _encode_linear_model(
             ctx, weights, bias, self.ct_scale, pt_scale
@@ -188,7 +254,7 @@ class LinearScorer:
             raise ValueError(
                 f"scorer was built for ct scale {self.ct_scale}, got {ct_x.scale}"
             )
-        return self._run(ct_x, self._w_res, self._b_res, self.gks)
+        return self._run(ct_x, self._w_res, self._b_res, self._ladder)
 
     def score(self, ct_x: Ciphertext) -> list[Ciphertext]:
         batched = self.score_batched(ct_x)
@@ -212,7 +278,7 @@ class LinearScorer:
                 f"shape {ct_xs.c0.shape}; use score() for a single sample"
             )
         return _linear_batch_program(self.ctx, self.pt_scale)(
-            ct_xs, self._w_res, self._b_res, self.gks
+            ct_xs, self._w_res, self._b_res, self._ladder
         )
 
 
@@ -454,7 +520,7 @@ class MlpScorer:
         self.ctx = ctx
         self.pt_scale = pt_scale
         self.ct_scale = ctx.scale if ct_scale is None else ct_scale
-        self.gks = gks
+        self._ladder = stack_rotation_ladder(ctx, gks)   # sole key copy kept
         self.rlk = rlk
         self.num_classes = int(w2.shape[0])
         self._rescales = rescales
@@ -485,7 +551,7 @@ class MlpScorer:
             raise ValueError(
                 f"scorer was built for ct scale {self.ct_scale}, got {ct_x.scale}"
             )
-        h = self._lin(ct_x, self._w1_res, self._b1_res, self.gks)
+        h = self._lin(ct_x, self._w1_res, self._b1_res, self._ladder)
         return self._tail(h, self.rlk, self._w2m, self._b2e)
 
     def score(self, ct_x: Ciphertext) -> list[Ciphertext]:
@@ -510,7 +576,7 @@ class MlpScorer:
                 f"shape {ct_xs.c0.shape}; use score() for a single sample"
             )
         hs = _linear_batch_program(self.ctx, self.pt_scale)(
-            ct_xs, self._w1_res, self._b1_res, self.gks
+            ct_xs, self._w1_res, self._b1_res, self._ladder
         )
         return _mlp_tail_batch_program(self.ctx, self.pt_scale, self._rescales)(
             hs, self.rlk, self._w2m, self._b2e

@@ -37,6 +37,22 @@ def test_rotate_and_sum_totals_all_slots(setup):
     np.testing.assert_allclose(np.real(z), x.sum(), atol=5e-2 * np.sqrt(len(x)))
 
 
+def test_rotate_and_sum_scan_matches_unrolled(setup):
+    # The serving path's lax.scan ladder must be BIT-EXACT against the
+    # op-by-op ladder: same stages, same modular arithmetic, only the
+    # program structure differs (tables-as-data instead of unrolled HLO).
+    ctx, sk, pk, gks = setup
+    rng = np.random.default_rng(7)
+    x = rng.normal(0, 0.5, encoding.num_slots(ctx.ntt))
+    ct = hei.encrypt_features(ctx, pk, x, jax.random.key(9))
+    ref = hei.rotate_and_sum(ctx, ct, gks)
+    ladder = hei.stack_rotation_ladder(ctx, gks)
+    got = hei.rotate_and_sum_scan(ctx, ct, ladder)
+    np.testing.assert_array_equal(np.asarray(got.c0), np.asarray(ref.c0))
+    np.testing.assert_array_equal(np.asarray(got.c1), np.asarray(ref.c1))
+    assert got.scale == ref.scale
+
+
 def test_encrypted_linear_matches_plaintext(setup):
     ctx, sk, pk, gks = setup
     rng = np.random.default_rng(4)
