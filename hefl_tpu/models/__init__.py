@@ -15,7 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from hefl_tpu.models.cnn import MedCNN, SmallCNN, count_params
+from hefl_tpu.models.cnn import LogReg, MedCNN, SmallCNN, count_params
 from hefl_tpu.models.resnet import ResNet20
 
 # name -> (module class, default num_classes, default input shape): each
@@ -24,6 +24,7 @@ from hefl_tpu.models.resnet import ResNet20
 MODEL_REGISTRY: dict[str, tuple[type, int, tuple[int, int, int]]] = {
     "medcnn": (MedCNN, 2, (256, 256, 3)),
     "smallcnn": (SmallCNN, 10, (28, 28, 1)),
+    "logreg": (LogReg, 10, (28, 28, 1)),
     "resnet20": (ResNet20, 10, (32, 32, 3)),
 }
 
@@ -53,6 +54,7 @@ def create_model(
 
 
 __all__ = [
+    "LogReg",
     "MedCNN",
     "SmallCNN",
     "ResNet20",

@@ -69,6 +69,28 @@ class SmallCNN(MedCNN):
     dense: Sequence[int] = (128,)
 
 
+class LogReg(nn.Module):
+    """Multinomial logistic regression (flatten -> one Dense): the standard
+    large-cohort DP-FedAvg demonstrator. Central DP's per-coordinate noise
+    on the released mean is sigma*C/K while a clipped update's per-coordinate
+    signal is ~C/sqrt(d), so at fixed privacy the utility frontier is set by
+    K/sqrt(d) — a low-d model is how a CPU-sized cohort (fl/dp.py cohort-size
+    law) shows DP being useful AND private, where a 225k-param CNN at the
+    same epsilon is buried in its own noise (RESULTS.md r4 DP rows)."""
+
+    num_classes: int = 10
+    apply_softmax: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(
+            self.num_classes, dtype=jnp.bfloat16, param_dtype=jnp.float32
+        )(x)
+        x = x.astype(jnp.float32)
+        return nn.softmax(x) if self.apply_softmax else x
+
+
 def count_params(params) -> int:
     """Total scalar parameter count of a pytree (222,722 for MedCNN@256)."""
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
