@@ -42,6 +42,27 @@ def test_seed_runs_exclude_smoke_and_pinned(artifact_dir):
     assert [r["seed"] for r in results.load_pinned_runs()] == [2]
 
 
+def test_flagship_runs_fold_into_markdown(artifact_dir):
+    # flagship_acc.py artifacts (indent-formatted JSON, unlike the
+    # one-line bench outputs) surface in their own RESULTS.md section;
+    # smoke shakeouts stay out.
+    with open("flagship_acc_0.json", "w") as f:
+        json.dump(
+            {"task": "flagship_accuracy", "seed": 0, "device": "cpu",
+             "local_epochs": 10, "accuracy": 0.9, "precision": 0.9,
+             "recall": 0.9, "f1": 0.9, "acc_vs_reference": 0.06,
+             "wallclock_s_total": 123.0},
+            f, indent=2,
+        )
+    with open("flagship_acc_smoke_0.json", "w") as f:
+        json.dump({"task": "flagship_accuracy", "smoke": True, "seed": 0}, f)
+    runs = results.load_flagship_runs()
+    assert [r["_seed_file"] for r in runs] == ["flagship_acc_0.json"]
+    md = results.write_markdown({"presets": [], "convergence": []})
+    assert "Flagship accuracy" in md and "flagship_acc_0.json" in md
+    assert "flagship_acc_smoke_0" not in md
+
+
 def test_corrupt_artifact_is_skipped(artifact_dir, tmp_path):
     artifact_dir("seeds_0.json", {"seed": 0})
     (tmp_path / "seeds_1.json").write_text("{truncated")
