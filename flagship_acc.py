@@ -136,6 +136,12 @@ def main() -> None:
         state = template
 
     for e in range(epochs_done, cfg.epochs):
+        if bool(np.all(np.asarray(state.stopped))):
+            # Covers resume-from-checkpoint after the break below: never
+            # spend a chunk computing a state-identical frozen epoch.
+            log(f"all clients already early-stopped before epoch {e + 1}; "
+                "skipping to the encrypted tail")
+            break
         t0 = time.perf_counter()
         state, mets = chunk(params, state, xs_d, ys_d, epoch_keys[:, e : e + 1])
         jax.block_until_ready(mets)
@@ -159,6 +165,14 @@ def main() -> None:
             f"{m[:, 0].round(4).tolist()} val_acc {m[:, 1].round(4).tolist()}"
             f" | stopped {m[:, 3].astype(bool).tolist()}"
         )
+        if bool(np.all(np.asarray(state.stopped))):
+            # Semantics-identical shortcut the unchunked lax.scan cannot
+            # take: every client is early-stopped, so the remaining epochs
+            # would only carry the frozen state forward (fl/client.py
+            # masking). best_params — what the round ships — is final now.
+            log(f"all clients early-stopped after epoch {e + 1}; "
+                "remaining epochs are frozen no-ops — finishing early")
+            break
 
     # --- the encrypted round tail: encrypt each client's best weights,
     # homomorphic sum, owner decrypt (FLPyfhelin.py:200-228,366-390,263-281
@@ -198,6 +212,9 @@ def main() -> None:
         "num_clients": num_clients,
         "rounds": 1,
         "local_epochs": cfg.epochs,
+        # < local_epochs iff every client early-stopped (recipe semantics
+        # unchanged: the remaining epochs would be frozen no-ops).
+        "epochs_run": len(val_curve),
         "seed": seed,
         "device": ", ".join(devices_used),
         **({"platform_pinned": platform} if platform else {}),

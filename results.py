@@ -468,14 +468,18 @@ def write_markdown(data: dict) -> str:
             "evaluation. Accuracy is device-independent; the wall-clock "
             "column describes the labeled device, not a TPU.",
             "",
-            "| run | device | local epochs | accuracy | precision | "
+            "| run | device | epochs run/planned | accuracy | precision | "
             "recall | F1 | vs reference | wall-clock (s) |",
             "|---|---|---|---|---|---|---|---|---|",
         ]
         for s in flagship:
+            planned = s.get("local_epochs")
+            # epochs_run < planned iff every client early-stopped (the
+            # chunked driver skips the frozen no-op epochs).
+            ep = f"{s.get('epochs_run', planned)}/{planned}"
             lines.append(
                 f"| {s['_seed_file']} | {s.get('device')} | "
-                f"{s.get('local_epochs')} | {s.get('accuracy')} | "
+                f"{ep} | {s.get('accuracy')} | "
                 f"{s.get('precision')} | {s.get('recall')} | "
                 f"{s.get('f1')} | {s.get('acc_vs_reference')} | "
                 f"{s.get('wallclock_s_total')} |"
