@@ -24,7 +24,9 @@ Usage:
   FLAGSHIP_SEED=0 python flagship_acc.py          # run / resume seed 0
   FLAGSHIP_PLATFORM=cpu (default)                  # pin; "tpu" probes first
 
-Artifacts: flagship_state_{seed}.npz (rolling, deleted on success),
+Artifacts: flagship_state_{seed}.npz (rolling; deleted when the run
+completes or early-stops, deliberately KEPT on a FLAGSHIP_FINISH_NOW
+budget cutoff so a later session can resume toward the full recipe),
 flagship_acc_{seed}.json (final evidence; results.py folds it into
 RESULTS.md).
 """
@@ -135,7 +137,27 @@ def main() -> None:
     else:
         state = template
 
+    # FLAGSHIP_FINISH_NOW=1: stop training at the current checkpoint and
+    # run the encrypted tail + evaluation immediately. For when the epoch
+    # budget (≈1 h/epoch on this 1-core box) collides with a hard session
+    # boundary: an honest, clearly-labeled partial row beats a checkpoint
+    # that never becomes evidence. The artifact records finish_reason and
+    # partial=true.
+    finish_now = os.environ.get("FLAGSHIP_FINISH_NOW") == "1"
+    if finish_now and epochs_done == 0:
+        # Nothing trained: evaluating init weights is meaningless, and
+        # os.replace below would clobber any completed artifact for this
+        # seed (e.g. a stale FLAGSHIP_FINISH_NOW left exported in a shell).
+        raise SystemExit(
+            "FLAGSHIP_FINISH_NOW=1 but no epoch checkpoint exists for "
+            f"seed {seed}; refusing to evaluate untrained weights"
+        )
     for e in range(epochs_done, cfg.epochs):
+        if finish_now:
+            log(f"FLAGSHIP_FINISH_NOW: stopping at epoch {e} of "
+                f"{cfg.epochs}; running the encrypted tail on the "
+                "best-so-far weights")
+            break
         if bool(np.all(np.asarray(state.stopped))):
             # Covers resume-from-checkpoint after the break below: never
             # spend a chunk computing a state-identical frozen epoch.
@@ -204,6 +226,12 @@ def main() -> None:
     eval_s = time.perf_counter() - t0
     spent_s += he_s + eval_s
 
+    finish_reason = (
+        "completed" if len(val_curve) >= cfg.epochs
+        else "early_stopped"
+        if bool(np.all(np.asarray(state.stopped)))
+        else "budget_cutoff"
+    )
     record = {
         "task": "flagship_accuracy",
         **({"smoke": True} if smoke else {}),
@@ -213,8 +241,10 @@ def main() -> None:
         "rounds": 1,
         "local_epochs": cfg.epochs,
         # < local_epochs iff every client early-stopped (recipe semantics
-        # unchanged: the remaining epochs would be frozen no-ops).
+        # unchanged) or the run was budget-cut (finish_reason says which).
         "epochs_run": len(val_curve),
+        "finish_reason": finish_reason,
+        **({"partial": True} if finish_reason == "budget_cutoff" else {}),
         "seed": seed,
         "device": ", ".join(devices_used),
         **({"platform_pinned": platform} if platform else {}),
@@ -233,10 +263,13 @@ def main() -> None:
     with open(out_path + ".tmp", "w") as f:
         json.dump(record, f, indent=2)
     os.replace(out_path + ".tmp", out_path)
-    try:
-        os.remove(state_path + ".npz")
-    except OSError:
-        pass
+    if record["finish_reason"] != "budget_cutoff":
+        # A budget-cut run keeps its checkpoint so a later session can
+        # resume toward the full recipe and supersede this partial row.
+        try:
+            os.remove(state_path + ".npz")
+        except OSError:
+            pass
     print(json.dumps(record))
 
 

@@ -474,11 +474,15 @@ def write_markdown(data: dict) -> str:
         ]
         for s in flagship:
             planned = s.get("local_epochs")
-            # epochs_run < planned iff every client early-stopped (the
-            # chunked driver skips the frozen no-op epochs).
+            # epochs_run < planned when every client early-stopped (the
+            # chunked driver skips the frozen no-op epochs) OR the run was
+            # budget-cut — the partial flag marks the latter.
             ep = f"{s.get('epochs_run', planned)}/{planned}"
+            name = s["_seed_file"] + (
+                " (partial: budget cutoff)" if s.get("partial") else ""
+            )
             lines.append(
-                f"| {s['_seed_file']} | {s.get('device')} | "
+                f"| {name} | {s.get('device')} | "
                 f"{ep} | {s.get('accuracy')} | "
                 f"{s.get('precision')} | {s.get('recall')} | "
                 f"{s.get('f1')} | {s.get('acc_vs_reference')} | "
