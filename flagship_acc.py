@@ -45,6 +45,34 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _migrate_checkpoint(path: str) -> None:
+    """Upgrade a pre-best_loss_params ClientState checkpoint in place.
+
+    r5 added `best_loss_params` to ClientState (the EarlyStopping restore
+    target — see fl.client.client_shipped_params). Older checkpoints lack
+    the field; seed it from `.params`, which is exact whenever val loss
+    improved monotonically up to the checkpoint (true of the run this
+    migrates) and the best available reconstruction otherwise — the
+    alternative is discarding hours of single-core training.
+    """
+    with np.load(path) as z:
+        names = list(z.files)
+        if any(n.startswith("param:.best_loss_params") for n in names):
+            return
+        data = {n: z[n] for n in names}
+    added = 0
+    for n in names:
+        if n.startswith("param:.params/"):
+            data[n.replace("param:.params/", "param:.best_loss_params/", 1)] = data[n]
+            added += 1
+    if not added:
+        raise RuntimeError(f"cannot migrate {path}: no .params leaves found")
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(tmp, **data)
+    os.replace(tmp, path)
+    log(f"migrated {path}: seeded best_loss_params from params ({added} leaves)")
+
+
 def main() -> None:
     seed = int(os.environ.get("FLAGSHIP_SEED", "0"))
     smoke = os.environ.get("FLAGSHIP_SMOKE") == "1"
@@ -62,7 +90,11 @@ def main() -> None:
     from hefl_tpu.ckks.packing import PackSpec
     from hefl_tpu.data import iid_contiguous, stack_federated
     from hefl_tpu.fl import decrypt_average, evaluate
-    from hefl_tpu.fl.client import init_client_state, local_train_epochs
+    from hefl_tpu.fl.client import (
+        client_shipped_params,
+        init_client_state,
+        local_train_epochs,
+    )
     from hefl_tpu.fl.secure import aggregate_encrypted, encrypt_stack
     from hefl_tpu.flagship import (
         BASELINE_ACC,
@@ -115,6 +147,7 @@ def main() -> None:
     spent_s = 0.0
     devices_used = [device]
     if os.path.exists(state_path + ".npz"):
+        _migrate_checkpoint(state_path + ".npz")
         state, meta = load_pytree(state_path, template)
         if meta.get("seed") != seed:
             raise RuntimeError(
@@ -191,31 +224,35 @@ def main() -> None:
             # Semantics-identical shortcut the unchunked lax.scan cannot
             # take: every client is early-stopped, so the remaining epochs
             # would only carry the frozen state forward (fl/client.py
-            # masking). best_params — what the round ships — is final now.
+            # masking). client_shipped_params(state) — what the round
+            # ships — is final now.
             log(f"all clients early-stopped after epoch {e + 1}; "
                 "remaining epochs are frozen no-ops — finishing early")
             break
 
-    # --- the encrypted round tail: encrypt each client's best weights,
-    # homomorphic sum, owner decrypt (FLPyfhelin.py:200-228,366-390,263-281
-    # equivalents), then the reference's sklearn-style test metrics. ---
+    # --- the encrypted round tail: encrypt what each client actually
+    # uploads (fl.client.client_shipped_params — the reference's post-fit
+    # save_weights semantics), homomorphic sum, owner decrypt
+    # (FLPyfhelin.py:196-228,366-390,263-281 equivalents), then the
+    # reference's sklearn-style test metrics. ---
     from hefl_tpu.ckks import encoding
     from hefl_tpu.ckks.packing import pack_pytree
 
     t0 = time.perf_counter()
+    shipped = jax.vmap(client_shipped_params)(state)
     # Saturation guard (same diagnostic every encrypted-round artifact
-    # carries): count best weights clipped at the CKKS encode envelope —
+    # carries): count shipped weights clipped at the CKKS encode envelope —
     # nonzero means the accuracy below was measured on clipped weights.
     overflow = jax.vmap(
         lambda prm: encoding.encode_overflow_count(
             pack_pytree(prm, ctx.n), ctx.scale
         )
-    )(state.best_params)
+    )(shipped)
     overflow_total = int(np.sum(np.asarray(overflow)))
     if overflow_total:
         log(f"WARNING: {overflow_total} weights clipped at the encoder "
             "envelope; the accuracy below is measured on clipped weights")
-    cts = encrypt_stack(ctx, pk, state.best_params, enc_keys)
+    cts = encrypt_stack(ctx, pk, shipped, enc_keys)
     ct_sum = aggregate_encrypted(ctx, cts)
     jax.block_until_ready((ct_sum.c0, ct_sum.c1))
     new_params = decrypt_average(ctx, sk, ct_sum, num_clients, pack)

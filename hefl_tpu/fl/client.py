@@ -38,6 +38,7 @@ class ClientState:
     opt: AdamState
     lr_scale: jax.Array          # f32: ReduceLROnPlateau multiplier
     best_params: object          # ModelCheckpoint best-by-accuracy
+    best_loss_params: object     # EarlyStopping best-by-val-loss (restore target)
     best_val_acc: jax.Array
     best_val_loss: jax.Array
     wait_es: jax.Array           # epochs since val-loss improvement (early stop)
@@ -59,6 +60,7 @@ def init_client_state(global_params) -> ClientState:
         opt=adam_init(global_params),
         lr_scale=jnp.float32(1.0),
         best_params=global_params,
+        best_loss_params=global_params,
         best_val_acc=jnp.float32(-jnp.inf),
         best_val_loss=jnp.float32(jnp.inf),
         wait_es=jnp.int32(0),
@@ -73,6 +75,7 @@ def _epoch_step_fn(
     global_params,
     x: jax.Array,
     y: jax.Array,
+    track_best_acc: bool = True,
 ):
     """Build the pure per-epoch transition (SGD steps + validation +
     callback logic) for one client's data. Shared by `local_train` (scan
@@ -153,12 +156,25 @@ def _epoch_step_fn(
         )
         sel = lambda new, old: jnp.where(frozen, old, new)  # noqa: E731
         take_best = jnp.logical_and(acc_improved, jnp.logical_not(frozen))
+        take_best_loss = jnp.logical_and(loss_improved, jnp.logical_not(frozen))
         new_state = ClientState(
             params=pick(params, state.params),
             opt=pick(opt, state.opt),
             lr_scale=sel(lr_scale, state.lr_scale),
-            best_params=jax.tree_util.tree_map(
-                lambda a, b: jnp.where(take_best, a, b), params, state.best_params
+            # best-by-accuracy (ModelCheckpoint) is only ever read by the
+            # centralized train_server path; clients skip the per-epoch
+            # full-tree select (track_best_acc=False -> XLA DCEs the copy).
+            best_params=(
+                jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(take_best, a, b),
+                    params, state.best_params,
+                )
+                if track_best_acc
+                else state.best_params
+            ),
+            best_loss_params=jax.tree_util.tree_map(
+                lambda a, b: jnp.where(take_best_loss, a, b),
+                params, state.best_loss_params,
             ),
             best_val_acc=sel(jnp.maximum(val_acc, state.best_val_acc), state.best_val_acc),
             best_val_loss=sel(
@@ -184,6 +200,7 @@ def local_train_epochs(
     y: jax.Array,
     state: ClientState,
     epoch_keys: jax.Array,
+    track_best_acc: bool = True,
 ):
     """Advance the client program by `len(epoch_keys)` epochs from `state`.
 
@@ -191,11 +208,36 @@ def local_train_epochs(
     afford the full `cfg.epochs` in one process slices the precomputed
     per-epoch key array, checkpoints the returned ClientState between
     invocations, and ends with exactly the same callback semantics
-    (`state.best_params` is the EarlyStopping/ModelCheckpoint restore).
+    (`client_shipped_params(state)` is the client-upload restore).
     -> (state, metrics f32[len(epoch_keys), 4]).
     """
-    epoch_step = _epoch_step_fn(module, cfg, global_params, x, y)
+    epoch_step = _epoch_step_fn(module, cfg, global_params, x, y,
+                                track_best_acc=track_best_acc)
     return jax.lax.scan(epoch_step, state, epoch_keys)
+
+
+def client_shipped_params(state: ClientState):
+    """The weights a CLIENT uploads after `model.fit`, with the reference's
+    exact callback semantics (FLPyfhelin.py:184-198): what gets encrypted
+    is `save_weights(model)` AFTER fit — i.e. the live model, on which
+    TF-2.x `EarlyStopping(restore_best_weights=True)` restores the
+    best-val-LOSS weights ONLY when it actually stopped training early;
+    a run that completes all epochs keeps its final-epoch weights. The
+    per-client `ModelCheckpoint` (best-by-val-accuracy) writes a side
+    .ckpt that the client upload path never reads — that checkpoint IS
+    what the centralized `train_server` reloads (FLPyfhelin.py:169-174),
+    hence `train_centralized` ships `state.best_params` instead.
+
+    (Shipping best-by-accuracy here — r4 behavior — silently degrades the
+    hardened flagship task: the 80-image val split saturates at accuracy
+    1.0 within a few epochs and strict-improvement tracking then locks in
+    those early, undertrained weights.)
+    """
+    return jax.tree_util.tree_map(
+        lambda best, fin: jnp.where(state.stopped, best, fin),
+        state.best_loss_params,
+        state.params,
+    )
 
 
 def local_train(
@@ -208,30 +250,44 @@ def local_train(
 ):
     """Train one client from the global weights.
 
-    x: uint8[m, H, W, C]; y: int32[m]; -> (best_params, metrics f32[E, 4])
-    with metrics columns (val_loss, val_acc, lr_scale, stopped).
+    x: uint8[m, H, W, C]; y: int32[m]; -> (shipped_params, metrics
+    f32[E, 4]) with metrics columns (val_loss, val_acc, lr_scale,
+    stopped). `shipped_params` follows `client_shipped_params`.
     """
     epoch_keys = jax.random.split(key, cfg.epochs)
     final, metrics = local_train_epochs(
         module, cfg, global_params, x, y,
         init_client_state(global_params), epoch_keys,
+        track_best_acc=False,   # clients never read the ModelCheckpoint copy
     )
-    # EarlyStopping(restore_best_weights=True): ship the best checkpoint.
+    return client_shipped_params(final), metrics
+
+
+# Convenience jitted entry for single-client use (tests).
+local_train_jit = partial(jax.jit, static_argnums=(0, 1))(local_train)
+
+
+def _centralized(module, cfg: TrainConfig, params, x, y, key):
+    epoch_keys = jax.random.split(key, cfg.epochs)
+    final, metrics = local_train_epochs(
+        module, cfg, params, x, y, init_client_state(params), epoch_keys
+    )
+    # train_server reloads its best-by-ACCURACY ModelCheckpoint after fit
+    # (FLPyfhelin.py:169-174) — unlike the client upload path, which ships
+    # the post-fit live model (see client_shipped_params).
     return final.best_params, metrics
 
 
-# Convenience jitted entry for single-client use (tests, centralized baseline
-# — the analog of `train_server`, FLPyfhelin.py:161).
-local_train_jit = partial(jax.jit, static_argnums=(0, 1))(local_train)
+_centralized_jit = partial(jax.jit, static_argnums=(0, 1))(_centralized)
 
 
 def train_centralized(module, cfg: TrainConfig, params, x, y, key):
     """Centralized (non-federated) baseline trainer — `train_server`
     (FLPyfhelin.py:161-177): the whole dataset, one model, the same
     callback semantics (EarlyStopping / ReduceLROnPlateau / best-checkpoint
-    restore). The reference defines it but its notebook never calls it; it
-    exists to measure what federation costs in accuracy.
+    restore-by-accuracy). The reference defines it but its notebook never
+    calls it; it exists to measure what federation costs in accuracy.
 
-    -> (best_params, metrics f32[E, 4]) like `local_train`.
+    -> (best_params, metrics f32[E, 4]).
     """
-    return local_train_jit(module, cfg, params, x, y, key)
+    return _centralized_jit(module, cfg, params, x, y, key)

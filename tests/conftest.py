@@ -65,7 +65,7 @@ _SLOW_PATTERNS = (
     "test_data.py::test_medical_spec_keeps_accuracy_headroom",
     "test_ckks.py::test_rescale",
     "test_ckks.py::test_ct_mul_plain_poly",
-    "test_fl.py::test_local_train_improves",
+    "test_fl.py::test_local_train_ships_reference_callback",
     "test_experiment.py::test_cli_main_json_output",
     "test_galois.py::test_rotate",
     "test_models.py::test_resnet20",

@@ -100,8 +100,10 @@ def test_local_train_epochs_chunked_matches_unchunked(tmp_path):
     np.testing.assert_allclose(
         np.concatenate(mets), np.asarray(mets_ref), rtol=1e-5, atol=1e-6
     )
+    from hefl_tpu.fl.client import client_shipped_params
+
     for a, b in zip(
-        jax.tree_util.tree_leaves(state.best_params),
+        jax.tree_util.tree_leaves(client_shipped_params(state)),
         jax.tree_util.tree_leaves(best_ref),
     ):
         np.testing.assert_allclose(
@@ -109,25 +111,43 @@ def test_local_train_epochs_chunked_matches_unchunked(tmp_path):
         )
 
 
-def test_local_train_improves_and_restores_best():
+def test_local_train_ships_reference_callback_semantics():
+    # The client upload is save_weights(model) AFTER fit
+    # (FLPyfhelin.py:196-198): TF-2.x EarlyStopping restores the
+    # best-val-LOSS weights only when it stopped training early; a run
+    # that completes all its epochs ships the FINAL epoch's weights.
+    from hefl_tpu.fl.client import _eval_metrics
+
     model, params, xs, ys, xt, yt = _setup(1, 96)
+    n_val = int(96 * 0.25)
+    x_va = jnp.asarray(xs[0][:n_val])
+    oh_va = jax.nn.one_hot(jnp.asarray(ys[0][:n_val]), 10)
+
+    # (a) no early stop (patience > epochs): shipped == final weights, so
+    # re-evaluating them reproduces the LAST epoch's val loss.
     cfg = TrainConfig(epochs=3, batch_size=16, num_classes=10, augment=False,
                       val_fraction=0.25)
-    best, metrics = jax.jit(
+    shipped, metrics = jax.jit(
         lambda p, x, y, k: local_train(model, cfg, p, x, y, k)
     )(params, jnp.asarray(xs[0]), jnp.asarray(ys[0]), jax.random.key(1))
     assert metrics.shape == (3, 4)
-    val_acc = np.asarray(metrics[:, 1])
-    # best weights correspond to the max-val-acc epoch: re-evaluating the
-    # returned params on the val slice (the HEAD fraction, Keras semantics)
-    # must match that accuracy.
-    n_val = int(96 * 0.25)
-    from hefl_tpu.fl.client import _eval_metrics
-    _, acc = _eval_metrics(
-        model, best, jnp.asarray(xs[0][:n_val]),
-        jax.nn.one_hot(jnp.asarray(ys[0][:n_val]), 10),
-    )
-    assert np.isclose(float(acc), val_acc.max(), atol=1e-6)
+    assert not bool(metrics[-1, 3])  # really did run un-stopped
+    loss, _ = _eval_metrics(model, shipped, x_va, oh_va)
+    assert np.isclose(float(loss), float(metrics[-1, 0]), atol=1e-3)
+
+    # (b) early stop: min_delta=10 means only epoch 1 ever counts as an
+    # improvement, so patience-1 ES fires deterministically at epoch 2 and
+    # the shipped weights must be epoch 1's (the best-val-loss restore),
+    # NOT the later epochs' params the loop kept training.
+    cfg_es = TrainConfig(epochs=4, batch_size=16, num_classes=10,
+                         augment=False, val_fraction=0.25, es_patience=1,
+                         min_delta=10.0)
+    shipped, metrics = jax.jit(
+        lambda p, x, y, k: local_train(model, cfg_es, p, x, y, k)
+    )(params, jnp.asarray(xs[0]), jnp.asarray(ys[0]), jax.random.key(1))
+    assert bool(metrics[-1, 3])  # stopped early
+    loss, _ = _eval_metrics(model, shipped, x_va, oh_va)
+    assert np.isclose(float(loss), float(metrics[0, 0]), atol=1e-3)
 
 
 def test_early_stopping_freezes_state():
