@@ -604,6 +604,43 @@ def write_markdown(data: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _write_md(data: dict) -> None:
+    with open("RESULTS.md.tmp", "w") as f:
+        f.write(write_markdown(data))
+    os.replace("RESULTS.md.tmp", "RESULTS.md")
+
+
+def _write_evidence(data: dict, md_fatal: bool = True) -> None:
+    """Atomic RESULTS.json + RESULTS.md dump: a suite `timeout` kill
+    mid-write must not truncate the merged evidence file. `md_fatal=False`
+    (the in-measurement-loop mode) demotes a markdown-render failure to a
+    warning: the JSON is the canonical evidence and a render bug must not
+    abort a sweep of hour-long measurements."""
+    with open("RESULTS.json.tmp", "w") as f:
+        json.dump(data, f, indent=2)
+    os.replace("RESULTS.json.tmp", "RESULTS.json")
+    try:
+        _write_md(data)
+    except Exception:
+        if md_fatal:
+            raise
+        import traceback
+
+        print("WARNING: RESULTS.md render failed (JSON evidence saved):",
+              file=sys.stderr)
+        traceback.print_exc()
+
+
+def _merge_presets(data: dict, records: list[dict]) -> None:
+    merged = {r.get("preset"): r for r in _merge_records(
+        data.get("presets", []), records
+    )}
+    order = list(PRESET_LABELS) + [
+        k for k in merged if k not in PRESET_LABELS
+    ]
+    data["presets"] = [merged[k] for k in order if k in merged]
+
+
 def main() -> None:
     args = [a for a in sys.argv[1:]]
     convergence = "--convergence" in args
@@ -621,33 +658,26 @@ def main() -> None:
         from hefl_tpu.presets import PRESETS
 
         names = names or list(PRESETS)
-        records = []
         for name in names:
             try:
-                records.append(run_preset(name))
+                rec = run_preset(name)
             except Exception as e:
                 print(f"{name} FAILED: {e}", file=sys.stderr, flush=True)
-                records.append({"preset": name, "error": str(e)})
-        merged = {r.get("preset"): r for r in _merge_records(
-            data.get("presets", []), records
-        )}
-        order = list(PRESET_LABELS) + [
-            k for k in merged if k not in PRESET_LABELS
-        ]
-        data["presets"] = [merged[k] for k in order if k in merged]
+                rec = {"preset": name, "error": str(e)}
+            # Persist after EVERY preset: some take an hour per round on
+            # this box, and a stage timeout / session cutoff mid-sweep must
+            # not cost the presets that already finished (same philosophy
+            # as bench.py's rolling partials).
+            _merge_presets(data, [rec])
+            _write_evidence(data, md_fatal=False)
 
-    # Atomic replace: a suite `timeout` kill mid-dump must not truncate the
-    # merged evidence file (a half-written RESULTS.json would silently drop
-    # the presets section on the next merge). Render-only mode regenerates
-    # the markdown alone — it measured nothing, so it must not rewrite the
-    # canonical evidence file.
-    if not render_only:
-        with open("RESULTS.json.tmp", "w") as f:
-            json.dump(data, f, indent=2)
-        os.replace("RESULTS.json.tmp", "RESULTS.json")
-    with open("RESULTS.md.tmp", "w") as f:
-        f.write(write_markdown(data))
-    os.replace("RESULTS.md.tmp", "RESULTS.md")
+    # Render-only mode regenerates the markdown alone — it measured
+    # nothing, so it must not rewrite the canonical evidence file. The
+    # preset path already persisted inside its loop.
+    if render_only:
+        _write_md(data)
+    elif convergence:
+        _write_evidence(data)
     ok = [r for r in data["presets"] + data["convergence"] if "error" not in r]
     print(json.dumps({"measured": len(ok)}))
 
