@@ -45,40 +45,15 @@ import time
 import numpy as np
 
 
-# bf16 peak FLOP/s by TPU generation (public spec sheets), for the MFU
-# estimate. Unknown device kinds report mfu=null rather than a guess.
-_PEAK_BF16 = {
-    "v4": 275e12,
-    "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
-    "v5p": 459e12, "v5": 459e12,
-    "v6e": 918e12, "v6 lite": 918e12, "trillium": 918e12,
-}
+# Peak-FLOPs lookup, cost_analysis plumbing, and the per-phase
+# {seconds, flops, mfu, images_per_s} records all come from
+# hefl_tpu.utils.roofline — the single source every measurement driver
+# shares (mfu_probe.py, profile_round.py, experiment.py).
+from hefl_tpu.utils import roofline
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
-
-
-def _peak_flops(device) -> float | None:
-    kind = getattr(device, "device_kind", "").lower()
-    for tag, peak in _PEAK_BF16.items():
-        if tag in kind:
-            return peak
-    return None
-
-
-def _program_flops(fn, *args) -> float | None:
-    """Analytic FLOPs of jit(fn)(*args) via XLA cost analysis."""
-    import jax
-
-    try:
-        cost = jax.jit(fn).lower(*args).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        return float(cost["flops"])
-    except Exception as e:  # cost analysis is advisory; never fail the bench
-        log(f"cost_analysis unavailable: {e}")
-        return None
 
 
 def _latest_tpu_bench() -> str | None:
@@ -161,6 +136,7 @@ def main() -> None:
     from hefl_tpu.ckks.keys import keygen
     from hefl_tpu.ckks.packing import PackSpec
     from hefl_tpu.data import iid_contiguous, stack_federated
+    from hefl_tpu.data.augment import backend_report as augment_backend_report
     from hefl_tpu.fl import (
         decrypt_average,
         evaluate,
@@ -203,20 +179,24 @@ def main() -> None:
 
     xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
 
-    # Analytic train FLOPs for the MFU estimate: fwd cost of one batch x 3
-    # (fwd + bwd ~= 3x fwd) x steps/epoch x epochs x clients.
-    n_tr = xs.shape[1] - int(xs.shape[1] * cfg.val_fraction)
-    steps_per_epoch = n_tr // cfg.batch_size
-    fwd_flops = _program_flops(
+    # Analytic train FLOPs for the MFU estimate: fwd cost of one fused
+    # batch x 3 (fwd + bwd ~= 3x fwd) x steps/epoch x epochs x clients.
+    # Batch geometry comes from the same helper _train_split uses, so the
+    # numerator cannot drift from what training actually runs.
+    from hefl_tpu.fl.client import train_batch_geometry
+
+    _, grp, steps_per_epoch = train_batch_geometry(cfg, int(xs.shape[1]))
+    fwd_flops = roofline.program_flops(
         lambda p, xb: module.apply({"params": p}, xb),
         params,
-        jnp.zeros((cfg.batch_size, *x.shape[1:]), jnp.float32),
+        jnp.zeros((grp, *x.shape[1:]), jnp.float32),
     )
-    train_flops = (
-        3.0 * fwd_flops * steps_per_epoch * cfg.epochs * num_clients
-        if fwd_flops
-        else None
+    if fwd_flops is None:
+        log("cost_analysis unavailable; MFU columns will be null")
+    train_flops = roofline.train_flops_per_round(
+        fwd_flops, steps_per_epoch, cfg.epochs, num_clients
     )
+    train_images_per_round = num_clients * cfg.epochs * steps_per_epoch * grp
 
     round_stats = []
     history = []
@@ -369,18 +349,33 @@ def main() -> None:
     # rate uses it.
     steady_round_s = float(np.min([s["total"] for s in warm])) if warm else None
     steady_train_s = float(np.min([s["train"] for s in warm])) if warm else None
-    peak = _peak_flops(dev)
-    mfu = (
-        train_flops / steady_train_s / peak
-        if (train_flops and steady_train_s and peak)
-        else None
-    )
+    steady_decrypt_s = float(np.min([s["decrypt"] for s in warm])) if warm else None
+    steady_eval_s = float(np.min([s["evaluate"] for s in warm])) if warm else None
+    # Per-phase roofline records (steady = min over warm rounds; falls back
+    # to the cold round when only one round ran, labeled by steady=null
+    # above). The train numerator is TRAIN math only — the fused program
+    # also encrypts+aggregates, so its MFU is a lower bound.
+    phase_roofline = {
+        "train+encrypt+aggregate": roofline.phase_stats(
+            steady_train_s if warm else cold["train"],
+            flops=train_flops, device=dev, images=train_images_per_round,
+        ),
+        "decrypt": roofline.phase_stats(
+            steady_decrypt_s if warm else cold["decrypt"], device=dev
+        ),
+        "evaluate": roofline.phase_stats(
+            steady_eval_s if warm else cold["evaluate"], device=dev,
+            images=len(xt),
+        ),
+    }
+    mfu = roofline.mfu(train_flops, steady_train_s, dev)
     log(
         f"cold round {cold['total']:.2f}s | warm mean "
         f"{warm_round_s and round(warm_round_s, 2)}s | steady "
         f"{steady_round_s and round(steady_round_s, 2)}s | "
         f"rounds/sec/chip {steady_round_s and round(1 / steady_round_s, 4)} | "
-        f"train MFU {mfu and round(mfu, 3)}"
+        f"train MFU {mfu and round(mfu, 3)} | train images/s "
+        f"{phase_roofline['train+encrypt+aggregate']['images_per_s']}"
     )
 
     print(
@@ -410,6 +405,12 @@ def main() -> None:
                 "rounds_per_sec_per_chip": steady_round_s
                 and round(1.0 / steady_round_s, 4),
                 "train_mfu": mfu and round(mfu, 4),
+                # Per-phase {seconds, flops, mfu, images_per_s} sourced
+                # from hefl_tpu.utils.roofline (steady-state values).
+                "phase_roofline": phase_roofline,
+                # Which augment row-shift backend the round programs traced
+                # with (incl. auto-selection micro-timings when in "auto").
+                "augment_backend": augment_backend_report(),
                 "device": getattr(dev, "device_kind", str(dev)),
                 "seed": seed,
                 # `accuracy` pairs with `value`: both are the round-0

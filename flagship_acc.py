@@ -134,7 +134,10 @@ def main() -> None:
             lambda s, x_, y_, k: local_train_epochs(module, cfg, gp, x_, y_, s, k)
         )(state, xs_b, ys_b, keys)
 
-    chunk = jax.jit(chunk_fn)
+    # Donate the ClientState carry: the chunked driver then holds ONE
+    # resident copy of the flagship-shape state instead of input+output
+    # (a no-op warning on backends without donation support, e.g. CPU).
+    chunk = jax.jit(chunk_fn, donate_argnums=(1,))
 
     tag = f"smoke_{seed}" if smoke else str(seed)
     state_path = f"flagship_state_{tag}"

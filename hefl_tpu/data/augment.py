@@ -4,33 +4,44 @@ The reference's training generator (/root/reference/FLPyfhelin.py:81-88)
 applies rescale=1/255, shear_range=0.2, zoom_range=0.2,
 horizontal_flip=True. Keras does this per-image on the host with PIL-style
 affine warps. A naive device port (`map_coordinates`) lowers to XLA's
-general 2-D gather — the TPU's slow path, ~6x the cost of the SGD step it
-feeds. Instead the affine warp here is decomposed into gather-free stages
-that all map onto the MXU / VPU:
+general 2-D gather — historically assumed to be the TPU's slow path — so
+the affine warp here is decomposed into stages that map onto MXU / VPU
+primitives:
 
   1. vertical zoom   — one-hot bilinear interpolation MATRIX per image,
                        applied as a batched matmul (two nonzeros per row;
                        building it is a broadcast compare, applying it is
                        256x256 @ 256x(W*C) on the MXU);
   2. shear           — a per-row fractional x-shift delta(y) = tan(s)/zx *
-                       (y-c), done as a spectral phase ramp: transform each
-                       row, rotate bin f by e^{2pi i f delta/W}, transform
-                       back. Two interchangeable backends (HEFL_AUG_SHIFT):
-                       XLA's native real FFT (default — O(W log W)/row) or
-                       constant cos/sin DFT matrices (MXU matmuls).
-                       Edge-padded so the circular wrap never touches real
-                       pixels (max |delta| < 33 at shear 0.2);
+                       (y-c). THREE interchangeable backends (see below);
   3. horizontal zoom + flip — one-hot matrix matmul like stage 1.
 
+Row-shift backends (`HEFL_AUG_SHIFT` / `TrainConfig.aug_backend`):
+
+  * ``gather``  — 1-D bilinear interpolation via `take_along_axis` along
+                  the width axis (an XLA gather on ONE axis, not the 2-D
+                  general gather). This is exactly Keras' bilinear kernel,
+                  convex (no overshoot, no clamp pass), and O(W) per row.
+                  Measured fastest everywhere tried so far (PROFILE.md:
+                  the FFT shear cost 120 ms/batch on CPU; this path is
+                  >20x cheaper at the same shape).
+  * ``fft``     — bandlimited (sinc) shift through XLA's native real FFT:
+                  transform each row, rotate bin f by e^{2pi i f delta/W},
+                  transform back. O(W log W) per row.
+  * ``dft``     — the same spectral shift as constant cos/sin DFT matrices
+                  (MXU matmuls), O(W·F) per row.
+  * ``auto``    — (default) one-shot micro-timing of the three backends at
+                  first use on the live backend; the winner is cached for
+                  the process and reported via `backend_report()` so bench
+                  artifacts can record the choice.
+
 The composite inverse map equals the reference's affine exactly
-(src_y = (y-c)/zy + c, src_x = tan(s)/zx*(y-c) + f/zx*(x-c) + c); only the
-x-interpolation kernel differs (bandlimited sinc via the DFT instead of
-bilinear). Sinc interpolation rings (Gibbs overshoot of a few percent at
-sharp edges), so the sheared rows are clamped back to each image's own
-value range — Keras' bilinear warp is range-preserving and ours must be
-too ([0,1] pixels stay [0,1]). Randomness semantics follow Keras: shear
-angle ~ U(-s, s) radians, zoom ~ U(1-z, 1+z) per axis, flip with
-probability 0.5.
+(src_y = (y-c)/zy + c, src_x = tan(s)/zx*(y-c) + f/zx*(x-c) + c). The
+gather backend interpolates bilinearly like Keras; the spectral backends
+interpolate with a bandlimited sinc, which rings at sharp edges (Gibbs), so
+their sheared rows are clamped back to each image's own value range.
+Randomness semantics follow Keras: shear angle ~ U(-s, s) radians,
+zoom ~ U(1-z, 1+z) per axis, flip with probability 0.5.
 """
 
 from __future__ import annotations
@@ -43,18 +54,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# Edge padding for the DFT shift. Must exceed the worst-case shear
+# Edge padding for the spectral shift. Must exceed the worst-case shear
 # displacement tan(shear)/zx * (H-1)/2 = tan(0.2)/0.8 * 127.5 = 32.3 px at
 # Keras-default ranges on 256x256, else the circular wrap leaks the opposite
-# edge into corner rows.
+# edge into corner rows. (The gather backend needs no padding: it clamps
+# sample positions to the row, which IS edge padding.)
 _PAD = 40
 
-# Row-shift backend: "fft" evaluates the same bandlimited shift through
-# XLA's native real FFT (O(W log W) per row — ~20x fewer FLOPs than the
-# matmul DFT at W=256 and the measured-faster path on TPU); "dft" is the
-# explicit cos/sin-matrix form (two MXU matmuls each way). Identical math,
-# different numerics at the float32 ulp level. HEFL_AUG_SHIFT overrides.
-_SHIFT_BACKEND = os.environ.get("HEFL_AUG_SHIFT", "fft")
+SHIFT_BACKENDS = ("gather", "fft", "dft")
+
+# Requested backend: "gather" / "fft" / "dft" pin one; "auto" (default)
+# micro-times the three at first use and caches the winner. HEFL_AUG_SHIFT
+# overrides globally; TrainConfig.aug_backend / random_augment(backend=...)
+# override per call site.
+_ENV_BACKEND = os.environ.get("HEFL_AUG_SHIFT", "auto")
+
+# One-shot auto-selection state (process-global so every trace of every
+# program in one process agrees on the backend). _LAST_RESOLVED tracks the
+# most recent resolution INCLUDING per-call pins (TrainConfig.aug_backend /
+# random_augment(backend=...)) so backend_report() describes what traced
+# programs actually use, not just the env/auto state.
+_AUTO_CHOICE: str | None = None
+_AUTO_TIMINGS_MS: dict[str, float] | None = None
+_LAST_RESOLVED: str | None = None
 
 
 def _lin_weights(src: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -124,25 +146,186 @@ def _shift_rows_fft(x: jnp.ndarray, delta: jnp.ndarray) -> jnp.ndarray:
     return out[:, :, _PAD : _PAD + w, :].astype(jnp.float32)
 
 
-def _shift_rows(x: jnp.ndarray, delta: jnp.ndarray) -> jnp.ndarray:
-    if _SHIFT_BACKEND == "dft":
-        return _shift_rows_dft(x, delta)
-    if _SHIFT_BACKEND == "fft":
-        return _shift_rows_fft(x, delta)
-    raise ValueError(f"HEFL_AUG_SHIFT={_SHIFT_BACKEND!r}: expected 'fft' or 'dft'")
+def _shift_rows_gather(x: jnp.ndarray, delta: jnp.ndarray) -> jnp.ndarray:
+    """x[b, y, n, c] -> x sampled at n + delta[b, y] along axis 2, BILINEAR
+    interpolation with edge clamping.
+
+    Two `take_along_axis` gathers on the width axis plus a lerp — the
+    integer-shift path the spectral machinery was standing in for. This is
+    Keras' exact interpolation kernel (ImageDataGenerator warps
+    bilinearly), it cannot overshoot the input range (convex combination),
+    and clamping the sample position to [0, W-1] reproduces the edge-pad
+    semantics of the spectral backends without materializing padding.
+    """
+    w = x.shape[2]
+    src = jnp.arange(w, dtype=jnp.float32)[None, None, :] + delta[:, :, None]
+    src = jnp.clip(src, 0.0, float(w - 1))
+    i0 = jnp.floor(src).astype(jnp.int32)
+    i1 = jnp.minimum(i0 + 1, w - 1)
+    frac = (src - i0.astype(jnp.float32))[..., None]
+    g0 = jnp.take_along_axis(x, i0[..., None], axis=2)
+    g1 = jnp.take_along_axis(x, i1[..., None], axis=2)
+    return (g0 * (1.0 - frac) + g1 * frac).astype(jnp.float32)
 
 
-@partial(jax.jit, static_argnames=("shear", "zoom", "flip"))
-def random_augment(
+def _affine_gather(
+    images: jnp.ndarray,
+    s: jnp.ndarray,
+    zx: jnp.ndarray,
+    zy: jnp.ndarray,
+    f: jnp.ndarray,
+) -> jnp.ndarray:
+    """The whole per-image affine (vertical zoom, shear, horizontal
+    zoom/flip) as TWO separable bilinear gather passes — no matmuls, no
+    spectra.
+
+    The inverse map is the same composite the staged pipeline implements
+    (src_y = (y-cy)/zy + cy; src_x = f/zx*(x-cx) + cx + tan(s)/zx*(y-cy)),
+    but sampled with ONE bilinear kernel per axis directly on the source —
+    which is exactly what Keras' ImageDataGenerator does, where the staged
+    path convolves two interpolation kernels in x (shear, then zoom).
+    Bilinear weights are convex, so no range clamp is needed.
+    """
+    b, h, w = images.shape[0], images.shape[1], images.shape[2]
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    yv = jnp.arange(h, dtype=jnp.float32)
+    xv = jnp.arange(w, dtype=jnp.float32)
+    # vertical zoom: gather rows at src_y = (y-cy)/zy + cy
+    src_y = jnp.clip((yv[None, :] - cy) / zy[:, None] + cy, 0, h - 1)
+    i0 = jnp.floor(src_y).astype(jnp.int32)
+    i1 = jnp.minimum(i0 + 1, h - 1)
+    fy = (src_y - i0.astype(jnp.float32))[:, :, None, None]
+    r0 = jnp.take_along_axis(images, i0[:, :, None, None], axis=1)
+    r1 = jnp.take_along_axis(images, i1[:, :, None, None], axis=1)
+    t1 = r0 * (1.0 - fy) + r1 * fy
+    # shear + horizontal zoom/flip fused into one x-gather:
+    # src_x(y, x) = f/zx*(x-cx) + cx + tan(s)/zx*(y-cy)
+    delta = (jnp.tan(s) / zx)[:, None] * (yv[None, :] - cy)          # [b, h]
+    hx = (f / zx)[:, None] * (xv[None, :] - cx) + cx                 # [b, w]
+    src_x = jnp.clip(hx[:, None, :] + delta[:, :, None], 0, w - 1)   # [b, h, w]
+    j0 = jnp.floor(src_x).astype(jnp.int32)
+    j1 = jnp.minimum(j0 + 1, w - 1)
+    fx = (src_x - j0.astype(jnp.float32))[..., None]
+    g0 = jnp.take_along_axis(t1, j0[..., None], axis=2)
+    g1 = jnp.take_along_axis(t1, j1[..., None], axis=2)
+    return (g0 * (1.0 - fx) + g1 * fx).astype(jnp.float32)
+
+
+_SHIFT_FNS = {
+    "gather": _shift_rows_gather,
+    "fft": _shift_rows_fft,
+    "dft": _shift_rows_dft,
+}
+
+# Micro-timing shape for auto-selection: one quarter of the flagship
+# training batch (32 x 256 x 256 x 3). Small enough to cost well under a
+# second on CPU, large enough that the backends' asymptotics separate.
+_PROBE_SHAPE = (8, 256, 256, 3)
+
+
+def _time_backend(fn, *args) -> float:
+    import time
+
+    jax.block_until_ready(fn(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _autoselect_backend() -> str:
+    """One-shot micro-timing of the full augment per backend on the live
+    device (the backends differ structurally — the gather path has no
+    matmul stages — so timing only the row shift would mis-rank them).
+
+    Runs the first time an auto-mode `random_augment` resolves — usually
+    WHILE an outer program (the client train step) is being traced. Under
+    an active trace a jitted call on concrete inputs is STAGED into the
+    outer jaxpr (it returns tracers; `block_until_ready` on a tracer is a
+    no-op), which would time tracing overhead (~1 ms flat, backend-blind)
+    instead of execution — so the probe runs inside
+    `jax.ensure_compile_time_eval()`, which forces real eager execution of
+    the concrete probe inputs regardless of trace context. The winner is
+    cached for the process; `backend_report()` exposes the choice +
+    timings for bench artifacts.
+    """
+    global _AUTO_CHOICE, _AUTO_TIMINGS_MS
+    if _AUTO_CHOICE is not None:
+        return _AUTO_CHOICE
+    with jax.ensure_compile_time_eval():
+        # The probe INPUTS must also be built inside the eval context: under
+        # an active trace `jax.random.key(0)` would stage and return a
+        # tracer key, and one tracer input keeps every probe call staged.
+        x = jnp.asarray(
+            np.random.default_rng(0).random(_PROBE_SHAPE, np.float32)
+        )
+        key = jax.random.key(0)
+        timings = {
+            name: _time_backend(
+                lambda k, im, bk=name: _random_augment(k, im, 0.2, 0.2, True, bk),
+                key, x,
+            )
+            for name in SHIFT_BACKENDS
+        }
+    _AUTO_TIMINGS_MS = {k: round(v * 1e3, 3) for k, v in timings.items()}
+    _AUTO_CHOICE = min(timings, key=timings.get)
+    return _AUTO_CHOICE
+
+
+def resolve_shift_backend(override: str | None = None) -> str:
+    """The backend a `random_augment` call will actually use.
+
+    Priority: explicit `override` (config / call site) > HEFL_AUG_SHIFT >
+    "auto". "auto" triggers the one-shot micro-timing.
+    """
+    global _LAST_RESOLVED
+    backend = override or _ENV_BACKEND or "auto"
+    if backend == "auto":
+        backend = _autoselect_backend()
+    elif backend not in SHIFT_BACKENDS:
+        raise ValueError(
+            f"augment shift backend {backend!r}: expected one of "
+            f"{SHIFT_BACKENDS + ('auto',)}"
+        )
+    _LAST_RESOLVED = backend
+    return backend
+
+
+def backend_report() -> dict:
+    """What the augment layer is running — for bench/profile artifacts.
+
+    `backend` is the most recent RESOLVED choice — per-call pins
+    (TrainConfig.aug_backend) included, so a driver that pins a backend
+    reports that backend, not the idle env/auto state. None before any
+    resolution this process. `auto_timings_ms` carries the micro-timing
+    that justified an auto choice, when one ran.
+    """
+    env = _ENV_BACKEND or "auto"
+    resolved = _LAST_RESOLVED or (
+        env if env in SHIFT_BACKENDS else _AUTO_CHOICE
+    )
+    return {
+        "requested": env,
+        "backend": resolved,
+        "auto_timings_ms": _AUTO_TIMINGS_MS,
+    }
+
+
+def _shift_rows(x: jnp.ndarray, delta: jnp.ndarray, backend: str) -> jnp.ndarray:
+    return _SHIFT_FNS[backend](x, delta)
+
+
+@partial(jax.jit, static_argnames=("shear", "zoom", "flip", "backend"))
+def _random_augment(
     key: jax.Array,
     images: jnp.ndarray,
-    shear: float = 0.2,
-    zoom: float = 0.2,
-    flip: bool = True,
+    shear: float,
+    zoom: float,
+    flip: bool,
+    backend: str,
 ) -> jnp.ndarray:
-    """Batch [B, H, W, C] float images -> augmented batch, one random
-    (shear, zoom, horizontal-flip) affine per image. Gather-free; see the
-    module docstring for the three-stage decomposition."""
     b, h, w = images.shape[0], images.shape[1], images.shape[2]
     k_shear, k_zx, k_zy, k_flip = jax.random.split(key, 4)
     s = jax.random.uniform(k_shear, (b,), minval=-shear, maxval=shear)
@@ -151,6 +334,10 @@ def random_augment(
     f = jnp.where(
         flip, jnp.sign(jax.random.uniform(k_flip, (b,)) - 0.5), jnp.ones((b,))
     )
+    if backend == "gather":
+        # The fused two-pass bilinear warp: no one-hot matmuls, no
+        # spectral shift — the whole affine is two axis gathers.
+        return _affine_gather(images, s, zx, zy, f)
     cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
     yv = jnp.arange(h, dtype=jnp.float32)
     xv = jnp.arange(w, dtype=jnp.float32)
@@ -164,11 +351,32 @@ def random_augment(
     delta = (jnp.tan(s) / zx)[:, None] * (yv[None, :] - cy)
     lo = jnp.min(t1, axis=(1, 2), keepdims=True)
     hi = jnp.max(t1, axis=(1, 2), keepdims=True)
-    t2 = jnp.clip(_shift_rows(t1, delta), lo, hi)
+    t2 = jnp.clip(_shift_rows(t1, delta, backend), lo, hi)
     # 3) horizontal zoom + flip: src_x = f/zx*(x-cx) + cx
     src_x = jnp.clip((f / zx)[:, None] * (xv[None, :] - cx) + cx, 0, w - 1)
     wx = _lin_weights(src_x, w)
     return jnp.einsum("bxu,byuc->byxc", wx, t2, preferred_element_type=jnp.float32)
+
+
+def random_augment(
+    key: jax.Array,
+    images: jnp.ndarray,
+    shear: float = 0.2,
+    zoom: float = 0.2,
+    flip: bool = True,
+    backend: str | None = None,
+) -> jnp.ndarray:
+    """Batch [B, H, W, C] float images -> augmented batch, one random
+    (shear, zoom, horizontal-flip) affine per image. See the module
+    docstring for the three-stage decomposition and the shift backends.
+
+    `backend` pins the row-shift backend for this call site (e.g. from
+    `TrainConfig.aug_backend`); None defers to HEFL_AUG_SHIFT / auto.
+    Backend resolution happens at trace time, so calls inside jitted code
+    (the client train step) resolve once per compiled program.
+    """
+    bk = resolve_shift_backend(backend)
+    return _random_augment(key, images, shear, zoom, flip, bk)
 
 
 def rescale(images: jnp.ndarray) -> jnp.ndarray:

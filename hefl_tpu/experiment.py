@@ -41,6 +41,7 @@ from hefl_tpu.fl import (
 from hefl_tpu.models import count_params, create_model
 from hefl_tpu.parallel import make_mesh
 from hefl_tpu.utils import PhaseTimer, load_checkpoint, save_checkpoint, save_params
+from hefl_tpu.utils import roofline
 
 
 @dataclasses.dataclass(frozen=True)
@@ -97,6 +98,35 @@ class ExperimentConfig:
     # distributed Gaussian noise INSIDE the encrypted round program. None
     # keeps the reference's HE-only behavior.
     dp: "DpConfig | None" = None
+
+
+def _train_roofline_inputs(module, params, train_cfg: TrainConfig,
+                           sample_shape, n_samples: int, num_clients: int):
+    """Per-round train FLOPs + image count for the roofline columns.
+
+    Batch geometry comes from `fl.client.train_batch_geometry` — the same
+    helper `_train_split` uses, so the numerator cannot drift from what
+    training runs. FLOPs are XLA's own `cost_analysis()` of one
+    fused-batch forward x3 (fwd+bwd ~= 3x fwd) — never a hand FLOP model.
+    -> (train_flops, images_per_round); (None, n) when the backend offers
+    no cost analysis.
+    """
+    import jax.numpy as jnp
+
+    from hefl_tpu.fl.client import train_batch_geometry
+
+    _, grp, steps = train_batch_geometry(train_cfg, int(n_samples))
+    if grp < 1:  # degenerate tiny client; no meaningful roofline
+        return None, 0
+    fwd = roofline.program_flops(
+        lambda p, xb: module.apply({"params": p}, xb),
+        params,
+        jnp.zeros((grp, *sample_shape), jnp.float32),
+    )
+    flops = roofline.train_flops_per_round(
+        fwd, steps, train_cfg.epochs, num_clients
+    )
+    return flops, num_clients * train_cfg.epochs * steps * grp
 
 
 def _partition(cfg: ExperimentConfig, y: np.ndarray) -> list[np.ndarray]:
@@ -168,9 +198,26 @@ def run_experiment(
             jax.block_until_ready(params)
         with timer.phase("evaluate"):
             results = evaluate(module, params, xt_d, yt)
+        dev = jax.devices()[0]
+        train_flops, train_images = _train_roofline_inputs(
+            module, params, train_cfg, x.shape[1:], len(x), 1
+        )
+        phases = timer.summary()
         record = {
             "round": 0,
-            "phases": timer.summary(),
+            "phases": phases,
+            # Per-phase {seconds, flops, mfu, images_per_s} sourced from
+            # hefl_tpu.utils.roofline — the same schema bench.py /
+            # profile_round.py artifacts carry.
+            "phase_roofline": {
+                "train": roofline.phase_stats(
+                    phases.get("train"), flops=train_flops, device=dev,
+                    images=train_images,
+                ),
+                "evaluate": roofline.phase_stats(
+                    phases.get("evaluate"), device=dev, images=len(xt)
+                ),
+            },
             "val_loss": [float(np.asarray(metrics)[-1, 0])],
             "val_acc": [float(np.asarray(metrics)[-1, 1])],
             **{k: float(results[k]) for k in ("accuracy", "precision", "recall", "f1")},
@@ -203,6 +250,15 @@ def run_experiment(
             raise ValueError("resume=True requires checkpoint_path")
         params, start_round, key, _ = load_checkpoint(cfg.checkpoint_path, params)
         say(f"resumed from {cfg.checkpoint_path} at round {start_round}")
+
+    dev = jax.devices()[0]
+    # Train-phase roofline inputs (geometry is per-configuration, so one
+    # cost-analysis compile serves every round).
+    train_flops, train_images = _train_roofline_inputs(
+        module, params, train_cfg, x.shape[1:], int(xs.shape[1]),
+        cfg.num_clients,
+    )
+    train_phase = "train+encrypt+aggregate" if cfg.encrypted else "train+aggregate"
 
     history: list[dict[str, Any]] = []
     for r in range(start_round, cfg.rounds):
@@ -238,6 +294,7 @@ def run_experiment(
         if profiling:
             jax.profiler.stop_trace()
             say(f"profiler trace written to {cfg.profile_dir}")
+        phases = timer.summary()
         record = {
             "round": r,
             **(
@@ -249,7 +306,29 @@ def run_experiment(
                 if cfg.dp is not None and cfg.encrypted
                 else {}
             ),
-            "phases": timer.summary(),
+            "phases": phases,
+            # Per-phase roofline record (same schema as bench.py /
+            # profile_round.py artifacts). The train numerator is TRAIN
+            # math only — the fused phase also encrypts+aggregates, so its
+            # MFU is a lower bound.
+            "phase_roofline": {
+                train_phase: roofline.phase_stats(
+                    phases.get(train_phase), flops=train_flops, device=dev,
+                    images=train_images,
+                ),
+                **(
+                    {
+                        "decrypt": roofline.phase_stats(
+                            phases.get("decrypt"), device=dev
+                        )
+                    }
+                    if cfg.encrypted
+                    else {}
+                ),
+                "evaluate": roofline.phase_stats(
+                    phases.get("evaluate"), device=dev, images=len(xt)
+                ),
+            },
             "val_loss": np.asarray(metrics)[:, -1, 0].tolist(),
             "val_acc": np.asarray(metrics)[:, -1, 1].tolist(),
             **{k: float(results[k]) for k in ("accuracy", "precision", "recall", "f1")},
@@ -284,8 +363,13 @@ def run_experiment(
         save_params(cfg.save_model_path, params)
         say(f"saved aggregated model to {cfg.save_model_path}")
 
+    from hefl_tpu.data.augment import backend_report
+
     return {
         "history": history,
         "final_metrics": history[-1] if history else None,
         "params": params,
+        # Which augment row-shift backend the round programs traced with
+        # (incl. auto-selection micro-timings when in "auto" mode).
+        "augment_backend": backend_report(),
     }

@@ -6,11 +6,29 @@ ReduceLROnPlateau(patience=2, factor=0.3, min_lr=1e-6)])`
 (/root/reference/FLPyfhelin.py:184-196). Keras callbacks are host-side
 mutable objects; here the whole local-training run — SGD steps, validation,
 early stopping, LR plateau, best-weight restore — is ONE pure function
-`local_train` built from `lax.scan`s, so it jits, vmaps across clients on a
+`local_train` built from `lax.scan`, so it jits, vmaps across clients on a
 device, and shard_maps across the mesh. Early stopping becomes masking
 (a stopped client's state is frozen through remaining epochs — lockstep
 cost, functional semantics), which is what lets 16 clients with different
 stopping epochs share one compiled program.
+
+Two scan layouts implement the identical math (`TrainConfig.flat_scan`):
+
+  * flat (default) — ONE steps-major scan over all E*S SGD steps, with the
+    per-epoch shuffles, augment keys, and the training labels' one-hot all
+    precomputed OUTSIDE the step body; validation + callback logic runs
+    under a `lax.cond` on the S-th step of each epoch. One scan body means
+    XLA optimizes a single step program (no nested-loop prologue per
+    epoch), and hoisting the index/one-hot work shrinks that body to the
+    conv/GEMM core.
+  * nested — the historical scan-over-epochs-of-scan-over-steps, kept so
+    the equivalence is a regression test (tests/test_perf.py) rather than
+    an article of faith.
+
+`TrainConfig.accum_steps > 1` fuses that many micro-batches into each
+optimizer step (one forward/backward over the union — the mean-loss
+gradient equals the mean of per-micro-batch gradients), feeding the MXU
+GEMMs `accum_steps`x larger without touching the Adam/decay update math.
 
 Also fixes (knowingly — SURVEY.md §2.5) the reference's quirk of carrying
 one model object across clients: every client here starts exactly from the
@@ -69,21 +87,47 @@ def init_client_state(global_params) -> ClientState:
     )
 
 
-def _epoch_step_fn(
-    module,
-    cfg: TrainConfig,
-    global_params,
-    x: jax.Array,
-    y: jax.Array,
-    track_best_acc: bool = True,
-):
-    """Build the pure per-epoch transition (SGD steps + validation +
-    callback logic) for one client's data. Shared by `local_train` (scan
-    over all epochs in one program) and `local_train_epochs` (scan over a
-    chunk of epochs from a checkpointed carry)."""
+@dataclasses.dataclass(frozen=True)
+class _TrainSplit:
+    """Static geometry + split views of one client's data (host-side)."""
+
+    x_tr: jax.Array
+    y_tr: jax.Array
+    x_va: jax.Array
+    onehot_va: jax.Array
+    n_tr: int
+    grp: int        # samples consumed per optimizer step (bs * accum)
+    steps: int      # optimizer steps per epoch
+
+
+def train_batch_geometry(cfg: TrainConfig, n_samples: int) -> tuple[int, int, int]:
+    """Static geometry of one client's local-train scan at `n_samples`
+    samples: -> (n_tr, grp, steps). `grp` is samples consumed per
+    optimizer step (batch_size x clamped accum_steps), `steps` is
+    optimizer steps per epoch. The SINGLE source shared by `_train_split`
+    and every roofline/MFU driver (bench.py, profile_round.py,
+    experiment.py) so FLOP/images-per-second numerators cannot drift from
+    the geometry training actually runs. Returns (n_tr, 0, 0) when the
+    client is too small to train (n_tr < 1) — `_train_split` raises on
+    that, drivers should not feed it.
+    """
+    n_val = max(int(n_samples * cfg.val_fraction), 1) if cfg.val_fraction > 0 else 0
+    n_tr = n_samples - n_val
+    if n_tr < 1:
+        return n_tr, 0, 0
+    bs = min(cfg.batch_size, n_tr)
+    # accum_steps fuses micro-batches into one optimizer step; clamp so a
+    # small client still takes at least one step per epoch.
+    accum = max(1, min(int(cfg.accum_steps), n_tr // bs))
+    grp = bs * accum
+    steps = max(n_tr // grp, 1)
+    return n_tr, grp, steps
+
+
+def _train_split(cfg: TrainConfig, x: jax.Array, y: jax.Array) -> _TrainSplit:
     m = int(x.shape[0])
-    n_val = max(int(m * cfg.val_fraction), 1) if cfg.val_fraction > 0 else 0
-    n_tr = m - n_val
+    n_tr, grp, steps = train_batch_geometry(cfg, m)
+    n_val = m - n_tr
     if n_tr < 1:
         raise ValueError(
             f"client has {m} sample(s); needs >= 2 to carve out a validation "
@@ -97,18 +141,95 @@ def _epoch_step_fn(
     else:  # degenerate config: validate on the train slice
         x_va, y_va = x_tr, y_tr
     onehot_va = jax.nn.one_hot(y_va, cfg.num_classes, dtype=jnp.float32)
-    bs = min(cfg.batch_size, n_tr)
-    steps = max(n_tr // bs, 1)
+    return _TrainSplit(
+        x_tr=x_tr, y_tr=y_tr, x_va=x_va, onehot_va=onehot_va,
+        n_tr=n_tr, grp=grp, steps=steps,
+    )
 
-    def train_step(carry, inp):
-        params, opt, lr_scale = carry
-        idx, k_aug = inp
-        xb = rescale(x_tr[idx])
+
+def _epoch_update(
+    cfg: TrainConfig,
+    state: ClientState,
+    params,
+    opt,
+    val_loss: jax.Array,
+    val_acc: jax.Array,
+    track_best_acc: bool,
+):
+    """The pure Keras-callback transition at an epoch boundary: given the
+    end-of-epoch weights and validation metrics, produce the next
+    ClientState and the epoch's metrics row [val_loss, val_acc, lr_scale,
+    stopped]. Shared verbatim by the flat and nested scan layouts so their
+    selection semantics (early-stop / plateau / restore) cannot drift."""
+    frozen = state.stopped  # already stopped before this epoch
+    loss_improved = val_loss < state.best_val_loss - cfg.min_delta
+    acc_improved = val_acc > state.best_val_acc
+    wait_es = jnp.where(loss_improved, 0, state.wait_es + 1)
+    wait_pl = jnp.where(loss_improved, 0, state.wait_plateau + 1)
+    plateau = wait_pl >= cfg.plateau_patience
+    lr_floor = cfg.min_lr / cfg.lr if cfg.lr > 0 else 0.0
+    lr_scale = jnp.where(
+        plateau,
+        jnp.maximum(state.lr_scale * cfg.plateau_factor, lr_floor),
+        state.lr_scale,
+    )
+    wait_pl = jnp.where(plateau, 0, wait_pl)
+    stopped_now = wait_es >= cfg.es_patience
+
+    pick = lambda new, old: jax.tree_util.tree_map(  # noqa: E731
+        lambda a, b: jnp.where(frozen, b, a), new, old
+    )
+    sel = lambda new, old: jnp.where(frozen, old, new)  # noqa: E731
+    take_best = jnp.logical_and(acc_improved, jnp.logical_not(frozen))
+    take_best_loss = jnp.logical_and(loss_improved, jnp.logical_not(frozen))
+    new_state = ClientState(
+        params=pick(params, state.params),
+        opt=pick(opt, state.opt),
+        lr_scale=sel(lr_scale, state.lr_scale),
+        # best-by-accuracy (ModelCheckpoint) is only ever read by the
+        # centralized train_server path; clients skip the per-epoch
+        # full-tree select (track_best_acc=False -> XLA DCEs the copy).
+        best_params=(
+            jax.tree_util.tree_map(
+                lambda a, b: jnp.where(take_best, a, b),
+                params, state.best_params,
+            )
+            if track_best_acc
+            else state.best_params
+        ),
+        best_loss_params=jax.tree_util.tree_map(
+            lambda a, b: jnp.where(take_best_loss, a, b),
+            params, state.best_loss_params,
+        ),
+        best_val_acc=sel(jnp.maximum(val_acc, state.best_val_acc), state.best_val_acc),
+        best_val_loss=sel(
+            jnp.minimum(val_loss, state.best_val_loss), state.best_val_loss
+        ),
+        wait_es=sel(wait_es, state.wait_es),
+        wait_plateau=sel(wait_pl, state.wait_plateau),
+        stopped=jnp.logical_or(frozen, stopped_now),
+    )
+    metrics = jnp.stack(
+        [val_loss, val_acc, new_state.lr_scale, new_state.stopped.astype(jnp.float32)]
+    )
+    return new_state, metrics
+
+
+def _make_train_step(module, cfg: TrainConfig, global_params, sp: _TrainSplit):
+    """The SGD micro-step shared by both scan layouts: gather a batch by
+    precomputed indices, augment, grad, Adam. `oh_tr` (the training
+    labels' one-hot, materialized once outside the scan) is closed over so
+    the step body gathers rows instead of re-encoding labels per step."""
+    oh_tr = jax.nn.one_hot(sp.y_tr, cfg.num_classes, dtype=jnp.float32)
+
+    def train_step(params, opt, lr_scale, idx, k_aug):
+        xb = rescale(sp.x_tr[idx])
         if cfg.augment:
             xb = random_augment(
-                k_aug, xb, shear=cfg.aug_shear, zoom=cfg.aug_zoom, flip=cfg.aug_flip
+                k_aug, xb, shear=cfg.aug_shear, zoom=cfg.aug_zoom,
+                flip=cfg.aug_flip, backend=cfg.aug_backend,
             )
-        oh = jax.nn.one_hot(y_tr[idx], cfg.num_classes, dtype=jnp.float32)
+        oh = oh_tr[idx]
         grads, (ce, acc) = jax.grad(
             lambda p: loss_fn(module, p, xb, oh, global_params, cfg.prox_mu),
             has_aux=True,
@@ -117,79 +238,119 @@ def _epoch_step_fn(
             grads, opt, params, cfg.lr, cfg.lr_decay, lr_scale,
             warmup_steps=cfg.warmup_steps,
         )
+        return params, opt, (ce, acc)
+
+    return train_step
+
+
+def _epoch_streams(epoch_keys: jax.Array, sp: _TrainSplit):
+    """Per-epoch shuffles + augment keys, derived EXACTLY as the nested
+    layout derives them inside its epoch body (split -> permutation /
+    per-step aug keys), but materialized up front: -> (perms [E, S, grp],
+    aug_keys [E, S])."""
+    ks = jax.vmap(jax.random.split)(epoch_keys)          # [E, 2]
+    k_perm, k_aug = ks[:, 0], ks[:, 1]
+    perms = jax.vmap(
+        lambda k: jax.random.permutation(k, sp.n_tr)[
+            : sp.steps * sp.grp
+        ].reshape(sp.steps, sp.grp)
+    )(k_perm)
+    aug_keys = jax.vmap(lambda k: jax.random.split(k, sp.steps))(k_aug)
+    return perms, aug_keys
+
+
+def _local_train_epochs_flat(
+    module, cfg: TrainConfig, global_params, x, y,
+    state: ClientState, epoch_keys, track_best_acc: bool,
+):
+    """ONE steps-major scan over all E*S SGD steps. Validation + callback
+    logic fires under a `lax.cond` on each epoch's final step (the cond
+    predicate is an unbatched function of the step index, so it stays a
+    real branch — no validation cost on interior steps — even under the
+    cross-client vmap)."""
+    sp = _train_split(cfg, x, y)
+    e = int(epoch_keys.shape[0])
+    perms, aug_keys = _epoch_streams(epoch_keys, sp)
+    flat_perm = perms.reshape(e * sp.steps, sp.grp)
+    flat_aug = aug_keys.reshape(e * sp.steps)
+    is_end = (jnp.arange(e * sp.steps) % sp.steps) == sp.steps - 1
+    train_step = _make_train_step(module, cfg, global_params, sp)
+
+    def flat_step(carry, inp):
+        params_run, opt_run, st = carry
+        idx, k_aug, end = inp
+        params_run, opt_run, _ = train_step(
+            params_run, opt_run, st.lr_scale, idx, k_aug
+        )
+
+        def boundary(p, o, s0):
+            frozen = s0.stopped
+            # Evaluate the params this epoch actually keeps: a stopped
+            # client's phantom-trained weights are discarded by
+            # _epoch_update, so its reported val metrics must come from
+            # the frozen weights.
+            eval_params = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(frozen, old, new), p, s0.params
+            )
+            val_loss, val_acc = _eval_metrics(
+                module, eval_params, sp.x_va, sp.onehot_va
+            )
+            ns, mets = _epoch_update(
+                cfg, s0, p, o, val_loss, val_acc, track_best_acc
+            )
+            # The next epoch's steps restart from the state the callbacks
+            # kept (frozen weights for a stopped client) — exactly the
+            # nested layout's "inner scan starts from state.params".
+            return ns.params, ns.opt, ns, mets
+
+        def interior(p, o, s0):
+            return p, o, s0, jnp.zeros((4,), jnp.float32)
+
+        params_run, opt_run, st, mets = jax.lax.cond(
+            end, boundary, interior, params_run, opt_run, st
+        )
+        return (params_run, opt_run, st), mets
+
+    (_, _, final), mets = jax.lax.scan(
+        flat_step, (state.params, state.opt, state), (flat_perm, flat_aug, is_end)
+    )
+    return final, mets[sp.steps - 1 :: sp.steps]
+
+
+def _local_train_epochs_nested(
+    module, cfg: TrainConfig, global_params, x, y,
+    state: ClientState, epoch_keys, track_best_acc: bool,
+):
+    """The historical nested layout: scan over epochs, each epoch scanning
+    its steps and deriving its shuffle inside the body. Kept behind
+    `flat_scan=False` as the semantics reference for the flat layout."""
+    sp = _train_split(cfg, x, y)
+    train_step = _make_train_step(module, cfg, global_params, sp)
+
+    def scan_step(carry, inp):
+        params, opt, lr_scale = carry
+        idx, k_aug = inp
+        params, opt, (ce, acc) = train_step(params, opt, lr_scale, idx, k_aug)
         return (params, opt, lr_scale), (ce, acc)
 
-    def epoch_step(state: ClientState, k_epoch):
+    def epoch_step(st: ClientState, k_epoch):
         k_perm, k_aug = jax.random.split(k_epoch)
-        perm = jax.random.permutation(k_perm, n_tr)[: steps * bs].reshape(steps, bs)
-        aug_keys = jax.random.split(k_aug, steps)
+        perm = jax.random.permutation(k_perm, sp.n_tr)[
+            : sp.steps * sp.grp
+        ].reshape(sp.steps, sp.grp)
+        aug_keys = jax.random.split(k_aug, sp.steps)
         (params, opt, _), _ = jax.lax.scan(
-            train_step, (state.params, state.opt, state.lr_scale), (perm, aug_keys)
+            scan_step, (st.params, st.opt, st.lr_scale), (perm, aug_keys)
         )
-        frozen = state.stopped  # already stopped before this epoch
-        # Evaluate the params this epoch actually keeps: a stopped client's
-        # phantom-trained weights are discarded below, so its reported val
-        # metrics must come from the frozen weights (they stay constant at
-        # the stop-epoch values, consistent with the lr/stopped columns).
+        frozen = st.stopped
         eval_params = jax.tree_util.tree_map(
-            lambda new, old: jnp.where(frozen, old, new), params, state.params
+            lambda new, old: jnp.where(frozen, old, new), params, st.params
         )
-        val_loss, val_acc = _eval_metrics(module, eval_params, x_va, onehot_va)
+        val_loss, val_acc = _eval_metrics(module, eval_params, sp.x_va, sp.onehot_va)
+        return _epoch_update(cfg, st, params, opt, val_loss, val_acc,
+                             track_best_acc)
 
-        # --- callback logic (pure) ---
-        loss_improved = val_loss < state.best_val_loss - cfg.min_delta
-        acc_improved = val_acc > state.best_val_acc
-        wait_es = jnp.where(loss_improved, 0, state.wait_es + 1)
-        wait_pl = jnp.where(loss_improved, 0, state.wait_plateau + 1)
-        plateau = wait_pl >= cfg.plateau_patience
-        lr_floor = cfg.min_lr / cfg.lr if cfg.lr > 0 else 0.0
-        lr_scale = jnp.where(
-            plateau,
-            jnp.maximum(state.lr_scale * cfg.plateau_factor, lr_floor),
-            state.lr_scale,
-        )
-        wait_pl = jnp.where(plateau, 0, wait_pl)
-        stopped_now = wait_es >= cfg.es_patience
-
-        pick = lambda new, old: jax.tree_util.tree_map(  # noqa: E731
-            lambda a, b: jnp.where(frozen, b, a), new, old
-        )
-        sel = lambda new, old: jnp.where(frozen, old, new)  # noqa: E731
-        take_best = jnp.logical_and(acc_improved, jnp.logical_not(frozen))
-        take_best_loss = jnp.logical_and(loss_improved, jnp.logical_not(frozen))
-        new_state = ClientState(
-            params=pick(params, state.params),
-            opt=pick(opt, state.opt),
-            lr_scale=sel(lr_scale, state.lr_scale),
-            # best-by-accuracy (ModelCheckpoint) is only ever read by the
-            # centralized train_server path; clients skip the per-epoch
-            # full-tree select (track_best_acc=False -> XLA DCEs the copy).
-            best_params=(
-                jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(take_best, a, b),
-                    params, state.best_params,
-                )
-                if track_best_acc
-                else state.best_params
-            ),
-            best_loss_params=jax.tree_util.tree_map(
-                lambda a, b: jnp.where(take_best_loss, a, b),
-                params, state.best_loss_params,
-            ),
-            best_val_acc=sel(jnp.maximum(val_acc, state.best_val_acc), state.best_val_acc),
-            best_val_loss=sel(
-                jnp.minimum(val_loss, state.best_val_loss), state.best_val_loss
-            ),
-            wait_es=sel(wait_es, state.wait_es),
-            wait_plateau=sel(wait_pl, state.wait_plateau),
-            stopped=jnp.logical_or(frozen, stopped_now),
-        )
-        metrics = jnp.stack(
-            [val_loss, val_acc, new_state.lr_scale, new_state.stopped.astype(jnp.float32)]
-        )
-        return new_state, metrics
-
-    return epoch_step
+    return jax.lax.scan(epoch_step, state, epoch_keys)
 
 
 def local_train_epochs(
@@ -208,12 +369,25 @@ def local_train_epochs(
     afford the full `cfg.epochs` in one process slices the precomputed
     per-epoch key array, checkpoints the returned ClientState between
     invocations, and ends with exactly the same callback semantics
-    (`client_shipped_params(state)` is the client-upload restore).
+    (`client_shipped_params(state)` is the client-upload restore). Jit
+    with the state donated (`local_train_epochs_jit`, or
+    `donate_argnums` on your own wrapper) so the chunked driver holds ONE
+    resident copy of the carry instead of input+output.
     -> (state, metrics f32[len(epoch_keys), 4]).
     """
-    epoch_step = _epoch_step_fn(module, cfg, global_params, x, y,
-                                track_best_acc=track_best_acc)
-    return jax.lax.scan(epoch_step, state, epoch_keys)
+    impl = (
+        _local_train_epochs_flat if cfg.flat_scan else _local_train_epochs_nested
+    )
+    return impl(module, cfg, global_params, x, y, state, epoch_keys,
+                track_best_acc)
+
+
+# Donated jitted entry for chunk-resume drivers: the incoming ClientState
+# buffers are reused for the outgoing ones (on backends that support
+# donation), halving the carry's resident footprint at flagship shapes.
+local_train_epochs_jit = partial(
+    jax.jit, static_argnums=(0, 1, 7), donate_argnums=(5,)
+)(local_train_epochs)
 
 
 def client_shipped_params(state: ClientState):

@@ -31,4 +31,21 @@ class TrainConfig:
     aug_shear: float = 0.2
     aug_zoom: float = 0.2
     aug_flip: bool = True
+    # Row-shift backend for the augment affine ("gather" | "fft" | "dft");
+    # None defers to HEFL_AUG_SHIFT / per-device auto-selection
+    # (data.augment.resolve_shift_backend).
+    aug_backend: str | None = None
     num_classes: int = 2
+    # Micro-batch accumulation: each optimizer step runs ONE fused
+    # forward/backward over `accum_steps` micro-batches of `batch_size`
+    # (mean loss over the union == mean of per-micro-batch gradients), so
+    # the MXU sees GEMMs `accum_steps`x larger. The Adam/decay update math
+    # is untouched; the schedule just advances once per fused batch, so
+    # >1 trades optimizer steps for arithmetic intensity (documented in
+    # README "Perf knobs"). 1 reproduces the reference exactly.
+    accum_steps: int = 1
+    # Steps-major flattened local-training scan (one scan over E*S steps,
+    # permutations/one-hot hoisted out of the step body) vs the historical
+    # nested scan-of-scans. Same math, same RNG stream; the flag exists so
+    # the equivalence stays testable (tests/test_perf.py).
+    flat_scan: bool = True

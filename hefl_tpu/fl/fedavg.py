@@ -21,13 +21,17 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from hefl_tpu.data.augment import rescale
 from hefl_tpu.fl.client import local_train
 from hefl_tpu.fl.config import TrainConfig
-from hefl_tpu.parallel import client_axes, client_mesh_size, pmean_tree
+from hefl_tpu.parallel import (
+    client_axes,
+    client_mesh_size,
+    pmean_tree,
+    shard_map,
+)
 
 
 def vmapped_train(module, cfg: TrainConfig, gp, x_blk, y_blk, k_blk):

@@ -26,7 +26,6 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from hefl_tpu.ckks import encoding, ops
@@ -36,7 +35,12 @@ from hefl_tpu.ckks.packing import PackSpec, pack_pytree, unpack_blocks
 from hefl_tpu.fl.config import TrainConfig
 from hefl_tpu.fl.fedavg import replicate_on, vmapped_train
 from hefl_tpu.ckks.modular import add_mod as modular_add_mod
-from hefl_tpu.parallel import client_axes, client_mesh_size, pmean_tree
+from hefl_tpu.parallel import (
+    client_axes,
+    client_mesh_size,
+    pmean_tree,
+    shard_map,
+)
 from hefl_tpu.parallel.collectives import MAX_PSUM_CLIENTS, hierarchical_psum_mod
 
 

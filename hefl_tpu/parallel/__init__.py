@@ -18,6 +18,7 @@ from hefl_tpu.parallel.mesh import (
     local_client_count,
     make_host_mesh,
     make_mesh,
+    shard_map,
 )
 from hefl_tpu.parallel.collectives import (
     hierarchical_psum_mod,
@@ -33,6 +34,7 @@ __all__ = [
     "client_mesh_size",
     "make_mesh",
     "make_host_mesh",
+    "shard_map",
     "local_client_count",
     "psum_mod",
     "pmean_tree",

@@ -22,6 +22,27 @@ CLIENT_AXIS = "clients"
 HOST_AXIS = "hosts"
 
 
+def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
+    """Version-portable `shard_map`: jax >= 0.5 exports it at top level
+    with `check_vma`; 0.4.x has it under `jax.experimental` with the same
+    knob named `check_rep`; the releases in between export it at top level
+    but still spell the knob `check_rep`. Every round program builds
+    through here."""
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    for kwarg in ("check_vma", "check_rep"):
+        try:
+            return _shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **{kwarg: check_vma},
+            )
+        except TypeError:  # this jax spells the replication-check knob
+            continue       # the other way
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
 def client_axes(mesh: Mesh) -> tuple[str, ...]:
     """Mesh axes the federated client dimension shards over (outer-first:
     hosts, then clients on a 2-D mesh)."""

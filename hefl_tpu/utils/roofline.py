@@ -1,0 +1,149 @@
+"""Roofline / MFU accounting shared by every measurement driver.
+
+Before this module each driver carried its own copy of the peak-FLOPs
+table and its own `cost_analysis()` plumbing (`bench.py._PEAK_BF16`,
+`mfu_probe.PEAK_FLOPS`), and `profile_round.py` attributed phase cost by
+raw subtraction across separately-compiled programs — which on sub-second
+rounds produced NEGATIVE rows (PROFILE.md's −17.7% validation row). This
+module is the single source for:
+
+  * the bf16 peak-FLOPs table by device kind (public spec sheets), with a
+    clearly-labeled CPU placeholder so smoke artifacts carry comparable
+    (shape-meaningful, absolute-meaningless) MFU columns instead of nulls;
+  * `program_flops` — XLA's own `cost_analysis()['flops']` off a lowered/
+    compiled program (never a hand FLOP model);
+  * `phase_stats` — the {seconds, flops, mfu, images_per_s} record every
+    BENCH/PROFILE artifact embeds per phase;
+  * `clamp_attribution` — ablation-subtraction deltas clamped at 0 with an
+    explicit `attribution_unreliable` flag when any raw delta was negative
+    (a negative delta means the two program variants fused differently and
+    the subtraction is noise, not a credit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+# bf16 peak FLOP/s by TPU generation (public spec sheets). Substring match
+# against `device_kind`, most-specific first.
+PEAK_BF16_FLOPS: dict[str, float] = {
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+    "trillium": 918e12,
+    "v4": 275e12,
+    "v5": 459e12,
+}
+
+# Order-of-magnitude CPU placeholder (one AVX-512 core-ish). Absolute MFU
+# against it is meaningless — only batch-scaling shape and phase ratios
+# are — so every record derived from it carries `peak_is_placeholder`.
+CPU_PLACEHOLDER_FLOPS = 1e11
+
+
+def device_kind(device: Any) -> str:
+    """Best-effort device-kind string for any JAX device (or a str)."""
+    if isinstance(device, str):
+        return device
+    return str(getattr(device, "device_kind", device))
+
+
+def peak_flops(device: Any) -> tuple[float | None, bool]:
+    """-> (peak bf16 FLOP/s, is_placeholder). None when the device kind is
+    unknown and not a CPU (never guess a real accelerator's peak)."""
+    kind = device_kind(device).lower()
+    for tag, peak in PEAK_BF16_FLOPS.items():
+        if tag in kind:
+            return peak, False
+    if "cpu" in kind or kind in ("", "none"):
+        return CPU_PLACEHOLDER_FLOPS, True
+    return None, False
+
+
+def program_flops(fn=None, *args, compiled=None) -> float | None:
+    """Analytic FLOPs via XLA cost analysis.
+
+    Either pass a callable + example args (jit-lowered here) or a
+    pre-compiled executable via `compiled=` (avoids a second compile when
+    the caller already AOT-compiled the step). Returns None when the PJRT
+    backend offers no cost analysis — advisory, never raises.
+    """
+    import jax
+
+    try:
+        if compiled is None:
+            compiled = jax.jit(fn).lower(*args).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost["flops"]) if cost else None
+    except Exception:
+        return None
+
+
+def mfu(flops: float | None, seconds: float | None, device: Any) -> float | None:
+    """Model FLOPs utilization: program FLOPs / wall seconds / device peak."""
+    peak, _ = peak_flops(device)
+    if not flops or not seconds or not peak:
+        return None
+    return flops / seconds / peak
+
+
+def phase_stats(
+    seconds: float | None,
+    flops: float | None = None,
+    device: Any = None,
+    images: int | None = None,
+) -> dict[str, Any]:
+    """One phase's roofline record: the unit every BENCH/PROFILE artifact
+    embeds. Fields are always PRESENT (null when not computable) so
+    downstream checkers can demand the schema without demanding hardware."""
+    peak, placeholder = peak_flops(device) if device is not None else (None, False)
+    rec: dict[str, Any] = {
+        "seconds": round(seconds, 4) if seconds is not None else None,
+        "flops": flops,
+        "mfu": (
+            round(flops / seconds / peak, 5)
+            if (flops and seconds and peak)
+            else None
+        ),
+        "images_per_s": (
+            round(images / seconds, 2) if (images and seconds) else None
+        ),
+    }
+    if placeholder and rec["mfu"] is not None:
+        rec["peak_is_placeholder"] = True
+    return rec
+
+
+def train_flops_per_round(
+    fwd_flops: float | None,
+    steps_per_epoch: int,
+    epochs: int,
+    num_clients: int,
+    bwd_multiplier: float = 3.0,
+) -> float | None:
+    """Analytic train FLOPs of one FL round from one batch's forward cost
+    (fwd + bwd ~= 3x fwd, the standard rule used by every driver here)."""
+    if not fwd_flops:
+        return None
+    return bwd_multiplier * fwd_flops * steps_per_epoch * epochs * num_clients
+
+
+def clamp_attribution(
+    raw: Mapping[str, float]
+) -> tuple[dict[str, float], bool]:
+    """Clamp ablation-subtraction phase deltas at 0.
+
+    -> (clamped rows, unreliable). `unreliable` is True when ANY raw delta
+    was negative: the variants fused differently enough that subtraction
+    stopped measuring the ablated stage, so the whole attribution must be
+    flagged, not just the offending row. Callers keep the raw values
+    alongside (suffix `_raw`) so the artifact stays auditable.
+    """
+    clamped = {k: max(0.0, float(v)) for k, v in raw.items()}
+    unreliable = any(float(v) < 0.0 for v in raw.values())
+    return clamped, unreliable

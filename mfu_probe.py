@@ -3,9 +3,12 @@
 VERDICT r3 next #7 asks either for a measured speedup of the steady train
 phase or a trace-backed explanation of why MFU sits near 0.02. This harness
 answers it directly: it times ONE jitted train step (grad + Adam, the exact
-math `fl/client.py:train_step` runs inside its lax.scan) across a batch-size
-ladder and reports images/s and MFU per point, using XLA's own
+math `fl/client.py`'s train step runs inside its lax.scan) across a
+batch-size ladder and reports images/s and MFU per point, using XLA's own
 `cost_analysis()['flops']` for the numerator rather than a hand FLOP model.
+Peak-FLOPs lookup and the MFU arithmetic come from
+`hefl_tpu.utils.roofline` — the same module every bench/profile artifact
+sources its MFU columns from.
 
 The diagnostic logic: the reference trains at batch 32
 (/root/reference/FLPyfhelin.py:184-196 via model.fit defaults in the driver).
@@ -27,22 +30,6 @@ import time
 
 import numpy as np
 
-# TPU v5e (lite) peak bf16 throughput, FLOP/s — for the absolute-MFU column.
-PEAK_FLOPS = {"TPU v5 lite": 394e12 / 2, "cpu": 1e11}
-
-
-def _peak(device_kind: str) -> float:
-    for k, v in PEAK_FLOPS.items():
-        if k.lower() in device_kind.lower():
-            return v
-    print(
-        f"WARNING: no peak-FLOPs entry for device kind {device_kind!r}; "
-        "using the CPU placeholder — absolute MFU values are meaningless, "
-        "only the batch-scaling shape is",
-        file=sys.stderr,
-    )
-    return PEAK_FLOPS["cpu"]
-
 
 def main() -> None:
     smoke = os.environ.get("MFU_SMOKE") == "1"
@@ -55,16 +42,25 @@ def main() -> None:
 
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")
 
-    from hefl_tpu.data.augment import random_augment, rescale
+    from hefl_tpu.data.augment import backend_report, random_augment, rescale
     from hefl_tpu.fl.config import TrainConfig
     from hefl_tpu.fl.loss import loss_fn
     from hefl_tpu.fl.optimizer import adam_init, adam_update
     from hefl_tpu.models.cnn import MedCNN
+    from hefl_tpu.utils import roofline
 
     dev = jax.devices()[0]
-    kind = getattr(dev, "device_kind", str(dev))
-    peak = _peak(kind)
-    print(f"device: {kind} (peak bf16 ~{peak / 1e12:.0f} TFLOP/s)", file=sys.stderr)
+    kind = roofline.device_kind(dev)
+    peak, placeholder = roofline.peak_flops(dev)
+    if placeholder:
+        print(
+            f"WARNING: CPU-placeholder peak for device kind {kind!r} — "
+            "absolute MFU values are meaningless, only the batch-scaling "
+            "shape is",
+            file=sys.stderr,
+        )
+    print(f"device: {kind} (peak bf16 ~{(peak or 0) / 1e12:.0f} TFLOP/s)",
+          file=sys.stderr)
 
     module = MedCNN()
     cfg = TrainConfig()
@@ -101,8 +97,7 @@ def main() -> None:
             .lower(params, opt, x_u8, y, key)
             .compile()
         )
-        # cost_analysis() may be None on nonstandard PJRT backends
-        flops = float((compiled.cost_analysis() or {}).get("flops", 0.0))
+        flops = roofline.program_flops(compiled=compiled) or 0.0
         jstep = compiled
 
         p, o = jax.tree_util.tree_map(jnp.copy, (params, opt))
@@ -121,7 +116,7 @@ def main() -> None:
                 "step_ms": round(dt * 1e3, 3),
                 "images_per_s": round(bs / dt, 1),
                 "xla_flops": flops,
-                "mfu": round(flops / dt / peak, 4),
+                "mfu": round(roofline.mfu(flops, dt, dev) or 0.0, 4),
             }
         )
         print(f"  batch {bs}: {dt * 1e3:.2f} ms", file=sys.stderr)
@@ -144,8 +139,18 @@ def main() -> None:
     )
     print(f"\nverdict: {verdict}")
     with open("mfu_probe.json", "w") as f:
-        json.dump({"device": kind, "peak_flops": peak, "rows": rows,
-                   "verdict": verdict}, f, indent=2)
+        json.dump(
+            {
+                "device": kind,
+                "peak_flops": peak,
+                "peak_is_placeholder": placeholder,
+                "augment_backend": backend_report(),
+                "rows": rows,
+                "verdict": verdict,
+            },
+            f,
+            indent=2,
+        )
 
 
 if __name__ == "__main__":

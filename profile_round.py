@@ -21,19 +21,26 @@ All timings are min-over-reps of warm (compiled) executions on the bench
 configuration (2 clients, 10 local epochs, medical 256x256). Writes a
 markdown table + one JSON line to stdout.
 
-Methodology caveat (printed with the table): the in-round attributions are
-SUBTRACTIONS ACROSS SEPARATELY-COMPILED PROGRAMS — each ablated variant is
-its own XLA program and may fuse differently, so "full − train_only = HE
-cost" is an estimate, not a measurement of the fused program's internals.
-The standalone encrypt/aggregate rows are the cross-check; for a
-trace-level ground truth run the experiment CLI with `--profile` in the
-same TPU window and compare.
+Attribution reliability (the method note printed with the table): each
+in-round attribution is a SUBTRACTION ACROSS SEPARATELY-COMPILED PROGRAMS —
+each ablated variant is its own XLA program and may fuse differently, so a
+raw delta can come out negative on fast rounds. Raw deltas are kept in the
+JSON under `*_raw`; the table rows are clamped at 0
+(`hefl_tpu.utils.roofline.clamp_attribution`) and the artifact carries an
+explicit `attribution_unreliable: true` flag whenever ANY raw delta was
+negative. For a trace-level ground truth run the experiment CLI with
+`--profile` in the same TPU window and compare.
+
+Every phase row also carries {mfu, images_per_s} sourced from
+`hefl_tpu.utils.roofline` (train-math FLOPs over phase seconds — a lower
+bound for the fused row, which also encrypts).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import sys
 import time
 
@@ -58,16 +65,12 @@ def _steady(fn, reps: int = 3, warmup: int = 1) -> float:
 
 
 def main() -> None:
-    import os
-
     import jax
 
     from hefl_tpu.utils.probe import setup_backend
 
-    setup_backend(
-        "profile_round.py",
-        "cpu" if os.environ.get("PROFILE_SMOKE") == "1" else None,
-    )
+    smoke = os.environ.get("PROFILE_SMOKE") == "1"
+    setup_backend("profile_round.py", "cpu" if smoke else None)
     import jax.numpy as jnp
 
     jax.config.update("jax_compilation_cache_dir", ".jax_cache")
@@ -76,6 +79,12 @@ def main() -> None:
     from hefl_tpu.ckks.keys import CkksContext, keygen
     from hefl_tpu.ckks.packing import PackSpec
     from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+    from hefl_tpu.data.augment import (
+        SHIFT_BACKENDS,
+        backend_report,
+        random_augment,
+        resolve_shift_backend,
+    )
     from hefl_tpu.fl import (
         TrainConfig,
         decrypt_average,
@@ -86,11 +95,9 @@ def main() -> None:
     from hefl_tpu.fl.secure import aggregate_encrypted, encrypt_params
     from hefl_tpu.models import create_model
     from hefl_tpu.parallel import make_mesh
-
-    import os
+    from hefl_tpu.utils import roofline
 
     num_clients = 2
-    smoke = os.environ.get("PROFILE_SMOKE") == "1"
     if smoke:
         # CI/CPU shakeout of the harness itself (tiny shapes, same code
         # path); real numbers come from the TPU run without this flag.
@@ -111,6 +118,16 @@ def main() -> None:
     xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
     xt_d = jax.device_put(jnp.asarray(xt))
     key = jax.random.key(5)
+    dev = jax.devices()[0]
+
+    # Full-config train geometry (the same helper _train_split uses): the
+    # matched-geometry val ablation below needs n_tr to hold the variant's
+    # step count identical to the full round's.
+    from hefl_tpu.fl.client import train_batch_geometry
+
+    _n_tr_full, _grp_full, _steps_full = train_batch_geometry(
+        cfg, int(xs.shape[1])
+    )
 
     variants = {
         "full secure round (train+encrypt+aggregate)": lambda: secure_fedavg_round(
@@ -124,11 +141,20 @@ def main() -> None:
             dataclasses.replace(cfg, augment=False),
             mesh, params, xs_d, ys_d, key,
         )[0],
-        "plain round, no per-epoch val": lambda: fedavg_round(
+        # Matched-geometry val ablation. val_fraction=0.0 would be wrong
+        # twice over: _train_split's val_fraction=0 fallback validates on
+        # the whole TRAIN slice (the source of the committed −17.7% row,
+        # the ablated variant coming out SLOWER than the full round), and
+        # an epsilon fraction alone changes n_tr and hence the step count.
+        # Feeding the variant n_tr+1 samples with an epsilon fraction
+        # clamps the val split to ONE image at the SAME train geometry
+        # (same batch, same steps/epoch), so the delta is eval cost only.
+        "plain round, 1-image val": lambda: fedavg_round(
             module,
-            dataclasses.replace(cfg, val_fraction=0.0, es_patience=10**6,
+            dataclasses.replace(cfg, val_fraction=1e-9, es_patience=10**6,
                                 plateau_patience=10**6),
-            mesh, params, xs_d, ys_d, key,
+            mesh, params, xs_d[:, : _n_tr_full + 1], ys_d[:, : _n_tr_full + 1],
+            key,
         )[0],
     }
     times: dict[str, float] = {}
@@ -143,12 +169,10 @@ def main() -> None:
     )
     ct0 = enc2(params, jax.random.key(1))
     t_encrypt = _steady(lambda: enc2(params, jax.random.key(1)).c0)
-    import jax.numpy as jnp2
-
     stacked = jax.jit(
         lambda c0, c1: aggregate_encrypted(
             ctx,
-            type(ct0)(c0=jnp2.stack([c0, c0]), c1=jnp2.stack([c1, c1]),
+            type(ct0)(c0=jnp.stack([c0, c0]), c1=jnp.stack([c1, c1]),
                       scale=ct0.scale),
         ).c0
     )
@@ -162,83 +186,116 @@ def main() -> None:
     log(f"standalone encrypt(1 client): {t_encrypt:.3f}s, aggregate(2): "
         f"{t_aggregate:.3f}s, decrypt: {t_decrypt:.3f}s, evaluate: {t_evaluate:.3f}s")
 
-    # Augment row-shift backend shootout at the training batch shape: the
-    # spectral shear is the augment pipeline's dominant FLOP term, so this
-    # picks the default for HEFL_AUG_SHIFT.
-    from hefl_tpu.data import augment as aug_mod
-
+    # Augment backend shootout at the training batch shape (always the
+    # flagship 256x256 image — augment cost is what this PR attacks, so
+    # the row must stay comparable across configs). The per-device winner
+    # of this same race is what "auto" mode picks at first use.
     batch = jnp.asarray(
         np.random.default_rng(3).random((cfg.batch_size, 256, 256, 3), np.float32)
     )
     aug_times = {}
-    prev_backend = aug_mod._SHIFT_BACKEND
-    try:
-        for backend in ("fft", "dft"):
-            aug_mod._SHIFT_BACKEND = backend
-            # random_augment's own jit cache is keyed on shapes/statics, not
-            # on the backend flag — trace the unjitted fn under a fresh jit
-            # per backend so each one actually compiles its own program.
-            fn = jax.jit(
-                lambda k, im: aug_mod.random_augment.__wrapped__(k, im)
-            )
-            aug_times[backend] = _steady(
-                lambda: fn(jax.random.key(0), batch), reps=10
-            )
-            log(f"random_augment[{backend}] per batch-{cfg.batch_size}: "
-                f"{aug_times[backend] * 1e3:.2f} ms")
-    finally:
-        aug_mod._SHIFT_BACKEND = prev_backend
+    for backend in SHIFT_BACKENDS:
+        fn = lambda: random_augment(jax.random.key(0), batch, backend=backend)  # noqa: B023,E731
+        aug_times[backend] = _steady(fn, reps=10)
+        log(f"random_augment[{backend}] per batch-{cfg.batch_size}: "
+            f"{aug_times[backend] * 1e3:.2f} ms")
+    chosen = resolve_shift_backend(cfg.aug_backend)
 
     full = times["full secure round (train+encrypt+aggregate)"]
     train_only = times["plain round (train+pmean, no HE)"]
     no_aug = times["plain round, augment off"]
-    no_val = times["plain round, no per-epoch val"]
+    no_val = times["plain round, 1-image val"]
+    raw = {
+        "he_in_round_s": full - train_only,
+        "augment_s": train_only - no_aug,
+        "per_epoch_val_s": train_only - no_val,
+    }
+    raw["sgd_core_s"] = no_aug - raw["per_epoch_val_s"]
+    clamped, unreliable = roofline.clamp_attribution(raw)
+
+    # Roofline columns: train-math FLOPs (fwd+bwd ~= 3x fwd at the fused
+    # batch) over phase seconds, at the geometry computed above (the same
+    # helper _train_split uses).
+    grp, steps_per_epoch = _grp_full, _steps_full
+    fwd_flops = roofline.program_flops(
+        lambda p, xb: module.apply({"params": p}, xb),
+        params,
+        jnp.zeros((grp, *x.shape[1:]), jnp.float32),
+    )
+    train_flops = roofline.train_flops_per_round(
+        fwd_flops, steps_per_epoch, cfg.epochs, num_clients
+    )
+    train_images = num_clients * cfg.epochs * steps_per_epoch * grp
+    phase_roofline = {
+        "fused_round": roofline.phase_stats(
+            full, flops=train_flops, device=dev, images=train_images
+        ),
+        "train_only": roofline.phase_stats(
+            train_only, flops=train_flops, device=dev, images=train_images
+        ),
+        "decrypt": roofline.phase_stats(t_decrypt, device=dev),
+        "evaluate": roofline.phase_stats(t_evaluate, device=dev, images=len(xt)),
+    }
+
     att = {
         "full_round_s": round(full, 3),
         "train_s": round(train_only, 3),
-        "he_in_round_s": round(full - train_only, 3),
-        "augment_s": round(train_only - no_aug, 3),
-        "per_epoch_val_s": round(train_only - no_val, 3),
-        "sgd_core_s": round(no_aug - (train_only - no_val), 3),
+        **{k: round(v, 3) for k, v in clamped.items()},
+        **{f"{k}_raw": round(v, 3) for k, v in raw.items()},
+        "attribution_unreliable": unreliable,
         "standalone_encrypt_s": round(t_encrypt, 3),
         "standalone_aggregate_s": round(t_aggregate, 3),
         "decrypt_s": round(t_decrypt, 3),
         "evaluate_s": round(t_evaluate, 3),
-        "augment_fft_ms": round(aug_times["fft"] * 1e3, 3),
-        "augment_dft_ms": round(aug_times["dft"] * 1e3, 3),
-        "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+        **{
+            f"augment_{b}_ms": round(t * 1e3, 3) for b, t in aug_times.items()
+        },
+        "augment_backend": {**backend_report(), "backend": chosen},
+        "phase_roofline": phase_roofline,
+        "device": roofline.device_kind(dev),
     }
 
     print(
         "Attribution method: ablation — each row below the total is the "
         "difference between two separately-compiled program variants "
-        "(estimates; XLA may fuse each variant differently). Standalone "
-        "encrypt/aggregate rows cross-check the HE estimate; `--profile` "
-        "traces are the fused program's ground truth."
+        "(estimates; XLA may fuse each variant differently). Raw deltas "
+        "are clamped at 0 in this table; the JSON keeps the raw values "
+        "(`*_raw`) and sets `attribution_unreliable: true` when any raw "
+        "delta was negative"
+        + (" — WHICH IS THE CASE FOR THIS RUN" if unreliable else "")
+        + ". Standalone encrypt/aggregate rows cross-check the HE "
+        "estimate; `--profile` traces are the fused program's ground truth."
     )
     print()
     print("| phase | seconds | share of fused round |")
     print("|---|---|---|")
     rows = [
         ("fused round total", full, 1.0),
-        ("  local SGD (no augment, no val)", att["sgd_core_s"],
-         att["sgd_core_s"] / full),
-        ("  data augmentation (affine/spectral shear)", att["augment_s"],
-         att["augment_s"] / full),
-        ("  per-epoch validation + callbacks", att["per_epoch_val_s"],
-         att["per_epoch_val_s"] / full),
-        ("  CKKS encrypt + psum (fused - plain)", att["he_in_round_s"],
-         att["he_in_round_s"] / full),
+        ("  local SGD (no augment, no val)", clamped["sgd_core_s"],
+         clamped["sgd_core_s"] / full),
+        ("  data augmentation (affine warp)", clamped["augment_s"],
+         clamped["augment_s"] / full),
+        ("  per-epoch validation + callbacks", clamped["per_epoch_val_s"],
+         clamped["per_epoch_val_s"] / full),
+        ("  CKKS encrypt + psum (fused - plain)", clamped["he_in_round_s"],
+         clamped["he_in_round_s"] / full),
     ]
     for name, t, share in rows:
         print(f"| {name} | {t:.3f} | {share:.1%} |")
     print(f"| decrypt (separate phase) | {att['decrypt_s']:.3f} | — |")
     print(f"| evaluate (separate phase) | {att['evaluate_s']:.3f} | — |")
     print()
-    print("| augment row-shift backend | ms / batch |")
+    tr = phase_roofline["train_only"]
+    print(
+        f"train-phase roofline: MFU {tr['mfu']} | {tr['images_per_s']} "
+        f"images/s ({'placeholder peak' if tr.get('peak_is_placeholder') else 'spec peak'})"
+    )
+    print()
+    print("| augment backend (full warp) | ms / batch |")
     print("|---|---|")
-    print(f"| fft (default) | {att['augment_fft_ms']} |")
-    print(f"| dft (matmul) | {att['augment_dft_ms']} |")
+    for b in SHIFT_BACKENDS:
+        tag = " (selected)" if b == chosen else ""
+        print(f"| {b}{tag} | {att[f'augment_{b}_ms']} |")
     print(json.dumps({"metric": "phase_attribution", **att}))
 
 

@@ -1,0 +1,104 @@
+#!/bin/bash
+# CPU perf smoke: proves the MFU/roofline + attribution machinery
+# end-to-end on the driver box before any TPU window is spent on it.
+# Runs the MFU_SMOKE train-step ladder and the PROFILE_SMOKE attribution
+# harness, then gates on the artifact SCHEMA:
+#   (a) every mfu_probe row carries mfu / images_per_s / xla_flops;
+#   (b) the attribution JSON carries phase_roofline records for every
+#       phase and the augment backend choice;
+#   (c) no clamped attribution row is negative, and any negative RAW delta
+#       is flagged attribution_unreliable (the PROFILE.md -17.7% row class
+#       of bug fails here, on CPU, instead of poisoning TPU evidence).
+# Wired into run_tpu_suite.sh as stage 0 (cheap pre-stage, no backend
+# probe needed — both harnesses pin themselves to CPU in smoke mode).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+workdir=$(mktemp -d)
+# mfu_probe.json is TPU-suite evidence when produced WITHOUT MFU_SMOKE;
+# shelter any committed copy from the smoke run's overwrite. The restore
+# lives in the EXIT trap so a failure or Ctrl-C between the overwrite and
+# the restore cannot clobber committed evidence (the backup would
+# otherwise vanish with $workdir).
+trap '[ -f "$workdir/mfu_probe.json.orig" ] && mv "$workdir/mfu_probe.json.orig" mfu_probe.json; rm -rf "$workdir"' EXIT
+[ -f mfu_probe.json ] && cp mfu_probe.json "$workdir/mfu_probe.json.orig"
+
+MFU_SMOKE=1 python mfu_probe.py > "$workdir/mfu_smoke.md"
+mv mfu_probe.json "$workdir/mfu_probe.json"
+if [ -f "$workdir/mfu_probe.json.orig" ]; then
+  mv "$workdir/mfu_probe.json.orig" mfu_probe.json
+fi
+
+PROFILE_SMOKE=1 python profile_round.py > "$workdir/profile_smoke.out"
+
+python - "$workdir/mfu_probe.json" "$workdir/profile_smoke.out" <<'PY'
+import json
+import sys
+
+mfu_path, prof_path = sys.argv[1:3]
+fail = []
+
+probe = json.load(open(mfu_path))
+if "peak_flops" not in probe or not probe.get("rows"):
+    fail.append("mfu_probe.json: missing peak_flops/rows")
+for row in probe.get("rows", []):
+    for field in ("mfu", "images_per_s", "xla_flops"):
+        if row.get(field) is None:
+            fail.append(
+                f"mfu_probe.json row batch={row.get('batch')}: missing {field}"
+            )
+if "augment_backend" not in probe:
+    fail.append("mfu_probe.json: missing augment_backend")
+
+rec = None
+for line in open(prof_path):
+    line = line.strip()
+    if not line.startswith("{"):
+        continue
+    try:
+        cand = json.loads(line)
+    except ValueError:
+        continue
+    if cand.get("metric") == "phase_attribution":
+        rec = cand
+if rec is None:
+    fail.append("profile output: no phase_attribution JSON line")
+else:
+    roofline = rec.get("phase_roofline") or {}
+    for phase in ("fused_round", "train_only", "decrypt", "evaluate"):
+        stats = roofline.get(phase)
+        if not isinstance(stats, dict) or not {
+            "seconds", "mfu", "images_per_s"
+        } <= set(stats):
+            fail.append(
+                f"profile: phase_roofline[{phase!r}] missing the "
+                "seconds/mfu/images_per_s schema"
+            )
+    unreliable = rec.get("attribution_unreliable")
+    if unreliable is None:
+        fail.append("profile: missing attribution_unreliable flag")
+    neg_raw = [
+        k for k, v in rec.items()
+        if k.endswith("_raw") and isinstance(v, (int, float)) and v < 0
+    ]
+    if neg_raw and unreliable is not True:
+        fail.append(
+            f"profile: negative raw deltas {neg_raw} not flagged "
+            "attribution_unreliable"
+        )
+    for k in ("he_in_round_s", "augment_s", "per_epoch_val_s", "sgd_core_s"):
+        if isinstance(rec.get(k), (int, float)) and rec[k] < 0:
+            fail.append(f"profile: clamped attribution row {k} is negative")
+    if "augment_backend" not in rec:
+        fail.append("profile: missing augment_backend record")
+
+if fail:
+    print("PERF SMOKE FAILED:")
+    for f in fail:
+        print(" -", f)
+    sys.exit(1)
+print(
+    "perf smoke OK: MFU + roofline schema present on both artifacts, "
+    "no unflagged negative attribution rows"
+)
+PY

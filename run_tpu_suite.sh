@@ -55,6 +55,13 @@ run_stage() {
   return $rc
 }
 
+echo "=== stage 0: CPU perf smoke (MFU/roofline + attribution schema gate)"
+# Cheap CPU-only pre-stage (~1 min, no TPU probe: both harnesses pin
+# themselves to CPU in smoke mode): fails fast if any measurement artifact
+# would ship without MFU fields or with an unflagged negative attribution
+# row, BEFORE the window spends 30-minute stages producing it.
+run_stage stage0 600 "" perf_smoke_err.log bash run_perf_smoke.sh
+
 echo "=== stage 1: NTT microbenchmark + on-hardware Pallas parity gate"
 # Runs FIRST: it bit-exact-compares the Pallas kernel against the XLA path
 # on real hardware. If the kernel is broken (exit 42: deterministic parity

@@ -5,10 +5,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from hefl_tpu.parallel import CLIENT_AXIS, make_mesh, psum_mod, ring_psum_mod
+from hefl_tpu.parallel import (
+    CLIENT_AXIS,
+    make_mesh,
+    psum_mod,
+    ring_psum_mod,
+    shard_map,
+)
 
 
 def _mesh8():

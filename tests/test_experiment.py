@@ -43,6 +43,13 @@ def test_encrypted_experiment_two_rounds():
         assert len(rec["val_acc"]) == 2
         # per-client encoder-saturation diagnostic must be recorded (and 0)
         assert rec["encode_overflow"] == [0, 0]
+        # every history record carries the per-phase roofline schema
+        # (hefl_tpu.utils.roofline.phase_stats — fields present, null OK)
+        pr = rec["phase_roofline"]
+        for phase in ("train+encrypt+aggregate", "decrypt", "evaluate"):
+            assert {"seconds", "flops", "mfu", "images_per_s"} <= set(pr[phase])
+        assert pr["train+encrypt+aggregate"]["seconds"] is not None
+    assert out["augment_backend"]["requested"] in ("auto", "gather", "fft", "dft")
     for leaf in np.asarray(out["params"]["Conv_0"]["kernel"]).ravel()[:5]:
         assert np.isfinite(leaf)
 

@@ -225,12 +225,13 @@ def test_pmean_aggregation_is_exact():
     # constant pytrees == numpy mean, bit-for-bit (no training in the loop).
     from jax.sharding import PartitionSpec as P
     from hefl_tpu.parallel import pmean_tree
+    from hefl_tpu.parallel import shard_map as _shard_map
 
     mesh = make_mesh(8)
     vals = np.arange(8, dtype=np.float32).reshape(8, 1) * 3.5 + 1.25
     body = lambda v: pmean_tree({"w": v}, CLIENT_AXIS)["w"]
     out = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P(CLIENT_AXIS), out_specs=P())
+        _shard_map(body, mesh=mesh, in_specs=P(CLIENT_AXIS), out_specs=P())
     )(jnp.asarray(vals))
     assert float(np.asarray(out).ravel()[0]) == float(vals.mean())
 
