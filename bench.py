@@ -202,6 +202,13 @@ def main() -> None:
     history = []
     xt_d = None
     overflow_total = 0
+    # Per-round exclusion record (ISSUE 2). The timed hot path runs the
+    # clean all-clients-present program, so each row is the compact
+    # {excluded, overflow_clients} summary (NOT the richer per-cause
+    # RoundMeta.record() dict experiment history carries): `excluded` is
+    # structurally 0 here, and `overflow_clients` says how many clients
+    # on_overflow="exclude" WOULD have dropped that round.
+    exclusions_by_round = []
     cur = params
     for r in range(rounds):
         k_round = flagship_round_key(seed, r)
@@ -233,6 +240,10 @@ def main() -> None:
         )
         ov = int(np.sum(np.asarray(overflow)))
         overflow_total += ov
+        exclusions_by_round.append(
+            {"excluded": 0,
+             "overflow_clients": int(np.sum(np.asarray(overflow) > 0))}
+        )
         log(f"  per-client val-acc: {np.asarray(metrics)[:, :, 1].round(3)}"
             + (f" | ENCODE OVERFLOW: {ov} weights clipped" if ov else ""))
         last_ct_sum, last_start, last_key = ct_sum, cur, k_round
@@ -250,6 +261,7 @@ def main() -> None:
             "accuracy_by_round": [h["accuracy"] for h in history],
             "f1_by_round": [h["f1"] for h in history],
             "round_stats": round_stats,
+            "exclusions_by_round": exclusions_by_round,
             "encode_overflow_count": overflow_total,
             **({"smoke": True} if smoke else {}),
             **({"platform_pinned": platform} if platform else {}),
@@ -433,6 +445,9 @@ def main() -> None:
                 # largest weight (a scale-headroom indicator only; per-client
                 # clipping is exactly what encode_overflow_count counts).
                 "encode_overflow_count": overflow_total,
+                # Per-round exclusion counts (robustness schema shared with
+                # experiment history[r]["robust"] and CHAOS_SMOKE.json).
+                "exclusions_by_round": exclusions_by_round,
                 # Same guard for the cell-6 artifact's own (re-)training.
                 "cell6_encode_overflow_count": cell6_overflow,
                 # Source: the cell-6 plaintext round's weights when it ran,

@@ -13,7 +13,7 @@ import json
 import os
 
 from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
-from hefl_tpu.fl import DpConfig, TrainConfig
+from hefl_tpu.fl import DpConfig, FaultConfig, TrainConfig
 from hefl_tpu.models import MODEL_REGISTRY
 
 
@@ -78,6 +78,41 @@ def build_parser() -> argparse.ArgumentParser:
                    help="DP-FedAvg L2 clip bound on a client's model delta")
     p.add_argument("--dp-delta", type=float, default=1e-5,
                    help="target delta for the (epsilon, delta) accountant")
+    # --- robustness / fault injection (fl/faults.py, README "Robustness") ---
+    p.add_argument("--on-overflow", default="warn",
+                   choices=["warn", "exclude", "raise"],
+                   help="when a client's update saturates the CKKS encode "
+                        "envelope: warn (reference behavior), exclude the "
+                        "client from the round, or raise")
+    p.add_argument("--max-update-norm", type=float, default=0.0, metavar="L2",
+                   help="exclude clients whose update L2 norm (vs the "
+                        "round's global weights) exceeds this bound "
+                        "(0 = no bound)")
+    p.add_argument("--drop-fraction", type=float, default=0.0,
+                   help="fault injection: fraction of clients scheduled "
+                        "out of each round (deterministic, --fault-seed)")
+    p.add_argument("--nan-clients", type=int, default=0, metavar="K",
+                   help="fault injection: clients per round whose update "
+                        "is NaN-poisoned before aggregation")
+    p.add_argument("--huge-clients", type=int, default=0, metavar="K",
+                   help="fault injection: clients per round whose update "
+                        "gets +1e15 on every weight")
+    p.add_argument("--straggler-delay", type=float, default=0.0, metavar="S",
+                   help="fault injection: max per-round straggler delay "
+                        "in seconds (25%% of clients straggle)")
+    p.add_argument("--fail-rounds", default="", metavar="R,R,...",
+                   help="fault injection: comma-separated round indices "
+                        "whose first attempt simulates a device loss "
+                        "(exercises --max-round-retries)")
+    p.add_argument("--fault-seed", type=int, default=0,
+                   help="PRNG seed of the fault schedule")
+    p.add_argument("--max-round-retries", type=int, default=0,
+                   help="retry a failed round this many times with "
+                        "exponential backoff, auto-resuming from the "
+                        "--checkpoint when one matches the round")
+    p.add_argument("--retry-backoff", type=float, default=0.5, metavar="S",
+                   help="base backoff between round retries (doubles per "
+                        "attempt)")
     return p
 
 
@@ -86,6 +121,29 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         args.num_classes
         if args.num_classes is not None
         else MODEL_REGISTRY[args.model][1]
+    )
+    fail_rounds = tuple(
+        int(r) for r in args.fail_rounds.split(",") if r.strip()
+    )
+    any_fault = (
+        args.drop_fraction > 0
+        or args.nan_clients > 0
+        or args.huge_clients > 0
+        or args.straggler_delay > 0
+        or fail_rounds
+    )
+    faults = (
+        FaultConfig(
+            seed=args.fault_seed,
+            drop_fraction=args.drop_fraction,
+            nan_clients=args.nan_clients,
+            huge_clients=args.huge_clients,
+            straggler_fraction=0.25 if args.straggler_delay > 0 else 0.0,
+            straggler_delay_s=args.straggler_delay,
+            fail_rounds=fail_rounds,
+        )
+        if any_fault
+        else None
     )
     return ExperimentConfig(
         model=args.model,
@@ -105,6 +163,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             prox_mu=args.prox_mu,
             augment=not args.no_augment,
             num_classes=num_classes,
+            on_overflow=args.on_overflow,
+            max_update_norm=args.max_update_norm,
         ),
         he=HEConfig(n=args.he_n, num_primes=args.he_primes),
         seed=args.seed,
@@ -123,6 +183,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             if args.dp_noise > 0
             else None
         ),
+        faults=faults,
+        max_round_retries=args.max_round_retries,
+        retry_backoff_s=args.retry_backoff,
     )
 
 

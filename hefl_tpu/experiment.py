@@ -13,6 +13,8 @@ checkpoint/resume.
 from __future__ import annotations
 
 import dataclasses
+import os
+import time
 from typing import Any
 
 import jax
@@ -29,17 +31,22 @@ from hefl_tpu.data import (
     stack_federated,
 )
 from hefl_tpu.fl import (
+    DeviceLost,
     DpConfig,
+    FaultConfig,
     TrainConfig,
     decrypt_average,
     epsilon_spent,
     evaluate,
     fedavg_round,
+    schedule_for_round,
     secure_fedavg_round,
     train_centralized,
 )
+from hefl_tpu.fl.faults import POISON_HUGE, POISON_NAN
+from hefl_tpu.fl.fedavg import masked_mode
 from hefl_tpu.models import count_params, create_model
-from hefl_tpu.parallel import make_mesh
+from hefl_tpu.parallel import client_mesh_size, make_mesh
 from hefl_tpu.utils import PhaseTimer, load_checkpoint, save_checkpoint, save_params
 from hefl_tpu.utils import roofline
 
@@ -98,6 +105,17 @@ class ExperimentConfig:
     # distributed Gaussian noise INSIDE the encrypted round program. None
     # keeps the reference's HE-only behavior.
     dp: "DpConfig | None" = None
+    # Deterministic fault injection (fl/faults.py): per-round scheduled
+    # dropout, NaN/huge-norm update poisoning, straggler delays, and
+    # simulated device loss. None = no faults AND no masked engine (the
+    # historical all-clients-present fast path, seeds untouched).
+    faults: "FaultConfig | None" = None
+    # Driver-level resilience: how many times to retry a round whose
+    # execution died (device loss / runtime error), with exponential
+    # backoff, auto-resuming params+RNG from the round checkpoint when one
+    # matching the current round exists. 0 = fail fast (historical).
+    max_round_retries: int = 0
+    retry_backoff_s: float = 0.5
 
 
 def _train_roofline_inputs(module, params, train_cfg: TrainConfig,
@@ -155,6 +173,24 @@ def run_experiment(
         raise ValueError(
             "dp is only applied on the encrypted federated path; remove "
             "--plaintext/--centralized or drop the dp config"
+        )
+    if cfg.faults is not None and cfg.centralized:
+        # Same fail-loud rationale as dp: a chaos run that silently ran
+        # no faults would let unhardened code pass a robustness gate.
+        raise ValueError(
+            "fault injection targets the federated round loop; remove "
+            "--centralized or drop the faults config"
+        )
+    if cfg.dp is not None and cfg.faults is not None:
+        # fl.dp's distributed noise shares are calibrated for FULL
+        # participation (sigma*C/sqrt(K) each); excluding any client also
+        # excludes its noise share, silently weakening the accounted
+        # (epsilon, delta) guarantee. fl.secure fail-louds if an exclusion
+        # actually happens; here the combination is rejected up front.
+        raise ValueError(
+            "dp and fault injection cannot be combined: dropped/poisoned "
+            "clients would take their noise shares with them and the "
+            "release would be less private than epsilon_spent reports"
         )
     train_cfg = cfg.train
     if cfg.data_dir is not None:
@@ -260,6 +296,17 @@ def run_experiment(
     )
     train_phase = "train+encrypt+aggregate" if cfg.encrypted else "train+aggregate"
 
+    # Robustness mode: any of fault injection, a client count that needs
+    # padding onto the mesh, or an update-sanitization knob routes rounds
+    # through the participation-masked engine (fl.fedavg/fl.secure), whose
+    # outputs carry a per-round RoundMeta. The predicate is the SAME
+    # masked_mode the round functions use to decide their return arity —
+    # one source, so producer and unpack cannot drift.
+    robust = masked_mode(
+        train_cfg, cfg.num_clients, client_mesh_size(mesh),
+        explicit=cfg.faults is not None, secure=cfg.encrypted,
+    )
+
     history: list[dict[str, Any]] = []
     for r in range(start_round, cfg.rounds):
         # Tracing (SURVEY.md §5): the reference brackets phases with
@@ -268,27 +315,119 @@ def run_experiment(
         profiling = cfg.profile_dir is not None and r == start_round
         if profiling:
             jax.profiler.start_trace(cfg.profile_dir)
-        timer = PhaseTimer()
+        sched = (
+            schedule_for_round(cfg.faults, r, cfg.num_clients)
+            if cfg.faults is not None
+            else None
+        )
+        part = sched.participation() if sched is not None else None
+        pois = sched.poison if sched is not None else None
+        straggler_s = (
+            float(np.max(sched.straggler_s)) if sched is not None else 0.0
+        )
         key, k_round = jax.random.split(key)
-        if cfg.encrypted:
-            with timer.phase("train+encrypt+aggregate"):
-                ct_sum, metrics, overflow = secure_fedavg_round(
-                    module, train_cfg, mesh, ctx, pk, params, xs_d, ys_d,
-                    k_round, dp=cfg.dp,
+        attempt = 0
+        while True:
+            # Retry/backoff envelope (cfg.max_round_retries): a round whose
+            # execution dies (device loss, runtime error) is retried with
+            # exponential backoff, auto-resuming (params, RNG) from the
+            # round checkpoint when one matching this round exists — the
+            # in-memory state is otherwise retried as-is. Deliberate
+            # config errors (ValueError/TypeError) are never retried.
+            try:
+                if sched is not None and sched.device_loss and attempt == 0:
+                    raise DeviceLost(
+                        f"fault injection: scheduled device loss at round {r}"
+                    )
+                timer = PhaseTimer()
+                meta = None
+                if cfg.encrypted:
+                    with timer.phase("train+encrypt+aggregate"):
+                        if robust:
+                            ct_sum, metrics, overflow, meta = (
+                                secure_fedavg_round(
+                                    module, train_cfg, mesh, ctx, pk, params,
+                                    xs_d, ys_d, k_round, dp=cfg.dp,
+                                    participation=part, poison=pois,
+                                )
+                            )
+                        else:
+                            ct_sum, metrics, overflow = secure_fedavg_round(
+                                module, train_cfg, mesh, ctx, pk, params,
+                                xs_d, ys_d, k_round, dp=cfg.dp,
+                            )
+                        jax.block_until_ready((ct_sum.c0, ct_sum.c1, metrics))
+                        if straggler_s > 0:
+                            # The synchronous round waits for its slowest
+                            # scheduled straggler (driver-level simulation;
+                            # shows up in the phase wall-clock like a real
+                            # straggler would).
+                            time.sleep(straggler_s)
+                    with timer.phase("decrypt"):
+                        if meta is not None and meta.surviving == 0:
+                            # Nobody made the round: the ciphertext is an
+                            # encryption of zero. Keep the global model —
+                            # the same carry-over the plaintext masked
+                            # engine applies (masked_mean_tree's count==0
+                            # branch) — instead of decoding a 0/0.
+                            say(f"round {r}: every client excluded "
+                                f"({meta.excluded}); keeping previous "
+                                "global model")
+                            new_params = params
+                        else:
+                            exact = (
+                                cfg.exact_final_decode
+                                and r == cfg.rounds - 1
+                            )
+                            new_params = decrypt_average(
+                                ctx, sk, ct_sum, cfg.num_clients, spec,
+                                exact=exact, meta=meta,
+                            )
+                            jax.block_until_ready(new_params)
+                else:
+                    overflow = None
+                    with timer.phase("train+aggregate"):
+                        if robust:
+                            new_params, metrics, meta = fedavg_round(
+                                module, train_cfg, mesh, params, xs_d, ys_d,
+                                k_round, participation=part, poison=pois,
+                            )
+                        else:
+                            new_params, metrics = fedavg_round(
+                                module, train_cfg, mesh, params, xs_d, ys_d,
+                                k_round,
+                            )
+                        jax.block_until_ready((new_params, metrics))
+                        if straggler_s > 0:
+                            time.sleep(straggler_s)
+                params = new_params
+                break
+            except RuntimeError as e:
+                if attempt >= cfg.max_round_retries:
+                    raise
+                backoff = cfg.retry_backoff_s * (2**attempt)
+                attempt += 1
+                say(
+                    f"round {r} failed ({type(e).__name__}: {e}); "
+                    f"retry {attempt}/{cfg.max_round_retries} "
+                    f"in {backoff:.1f}s"
                 )
-                jax.block_until_ready((ct_sum.c0, ct_sum.c1, metrics))
-            with timer.phase("decrypt"):
-                exact = cfg.exact_final_decode and r == cfg.rounds - 1
-                params = decrypt_average(
-                    ctx, sk, ct_sum, cfg.num_clients, spec, exact=exact
+                time.sleep(backoff)
+                ck = cfg.checkpoint_path
+                ck_file = (
+                    ck if ck is None or ck.endswith(".npz") else ck + ".npz"
                 )
-                jax.block_until_ready(params)
-        else:
-            with timer.phase("train+aggregate"):
-                params, metrics = fedavg_round(
-                    module, train_cfg, mesh, params, xs_d, ys_d, k_round
-                )
-                jax.block_until_ready((params, metrics))
+                if ck_file and os.path.exists(ck_file):
+                    ck_params, ck_round, ck_key, _ = load_checkpoint(
+                        ck, params
+                    )
+                    if ck_round == r:
+                        # The checkpoint holds exactly this round's entry
+                        # state (params after round r-1, pre-split RNG):
+                        # restore both so the retried round is identical.
+                        params = ck_params
+                        key, k_round = jax.random.split(ck_key)
+                        say(f"auto-resumed round-{r} state from {ck}")
         with timer.phase("evaluate"):
             results = evaluate(module, params, xt_d, yt)
         if profiling:
@@ -337,15 +476,53 @@ def run_experiment(
             # Encoder-saturation diagnostic: nonzero means trained weights
             # were clipped at the CKKS encode envelope (see fl.secure).
             record["encode_overflow"] = np.asarray(overflow).tolist()
-            if int(np.sum(overflow)) > 0:
-                say(f"WARNING: round {r} clipped {int(np.sum(overflow))} "
-                    "weights at the encoder envelope; lower he.scale")
+            overflow_total = int(np.sum(overflow))
+            if overflow_total > 0:
+                excluded_for_overflow = (
+                    meta is not None and meta.excluded.get("overflow", 0) > 0
+                )
+                if train_cfg.on_overflow == "raise":
+                    raise RuntimeError(
+                        f"round {r}: {overflow_total} weights saturated the "
+                        "CKKS encode envelope and on_overflow='raise' — "
+                        "lower he.scale or switch to on_overflow='exclude'"
+                    )
+                if excluded_for_overflow:
+                    say(f"round {r}: excluded "
+                        f"{meta.excluded['overflow']} client(s) whose "
+                        "updates saturated the encoder envelope")
+                else:
+                    say(f"WARNING: round {r} clipped {overflow_total} "
+                        "weights at the encoder envelope; lower he.scale")
+        if robust and meta is not None:
+            # Per-round robustness record: the participation mask the
+            # program applied, surviving count (the decode denominator),
+            # per-cause exclusion counts, retries, and the injected faults.
+            rob: dict[str, Any] = {**meta.record(), "round_retries": attempt}
+            if sched is not None:
+                rob["faults"] = {
+                    "dropped": np.flatnonzero(sched.dropped).tolist(),
+                    "nan": np.flatnonzero(
+                        sched.poison == POISON_NAN
+                    ).tolist(),
+                    "huge": np.flatnonzero(
+                        sched.poison == POISON_HUGE
+                    ).tolist(),
+                    "straggler_s": round(straggler_s, 4),
+                    "device_loss": bool(sched.device_loss),
+                }
+            record["robust"] = rob
         history.append(record)
         say(
             f"round {r}: acc {record['accuracy']:.4f} f1 {record['f1']:.4f} "
             + (
                 f"dp_eps {record['dp_epsilon']:.2f} "
                 if "dp_epsilon" in record
+                else ""
+            )
+            + (
+                f"surviving {meta.surviving}/{meta.num_clients} "
+                if robust and meta is not None
                 else ""
             )
             + f"({timer})"

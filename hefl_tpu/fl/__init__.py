@@ -16,6 +16,13 @@ devices) and aggregation is a collective.
 from hefl_tpu.fl.config import TrainConfig
 from hefl_tpu.fl.client import local_train, train_centralized
 from hefl_tpu.fl.dp import DpConfig, clip_by_global_norm, dp_sanitize, epsilon_spent
+from hefl_tpu.fl.faults import (
+    DeviceLost,
+    FaultConfig,
+    RoundFaults,
+    RoundMeta,
+    schedule_for_round,
+)
 from hefl_tpu.fl.fedavg import evaluate, fedavg_round, train_clients
 from hefl_tpu.fl.metrics import classification_metrics
 from hefl_tpu.fl.secure import (
@@ -29,6 +36,11 @@ from hefl_tpu.fl.secure import (
 __all__ = [
     "TrainConfig",
     "DpConfig",
+    "DeviceLost",
+    "FaultConfig",
+    "RoundFaults",
+    "RoundMeta",
+    "schedule_for_round",
     "clip_by_global_norm",
     "dp_sanitize",
     "epsilon_spent",

@@ -49,3 +49,27 @@ class TrainConfig:
     # nested scan-of-scans. Same math, same RNG stream; the flag exists so
     # the equivalence stays testable (tests/test_perf.py).
     flat_scan: bool = True
+    # --- update sanitization (fl.faults / the participation-masked round
+    # engine). Both knobs default OFF so the historical all-clients-present
+    # round programs (and their seeds) are untouched; turning either on
+    # forces the masked engine — which also applies the NaN/Inf filter —
+    # on EVERY round. (With both off, a faulted run's clean-schedule
+    # rounds take the bit-for-bit legacy fast path, which traces no
+    # predicates; RoundMeta.sanitized records which route ran.)
+    #
+    # What to do when a client's trained weights saturate the CKKS encode
+    # envelope (encode_overflow > 0): "warn" keeps the reference behavior
+    # (aggregate + log), "exclude" drops the client from the round inside
+    # the jitted program, "raise" aborts the experiment.
+    on_overflow: str = "warn"
+    # L2 bound on a client's update (delta vs the round's global weights):
+    # a finite update with a larger norm is excluded from aggregation.
+    # 0 disables the bound.
+    max_update_norm: float = 0.0
+
+    def __post_init__(self):
+        if self.on_overflow not in ("warn", "exclude", "raise"):
+            raise ValueError(
+                f"on_overflow={self.on_overflow!r}: must be one of "
+                "'warn' | 'exclude' | 'raise'"
+            )

@@ -1,0 +1,265 @@
+"""Fault model for the participation-masked round engine.
+
+Real cross-device FL is defined by dropout, stragglers, and bad updates —
+behaviors the reference pipeline (one process, every client finishes every
+round, FLPyfhelin.py:179-198) cannot even express. This module holds the
+three pieces that make those behaviors first-class and *reproducible*:
+
+  * `FaultConfig` / `schedule_for_round` — a deterministic PRNG-keyed fault
+    schedule: which clients drop, which upload NaN / huge-norm garbage,
+    which straggle (and by how long), and which rounds simulate a device
+    loss. Same (config, round, num_clients) => same schedule, always — so
+    every robustness behavior is testable bit-for-bit.
+  * `poison_tree` / `exclusion_bits` — the in-program halves: poison
+    injection applied to a client's trained update inside the jitted round
+    program, and the update-sanitization predicates (NaN/Inf filter,
+    update-norm bound, encoder-saturation signal) that compute the round's
+    participation mask *inside* the same program. A poisoned or diverged
+    client is excluded from aggregation, not averaged into the global model.
+  * `RoundMeta` — the public per-round robustness record: who participated,
+    who was excluded and why, and the surviving-client count that
+    `fl.secure.decrypt_average` uses as its decode denominator.
+
+Exclusion causes are a bitmask so one int32[C] program output carries full
+attribution (a client can be both scheduled-out and NaN-poisoned):
+bit 0 scheduled (dropout / padding), bit 1 non-finite update, bit 2
+update-norm bound, bit 3 encoder saturation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Exclusion-cause bits (the int32[C] `bits` output of a masked round).
+EXCLUDED_SCHEDULED = 1   # external mask: scheduled dropout or a padding slot
+EXCLUDED_NONFINITE = 2   # NaN/Inf anywhere in the trained update
+EXCLUDED_NORM = 4        # finite but ||update - global||_2 > max_update_norm
+EXCLUDED_OVERFLOW = 8    # encode_overflow > 0 under on_overflow="exclude"
+
+EXCLUSION_CAUSES = {
+    "scheduled": EXCLUDED_SCHEDULED,
+    "nonfinite": EXCLUDED_NONFINITE,
+    "norm": EXCLUDED_NORM,
+    "overflow": EXCLUDED_OVERFLOW,
+}
+
+# Poison codes (the int32[C] `poison` input of a masked round).
+POISON_NONE = 0
+POISON_NAN = 1    # every weight becomes NaN — a diverged client's upload
+POISON_HUGE = 2   # +1e15 on every weight — a huge-norm (model-poisoning) upload
+_HUGE = 1e15
+
+
+class DeviceLost(RuntimeError):
+    """Simulated device loss (FaultConfig.fail_rounds): raised by the driver
+    before the round executes, exercising the retry/backoff + auto-resume
+    path without real hardware failure."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Deterministic fault-injection schedule (frozen => hashable, can ride
+    in ExperimentConfig). All rates default to 0: an all-zeros FaultConfig
+    schedules nothing.
+
+    seed:                PRNG seed of the schedule (independent of the
+                         experiment seed so fault placement can be varied
+                         while training streams stay fixed).
+    drop_fraction:       fraction of clients scheduled out per round
+                         (rounded to a count; exact, not Bernoulli, so
+                         tests can assert the precise surviving count).
+    nan_clients:         clients per round whose trained update is replaced
+                         by NaNs before aggregation.
+    huge_clients:        clients per round whose update gets +1e15 on every
+                         weight (norm-bound / encoder-saturation fodder).
+    straggler_fraction:  fraction of clients that straggle each round.
+    straggler_delay_s:   max per-round straggler delay; the driver sleeps
+                         the round's max scheduled delay (the synchronous
+                         round waits for its slowest client).
+    fail_rounds:         rounds whose FIRST attempt raises DeviceLost — the
+                         deterministic hook for the retry/auto-resume path.
+    """
+
+    seed: int = 0
+    drop_fraction: float = 0.0
+    nan_clients: int = 0
+    huge_clients: int = 0
+    straggler_fraction: float = 0.0
+    straggler_delay_s: float = 0.0
+    fail_rounds: tuple[int, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundFaults:
+    """One round's concrete fault assignment (host-side numpy)."""
+
+    dropped: np.ndarray       # bool[C]  scheduled dropout
+    poison: np.ndarray        # int32[C] POISON_* codes
+    straggler_s: np.ndarray   # float64[C] per-client scheduled delay
+    device_loss: bool         # raise DeviceLost on this round's first attempt
+
+    def participation(self) -> np.ndarray:
+        """int32[C] external mask: 1 = scheduled to participate."""
+        return (~self.dropped).astype(np.int32)
+
+
+def schedule_for_round(
+    fc: FaultConfig, round_index: int, num_clients: int
+) -> RoundFaults:
+    """The deterministic fault assignment for one round.
+
+    Keyed by (fc.seed, round_index): independent of call order, process, or
+    how many times it is asked — the property the chaos gate and the
+    killed-then-resumed tests rely on. Dropout count is exact
+    (round(drop_fraction * C)); poison targets are drawn from the clients
+    that DID make the round, so every scheduled fault is observable in the
+    aggregation metadata rather than masked by its own dropout.
+    """
+    rng = np.random.default_rng([int(fc.seed), int(round_index)])
+    dropped = np.zeros(num_clients, dtype=bool)
+    n_drop = min(int(round(fc.drop_fraction * num_clients)), num_clients)
+    if n_drop:
+        dropped[rng.choice(num_clients, n_drop, replace=False)] = True
+    poison = np.zeros(num_clients, dtype=np.int32)
+    alive = np.flatnonzero(~dropped)
+    n_nan = min(int(fc.nan_clients), len(alive))
+    if n_nan:
+        picks = rng.choice(alive, n_nan, replace=False)
+        poison[picks] = POISON_NAN
+        alive = np.setdiff1d(alive, picks)
+    n_huge = min(int(fc.huge_clients), len(alive))
+    if n_huge:
+        poison[rng.choice(alive, n_huge, replace=False)] = POISON_HUGE
+    straggler_s = np.zeros(num_clients)
+    # Stragglers only make sense among clients that actually participate:
+    # a synchronous round never waits on a client its own schedule dropped.
+    candidates = np.flatnonzero(~dropped)
+    n_strag = min(
+        int(round(fc.straggler_fraction * num_clients)), len(candidates)
+    )
+    if n_strag and fc.straggler_delay_s > 0:
+        idx = rng.choice(candidates, n_strag, replace=False)
+        straggler_s[idx] = rng.uniform(
+            0.25 * fc.straggler_delay_s, fc.straggler_delay_s, n_strag
+        )
+    return RoundFaults(
+        dropped=dropped,
+        poison=poison,
+        straggler_s=straggler_s,
+        device_loss=int(round_index) in fc.fail_rounds,
+    )
+
+
+# ---------------------------------------------------------------------------
+# In-program halves: poison injection + sanitization predicates. Both are
+# pure jax transforms traced into the masked round programs (fl.fedavg /
+# fl.secure); a POISON_NONE code and an all-ones mask leave every value
+# bit-identical (jnp.where selection, never arithmetic on the kept path).
+# ---------------------------------------------------------------------------
+
+
+def poison_tree(tree, code: jax.Array):
+    """Apply one client's poison code to its trained update (jittable;
+    vmapped over the client axis by the round programs). code == POISON_NONE
+    returns every leaf bit-identical (pure `where` selection)."""
+
+    def pz(t):
+        out = jnp.where(code == POISON_NAN, jnp.full((), jnp.nan, t.dtype), t)
+        return jnp.where(code == POISON_HUGE, t + jnp.asarray(_HUGE, t.dtype), out)
+
+    return jax.tree_util.tree_map(pz, tree)
+
+
+def _tree_all_finite(tree) -> jax.Array:
+    flags = [jnp.all(jnp.isfinite(l)) for l in jax.tree_util.tree_leaves(tree)]
+    return functools.reduce(jnp.logical_and, flags)
+
+
+def exclusion_bits(cfg, global_params, p_out, mask_blk, overflow=None) -> jax.Array:
+    """Per-client exclusion bitmask for one device's block of clients.
+
+    p_out: stacked trained weight trees (leaves [cpd, ...]); mask_blk:
+    int32[cpd] external participation (0 = scheduled out); overflow:
+    int32[cpd] encoder-saturation counts (secure path only). `cfg` is the
+    (static, hashable) TrainConfig — its max_update_norm / on_overflow
+    knobs decide which predicates trace into the program. -> int32[cpd],
+    0 = participates.
+    """
+    finite = jax.vmap(_tree_all_finite)(p_out)
+    bits = jnp.where(mask_blk > 0, 0, EXCLUDED_SCHEDULED).astype(jnp.int32)
+    bits = bits | jnp.where(finite, 0, EXCLUDED_NONFINITE)
+    if cfg.max_update_norm > 0:
+        from hefl_tpu.fl.dp import global_l2_norm
+
+        norms = jax.vmap(
+            lambda tree: global_l2_norm(
+                jax.tree_util.tree_map(lambda t, g: t - g, tree, global_params)
+            )
+        )(p_out)
+        norm_bad = jnp.logical_and(finite, norms > cfg.max_update_norm)
+        bits = bits | jnp.where(norm_bad, EXCLUDED_NORM, 0)
+    if overflow is not None and cfg.on_overflow == "exclude":
+        bits = bits | jnp.where(overflow > 0, EXCLUDED_OVERFLOW, 0)
+    return bits
+
+
+# ---------------------------------------------------------------------------
+# Round metadata: the host-side public record of who made the aggregate.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundMeta:
+    """Public (non-secret) outcome of one masked round: the participation
+    mask the program actually applied, with cause attribution. `surviving`
+    is the decode denominator `decrypt_average` uses — the count of clients
+    whose (en/plain)crypted updates actually entered the sum."""
+
+    num_clients: int            # real clients (padding slots excluded)
+    bits: tuple[int, ...]       # per-client exclusion bitmask, 0 = kept
+    participation: tuple[int, ...]
+    surviving: int
+    excluded: dict              # cause name -> client count
+    # Whether the sanitization predicates actually RAN this round. False on
+    # the trivial all-ones fast path (the bit-for-bit legacy route, which
+    # traces no predicates): an all-zero bits row there means "nothing was
+    # scheduled out", NOT "every update was checked and passed". Set
+    # max_update_norm or on_overflow="exclude" to force the masked
+    # (sanitizing) program on every round.
+    sanitized: bool = True
+
+    @classmethod
+    def from_bits(cls, bits, sanitized: bool = True) -> "RoundMeta":
+        b = np.asarray(bits, dtype=np.int64)
+        part = (b == 0).astype(np.int32)
+        return cls(
+            num_clients=int(b.size),
+            bits=tuple(int(v) for v in b),
+            participation=tuple(int(v) for v in part),
+            surviving=int(part.sum()),
+            excluded={
+                name: int(np.count_nonzero(b & flag))
+                for name, flag in EXCLUSION_CAUSES.items()
+            },
+            sanitized=sanitized,
+        )
+
+    @classmethod
+    def full_participation(cls, num_clients: int) -> "RoundMeta":
+        """The all-clients-present record (the legacy fast path's meta —
+        no predicates traced, hence sanitized=False)."""
+        return cls.from_bits(np.zeros(num_clients, np.int64), sanitized=False)
+
+    def record(self) -> dict:
+        """JSON-ready summary for history[r] / bench artifacts."""
+        return {
+            "participation": list(self.participation),
+            "surviving": self.surviving,
+            "excluded": dict(self.excluded),
+            "sanitized": self.sanitized,
+        }

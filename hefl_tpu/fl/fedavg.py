@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 from hefl_tpu.data.augment import rescale
 from hefl_tpu.fl.client import local_train
 from hefl_tpu.fl.config import TrainConfig
+from hefl_tpu.fl.faults import RoundMeta, exclusion_bits, poison_tree
 from hefl_tpu.parallel import (
     client_axes,
     client_mesh_size,
@@ -47,8 +48,38 @@ def vmapped_train(module, cfg: TrainConfig, gp, x_blk, y_blk, k_blk):
     return jax.vmap(train_one)(x_blk, y_blk, k_blk)
 
 
+def masked_mean_tree(gp, p_out, keep, axes, total: int):
+    """Participation-masked FedAvg aggregation of one device's stacked
+    client trees — the shared masked-sum/surviving-count operator of BOTH
+    aggregators (the plaintext round below; fl.secure's with_plain_reference
+    output).
+
+    keep: bool[cpd]. The formula is deliberately the legacy pmean's op
+    sequence with a `where`-select and a final scale folded in:
+    mean(where(keep, t, 0)) -> pmean -> * (total / psum(count)) — so an
+    all-kept block degenerates BITWISE to the historical
+    mean -> pmean (where(True, t, 0) selects t exactly, and total/count is
+    exactly 1.0f). A round where nobody survives returns `gp` unchanged
+    rather than a zero model. -> (aggregated tree, surviving count f32).
+    """
+    def mmean(t):
+        k = keep.reshape((-1,) + (1,) * (t.ndim - 1))
+        return jnp.mean(jnp.where(k, t, jnp.zeros((), t.dtype)), axis=0)
+
+    summed = pmean_tree(jax.tree_util.tree_map(mmean, p_out), axes)
+    count = jax.lax.psum(jnp.sum(keep.astype(jnp.float32)), axes)
+    scale = jnp.where(count > 0, jnp.float32(total) / count, jnp.float32(0))
+    out = jax.tree_util.tree_map(
+        lambda t, g: jnp.where(count > 0, (t * scale).astype(t.dtype), g),
+        summed, gp,
+    )
+    return out, count
+
+
 @functools.lru_cache(maxsize=32)
-def _build_round_fn(module, cfg: TrainConfig, mesh, stacked: bool = False):
+def _build_round_fn(
+    module, cfg: TrainConfig, mesh, stacked: bool = False, masked: bool = False
+):
     """Compile-once factory: the jitted SPMD round program for one
     (module, cfg, mesh) triple. Cached so an R-round experiment traces and
     compiles the program a single time, not once per round.
@@ -56,25 +87,78 @@ def _build_round_fn(module, cfg: TrainConfig, mesh, stacked: bool = False):
     stacked=False -> (global mean, metrics): the FedAvg round.
     stacked=True  -> (per-client weight trees [C, ...], metrics): the
     train_clients measurement hook. One factory so the two programs can
-    never drift apart in specs or training body."""
+    never drift apart in specs or training body.
+
+    masked=True is the participation-masked engine (fl.faults): two extra
+    int32[C] traced inputs (participation mask, poison codes) and a third
+    output — the per-client exclusion bitmask. Masks are TRACED arguments,
+    so every round of a faulted experiment, whatever its mask, reuses this
+    one executable; the SPMD program shape never depends on who dropped."""
 
     axes = client_axes(mesh)   # ("clients",) or ("hosts", "clients")
+    total = None if stacked else client_mesh_size(mesh)
 
-    def body(gp, x_blk, y_blk, k_blk):
+    def body(gp, x_blk, y_blk, k_blk, m_blk=None, po_blk=None):
         p_out, mets = vmapped_train(module, cfg, gp, x_blk, y_blk, k_blk)
         if stacked:
             return p_out, mets
-        local_mean = jax.tree_util.tree_map(lambda t: jnp.mean(t, axis=0), p_out)
-        return pmean_tree(local_mean, axes), mets
+        if not masked:
+            local_mean = jax.tree_util.tree_map(
+                lambda t: jnp.mean(t, axis=0), p_out
+            )
+            return pmean_tree(local_mean, axes), mets
+        p_out = jax.vmap(poison_tree)(p_out, po_blk)
+        bits = exclusion_bits(cfg, gp, p_out, m_blk)
+        new_gp, _ = masked_mean_tree(
+            gp, p_out, bits == 0, axes, total * int(x_blk.shape[0])
+        )
+        return new_gp, mets, bits
 
+    in_specs = (P(), P(axes), P(axes), P(axes))
+    out_specs = (P(axes) if stacked else P(), P(axes))
+    if masked:
+        in_specs = in_specs + (P(axes), P(axes))
+        out_specs = out_specs + (P(axes),)
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(), P(axes), P(axes), P(axes)),
-        out_specs=(P(axes) if stacked else P(), P(axes)),
+        in_specs=in_specs,
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(fn)
+
+
+def pad_index(num_clients: int, n_dev: int) -> np.ndarray | None:
+    """Client-axis gather index that pads `num_clients` up to the next
+    multiple of `n_dev` by repeating client 0's slot (the padding clients
+    train on client 0's data with a recycled key and are masked OUT of
+    aggregation — they exist only to keep the SPMD program shape even).
+    None when no padding is needed."""
+    pad = (-num_clients) % n_dev
+    if pad == 0:
+        return None
+    return np.concatenate([np.arange(num_clients), np.zeros(pad, np.int64)])
+
+
+def _mask_inputs(num_clients: int, participation, poison, pad_idx):
+    """Canonicalize (participation, poison) to padded int32 device arrays.
+    Padding slots are scheduled OUT (mask 0) and unpoisoned."""
+    part = (
+        np.ones(num_clients, np.int32)
+        if participation is None
+        else np.asarray(participation).astype(np.int32).reshape(num_clients)
+    )
+    pois = (
+        np.zeros(num_clients, np.int32)
+        if poison is None
+        else np.asarray(poison).astype(np.int32).reshape(num_clients)
+    )
+    if pad_idx is not None:
+        pad = len(pad_idx) - num_clients
+        part = np.concatenate([part, np.zeros(pad, np.int32)])
+        pois = np.concatenate([pois, np.zeros(pad, np.int32)])
+    return jnp.asarray(part), jnp.asarray(pois)
 
 
 def replicate_on(mesh, tree):
@@ -91,6 +175,32 @@ def replicate_on(mesh, tree):
     return jax.tree_util.tree_map(lambda t: jax.device_put(t, rep), tree)
 
 
+def masked_mode(
+    cfg: TrainConfig, num_clients: int, n_dev: int, explicit: bool,
+    secure: bool = False,
+) -> bool:
+    """SINGLE source of the masked-engine routing predicate, shared by
+    `fedavg_round`, `fl.secure.secure_fedavg_round`, and the experiment
+    driver — the round functions' return arity (meta appended or not)
+    follows this predicate, so encoding it once keeps the producers and
+    the driver's unpack from ever drifting. `explicit` = the caller passed
+    a participation mask or poison codes; `secure` enables the
+    encrypted-path-only on_overflow signal."""
+    sanitizing = cfg.max_update_norm > 0 or (
+        secure and cfg.on_overflow == "exclude"
+    )
+    return explicit or num_clients % n_dev != 0 or sanitizing
+
+
+def _trivial_mask(participation, poison) -> bool:
+    """True when the caller's mask/poison cannot change the round's result:
+    the all-ones / no-poison case routes to the legacy executable, so a
+    robustness-enabled driver whose schedule happens to be clean this round
+    reproduces historical seeds bit-for-bit AND compiles no extra program."""
+    ok = participation is None or bool(np.all(np.asarray(participation) != 0))
+    return ok and (poison is None or not np.any(np.asarray(poison)))
+
+
 def fedavg_round(
     module,
     cfg: TrainConfig,
@@ -99,19 +209,47 @@ def fedavg_round(
     xs: jax.Array,
     ys: jax.Array,
     key: jax.Array,
+    participation=None,
+    poison=None,
 ):
     """One synchronous FedAvg round.
 
     xs: uint8[C, m, H, W, ch], ys: int32[C, m] federated arrays (C clients,
     axis 0 sharded over the mesh). -> (new_global_params, metrics[C, E, 4]).
+
+    Partial participation (`participation`: int-like[C], 0 = scheduled
+    out), fault injection (`poison`: fl.faults POISON_* codes[C]), a
+    non-divisible client count (padded with masked-out dummy clients), or
+    TrainConfig.max_update_norm > 0 route the round through the masked
+    engine, which appends a third output: the round's `fl.faults.RoundMeta`
+    (who aggregated, who was excluded and why). An all-ones mask with no
+    poison and no sanitization knobs takes the historical fast path —
+    bit-identical outputs, same compiled program, meta of all-zeros bits.
     """
     num_clients = int(xs.shape[0])
     n_dev = client_mesh_size(mesh)
-    if num_clients % n_dev != 0:
-        raise ValueError(f"{num_clients} clients on {n_dev} devices: must divide")
+    pad_idx = pad_index(num_clients, n_dev)
+    explicit = participation is not None or poison is not None
+    masked = masked_mode(cfg, num_clients, n_dev, explicit)
     client_keys = jax.random.split(key, num_clients)
     gp = replicate_on(mesh, global_params)
-    return _build_round_fn(module, cfg, mesh)(gp, xs, ys, client_keys)
+    if not masked:
+        return _build_round_fn(module, cfg, mesh)(gp, xs, ys, client_keys)
+    if (
+        pad_idx is None
+        and cfg.max_update_norm <= 0
+        and _trivial_mask(participation, poison)
+    ):
+        new_p, mets = _build_round_fn(module, cfg, mesh)(gp, xs, ys, client_keys)
+        return new_p, mets, RoundMeta.full_participation(num_clients)
+    part, pois = _mask_inputs(num_clients, participation, poison, pad_idx)
+    if pad_idx is not None:
+        xs, ys, client_keys = xs[pad_idx], ys[pad_idx], client_keys[pad_idx]
+    new_p, mets, bits = _build_round_fn(module, cfg, mesh, masked=True)(
+        gp, xs, ys, client_keys, part, pois
+    )
+    meta = RoundMeta.from_bits(np.asarray(bits)[:num_clients])
+    return new_p, mets[:num_clients], meta
 
 
 def train_clients(
@@ -129,14 +267,23 @@ def train_clients(
     Uses the same per-client key derivation as `fedavg_round` (split(key, C)),
     so `train_clients(..., k_train)` reproduces the trainings inside
     `secure_fedavg_round(..., key)` when `k_train, _ = jax.random.split(key)`.
+    A client count that does not divide the mesh is padded (client 0's data,
+    recycled key) and the padding rows sliced off the outputs.
     """
     num_clients = int(xs.shape[0])
     n_dev = client_mesh_size(mesh)
-    if num_clients % n_dev != 0:
-        raise ValueError(f"{num_clients} clients on {n_dev} devices: must divide")
+    pad_idx = pad_index(num_clients, n_dev)
     client_keys = jax.random.split(key, num_clients)
     gp = replicate_on(mesh, global_params)
-    return _build_round_fn(module, cfg, mesh, stacked=True)(gp, xs, ys, client_keys)
+    if pad_idx is not None:
+        xs, ys, client_keys = xs[pad_idx], ys[pad_idx], client_keys[pad_idx]
+    p_out, mets = _build_round_fn(module, cfg, mesh, stacked=True)(
+        gp, xs, ys, client_keys
+    )
+    if pad_idx is not None:
+        p_out = jax.tree_util.tree_map(lambda t: t[:num_clients], p_out)
+        mets = mets[:num_clients]
+    return p_out, mets
 
 
 @partial(jax.jit, static_argnums=(0, 3))

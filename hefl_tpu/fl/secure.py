@@ -28,12 +28,23 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+import numpy as np
+
 from hefl_tpu.ckks import encoding, ops
 from hefl_tpu.ckks.keys import CkksContext, PublicKey, SecretKey
 from hefl_tpu.ckks.ops import Ciphertext
 from hefl_tpu.ckks.packing import PackSpec, pack_pytree, unpack_blocks
 from hefl_tpu.fl.config import TrainConfig
-from hefl_tpu.fl.fedavg import replicate_on, vmapped_train
+from hefl_tpu.fl.faults import RoundMeta, exclusion_bits, poison_tree
+from hefl_tpu.fl.fedavg import (
+    _mask_inputs,
+    _trivial_mask,
+    masked_mean_tree,
+    masked_mode,
+    pad_index,
+    replicate_on,
+    vmapped_train,
+)
 from hefl_tpu.ckks.modular import add_mod as modular_add_mod
 from hefl_tpu.parallel import (
     client_axes,
@@ -105,22 +116,54 @@ def decrypt_average(
     ctx: CkksContext,
     sk: SecretKey,
     ct_sum: Ciphertext,
-    num_clients: int,
-    spec: PackSpec,
+    num_clients: int | None = None,
+    spec: PackSpec = None,
     exact: bool = False,
+    meta: "RoundMeta | None" = None,
 ):
     """Owner-side decrypt of the aggregated sum -> averaged parameter pytree.
 
-    `decrypt_import_weights` (FLPyfhelin.py:263-281). Division by
-    `num_clients` happens in the decode scale — exact, no ciphertext op.
+    `decrypt_import_weights` (FLPyfhelin.py:263-281). Division by the
+    client count happens in the decode scale — exact, no ciphertext op.
     `exact=True` routes through the host bignum CRT (the trust-boundary
     path used for final model export); default is the jittable f32 decode.
-    """
-    res = ops.decrypt(ctx, sk, ct_sum)
-    denom = ct_sum.scale * num_clients
-    if exact:
-        import numpy as np
 
+    Under partial participation the denominator MUST be the round's
+    surviving-client count, not the static experiment-wide total — dividing
+    a k-client sum by C silently shrinks the model toward zero. Pass the
+    masked round's `meta` (fl.faults.RoundMeta) and the decode divides by
+    `meta.surviving`; `num_clients`, when also given, is cross-checked
+    against the metadata's client count and a mismatch is an error (wrong
+    round's metadata, or a stale static count). The pre-masking signature
+    `decrypt_average(ctx, sk, ct, num_clients, spec)` keeps working: no
+    meta means full participation and `num_clients` is the denominator.
+    """
+    if spec is None:
+        raise TypeError("decrypt_average: spec (the PackSpec) is required")
+    if meta is not None:
+        if num_clients is not None and int(num_clients) != int(meta.num_clients):
+            raise ValueError(
+                f"decrypt_average: caller-supplied num_clients={num_clients} "
+                f"disagrees with the round metadata ({meta.num_clients} "
+                "clients) — pass the RoundMeta from the SAME round (or drop "
+                "num_clients and trust the metadata)"
+            )
+        surviving = int(meta.surviving)
+        if surviving <= 0:
+            raise ValueError(
+                "decrypt_average: round metadata reports 0 surviving clients "
+                "— the aggregate is an encryption of zero; skip the round "
+                "instead of decoding it"
+            )
+    elif num_clients is None:
+        raise TypeError(
+            "decrypt_average: need num_clients or the round's RoundMeta"
+        )
+    else:
+        surviving = int(num_clients)
+    res = ops.decrypt(ctx, sk, ct_sum)
+    denom = ct_sum.scale * surviving
+    if exact:
         blocks = jnp.asarray(
             encoding.decode_exact(ctx.ntt, np.asarray(res), denom).astype(np.float32)
         )
@@ -141,6 +184,8 @@ def secure_fedavg_round(
     key: jax.Array,
     with_plain_reference: bool = False,
     dp=None,
+    participation=None,
+    poison=None,
 ) -> tuple:
     """One encrypted FedAvg round: local training + encrypt + psum, jitted.
 
@@ -157,21 +202,42 @@ def secure_fedavg_round(
     any nonzero value means the flagship fidelity number is clipped and the
     scale must come down (VERDICT r2 weak #1's silent-saturation guard).
 
-    with_plain_reference=True is a MEASUREMENT-ONLY mode that appends a 4th
-    output: the plaintext FedAvg mean of the SAME in-program trained
-    weights (pmean over the same mesh). It deliberately leaks what the
-    encrypted path exists to hide — never use it in production — but it is
-    the only way to check the full production pipeline (encode + encrypt +
-    hierarchical psum-of-limbs + decrypt) against a plaintext reference at
-    flagship scale: re-running training in a second XLA program is not
+    with_plain_reference=True is a MEASUREMENT-ONLY mode that appends a
+    final output: the plaintext FedAvg mean of the SAME in-program trained
+    weights (pmean over the same mesh; the participation-masked mean when
+    the round runs masked). It deliberately leaks what the encrypted path
+    exists to hide — never use it in production — but it is the only way to
+    check the full production pipeline (encode + encrypt + hierarchical
+    psum-of-limbs + decrypt) against a plaintext reference at flagship
+    scale: re-running training in a second XLA program is not
     bit-reproducible (fusion-level float differences flip the discrete
     best-epoch restore), so a cross-program comparison measures training
     chaos, not HE error. bench.py's cell-6 artifact uses this.
+
+    Partial participation / fault injection (`participation`, `poison` —
+    same contract as fedavg.fedavg_round), a non-divisible client count
+    (padded with masked-out dummies), TrainConfig.max_update_norm > 0, or
+    on_overflow="exclude" route through the masked engine: dropped or
+    sanitized-out clients' ciphertext limbs are zeroed (a `where` select,
+    not a skipped collective — the SPMD program shape stays static) BEFORE
+    the psum, and the return gains a `RoundMeta` (inserted after
+    `encode_overflow`) whose `surviving` count is the public metadata
+    `decrypt_average` needs for its decode denominator. An all-ones mask
+    with no poison and no sanitization knobs takes the historical fast
+    path: bit-identical ciphertexts, same compiled program.
     """
     num_clients = int(xs.shape[0])
     n_dev = client_mesh_size(mesh)
-    if num_clients % n_dev != 0:
-        raise ValueError(f"{num_clients} clients on {n_dev} devices: must divide")
+    pad_idx = pad_index(num_clients, n_dev)
+    sanitizing = cfg.on_overflow == "exclude" or cfg.max_update_norm > 0
+    explicit = participation is not None or poison is not None
+    masked = masked_mode(cfg, num_clients, n_dev, explicit, secure=True)
+    trivial = (
+        masked
+        and pad_idx is None
+        and not sanitizing
+        and _trivial_mask(participation, poison)
+    )
     # dp=None keeps the historical 2-way split so existing seeds reproduce.
     if dp is None:
         k_train, k_enc = jax.random.split(key)
@@ -179,20 +245,63 @@ def secure_fedavg_round(
         k_train, k_enc, k_dp = jax.random.split(key, 3)
     train_keys = jax.random.split(k_train, num_clients)
     enc_keys = jax.random.split(k_enc, num_clients)
+    dp_keys = jax.random.split(k_dp, num_clients) if dp is not None else None
     # Canonicalize the replicated-global-params sharding so round 1 (params
     # now a decrypt_average output) reuses round 0's executable — see
     # fedavg.replicate_on.
     gp = replicate_on(mesh, global_params)
-    if dp is None:
-        # Keep the historical 5-arg cache key: dp-off rounds of any client
-        # count share one compiled program per configuration.
-        fn = _build_secure_round_fn(module, cfg, mesh, ctx, with_plain_reference)
-        return fn(gp, pk, xs, ys, train_keys, enc_keys)
+    if not masked or trivial:
+        # Historical program (also the all-ones/no-poison masked call: the
+        # mask cannot change the sum, so reuse the legacy executable and
+        # synthesize the full-participation metadata).
+        if dp is None:
+            # Keep the historical 5-arg cache key: dp-off rounds of any
+            # client count share one compiled program per configuration.
+            fn = _build_secure_round_fn(
+                module, cfg, mesh, ctx, with_plain_reference
+            )
+            outs = fn(gp, pk, xs, ys, train_keys, enc_keys)
+        else:
+            fn = _build_secure_round_fn(
+                module, cfg, mesh, ctx, with_plain_reference, dp, num_clients
+            )
+            outs = fn(gp, pk, xs, ys, train_keys, enc_keys, dp_keys)
+        if not masked:
+            return outs
+        meta = RoundMeta.full_participation(num_clients)
+        return outs[:3] + (meta,) + outs[3:]
+    part, pois = _mask_inputs(num_clients, participation, poison, pad_idx)
+    if pad_idx is not None:
+        xs, ys = xs[pad_idx], ys[pad_idx]
+        train_keys, enc_keys = train_keys[pad_idx], enc_keys[pad_idx]
+        if dp_keys is not None:
+            dp_keys = dp_keys[pad_idx]
     fn = _build_secure_round_fn(
-        module, cfg, mesh, ctx, with_plain_reference, dp, num_clients
+        module, cfg, mesh, ctx, with_plain_reference, dp, num_clients,
+        masked=True,
     )
-    dp_keys = jax.random.split(k_dp, num_clients)
-    return fn(gp, pk, xs, ys, train_keys, enc_keys, dp_keys)
+    args = (gp, pk, xs, ys, train_keys, enc_keys)
+    if dp is not None:
+        args = args + (dp_keys,)
+    outs = fn(*args + (part, pois))
+    ct_sum, mets, overflow, bits = outs[:4]
+    meta = RoundMeta.from_bits(np.asarray(bits)[:num_clients])
+    if dp is not None and meta.surviving < num_clients:
+        # fl.dp calibrates each client's noise share to sigma*C/sqrt(K) so
+        # K surviving shares sum to the central mechanism's sigma*C. A
+        # masked-out client's zeroed limbs also zero its noise share, so
+        # the aggregate would carry only sqrt(k/K) of the accounted noise —
+        # a silently weakened (epsilon, delta) guarantee, the one failure
+        # mode the dp path must never allow. Fail loudly instead.
+        raise ValueError(
+            f"dp round excluded {num_clients - meta.surviving} of "
+            f"{num_clients} clients ({meta.excluded}); distributed noise "
+            "shares are calibrated for full participation, so the release "
+            "would carry less noise than epsilon_spent accounts — disable "
+            "fault injection/sanitization for dp runs, or re-run the round"
+        )
+    out = (ct_sum, mets[:num_clients], overflow[:num_clients], meta)
+    return out + tuple(outs[4:])
 
 
 @functools.lru_cache(maxsize=32)
@@ -201,6 +310,7 @@ def _build_secure_round_fn(
     with_plain_reference: bool = False,
     dp=None,
     num_clients: int = 0,
+    masked: bool = False,
 ):
     """Compile-once factory for the encrypted round program (same rationale
     as fedavg._build_round_fn: one trace/compile per configuration, reused
@@ -211,11 +321,27 @@ def _build_secure_round_fn(
     on per-client clip-and-noise between training and encryption: the
     DP-FedAvg sanitizer runs inside this same SPMD program, so the
     plaintext clipped-but-unnoised update never leaves the device either.
+
+    `masked` is the participation-masked engine (fl.faults): two extra
+    int32[C] traced inputs (participation mask, poison codes) appended
+    after the key blocks, and one extra output — the per-client exclusion
+    bitmask — inserted after `encode_overflow`. A dropped or sanitized-out
+    client's ciphertext limbs are ZEROED before the local lazy sum (a
+    masked limb-select; zero residues are the additive identity mod p, so
+    the psum-of-limbs collective and the whole SPMD program shape are
+    untouched by who dropped). Masks are traced values: every round of a
+    faulted run shares this one executable.
     """
 
     axes = client_axes(mesh)   # ("clients",) or ("hosts", "clients")
+    n_dev = client_mesh_size(mesh)
 
-    def body(gp, pk, x_blk, y_blk, kt_blk, ke_blk, kd_blk=None):
+    def body(gp, pk, x_blk, y_blk, kt_blk, ke_blk, *rest):
+        i = 0
+        kd_blk = None
+        if dp is not None:
+            kd_blk, i = rest[0], 1
+        m_blk, po_blk = (rest[i], rest[i + 1]) if masked else (None, None)
         p_out, mets = vmapped_train(module, cfg, gp, x_blk, y_blk, kt_blk)
         if dp is not None:
             from hefl_tpu.fl.dp import dp_sanitize
@@ -223,6 +349,11 @@ def _build_secure_round_fn(
             p_out, _ = jax.vmap(
                 lambda k, t: dp_sanitize(k, gp, t, dp, num_clients)
             )(kd_blk, p_out)
+        if masked:
+            # Fault injection corrupts the UPLOAD (after training and after
+            # any DP sanitize — a poisoned client does not run its own
+            # defenses); POISON_NONE is a pure where-select no-op.
+            p_out = jax.vmap(poison_tree)(p_out, po_blk)
         # Saturation diagnostic on exactly what gets encoded (the packed
         # blocks); XLA CSEs the duplicate pack with encrypt_params' own.
         ov_one = lambda prm: encoding.encode_overflow_count(  # noqa: E731
@@ -230,6 +361,15 @@ def _build_secure_round_fn(
         )
         overflow = jax.vmap(ov_one)(p_out)             # [cpd] int32
         cts = encrypt_stack(ctx, pk, p_out, ke_blk)    # [cpd, n_ct, L, N]
+        if masked:
+            bits = exclusion_bits(cfg, gp, p_out, m_blk, overflow)
+            keep = bits == 0
+            sel = keep.reshape((-1, 1, 1, 1))
+            cts = Ciphertext(
+                c0=jnp.where(sel, cts.c0, jnp.uint32(0)),
+                c1=jnp.where(sel, cts.c1, jnp.uint32(0)),
+                scale=cts.scale,
+            )
         local = aggregate_encrypted(ctx, cts)          # this device's clients
         p = jnp.asarray(ctx.ntt.p)
         # Per-device partials are canonical (< p < 2**27), so each stage of
@@ -247,19 +387,31 @@ def _build_secure_round_fn(
             mets,
             overflow,
         )
+        if masked:
+            outs = outs + (bits,)
         if with_plain_reference:
-            local_mean = jax.tree_util.tree_map(
-                lambda t: jnp.mean(t, axis=0), p_out
-            )
-            outs = outs + (pmean_tree(local_mean, axes),)
+            if masked:
+                ref, _ = masked_mean_tree(
+                    gp, p_out, keep, axes, n_dev * int(x_blk.shape[0])
+                )
+            else:
+                local_mean = jax.tree_util.tree_map(
+                    lambda t: jnp.mean(t, axis=0), p_out
+                )
+                ref = pmean_tree(local_mean, axes)
+            outs = outs + (ref,)
         return outs
 
     out_specs = (P(), P(axes), P(axes))
+    if masked:
+        out_specs = out_specs + (P(axes),)
     if with_plain_reference:
         out_specs = out_specs + (P(),)
     in_specs = (P(), P(), P(axes), P(axes), P(axes), P(axes))
     if dp is not None:
         in_specs = in_specs + (P(axes),)   # per-client dp noise keys
+    if masked:
+        in_specs = in_specs + (P(axes), P(axes))  # participation, poison
     fn = shard_map(
         body,
         mesh=mesh,

@@ -62,14 +62,13 @@ def make_mesh(num_clients: int, devices: list | None = None) -> Mesh:
     When num_clients exceeds the device count (e.g. 16 clients on a v4-8),
     the client axis of the federated arrays is still sharded over this mesh
     and each device sequentially simulates `num_clients / n_devices` clients
-    via an inner vmap — see fl.fedavg. num_clients must then divide evenly.
+    via an inner vmap — see fl.fedavg. A count that does NOT divide the
+    mesh is fine: the round engines pad the client axis with masked-out
+    dummy clients (fl.fedavg.pad_index), so any client count runs on any
+    mesh.
     """
     devs = list(devices if devices is not None else jax.devices())
     n = min(num_clients, len(devs))
-    if num_clients % n != 0:
-        raise ValueError(
-            f"num_clients={num_clients} must be a multiple of mesh size {n}"
-        )
     return Mesh(np.array(devs[:n]), (CLIENT_AXIS,))
 
 

@@ -1,4 +1,5 @@
-"""The five BASELINE.json benchmark configurations as named presets.
+"""The five BASELINE.json benchmark configurations as named presets,
+plus the robustness ("chaos") smoke preset the fault-injection gate runs.
 
 BASELINE.json `configs` (derived from the reference's experiment grid —
 notebook cell 3 loops over client counts, FLPyfhelin.py:179-198 — plus the
@@ -19,7 +20,13 @@ north-star metric — is a min over two post-cold samples.
 from __future__ import annotations
 
 from hefl_tpu.experiment import ExperimentConfig, HEConfig
-from hefl_tpu.fl import TrainConfig
+from hefl_tpu.fl import FaultConfig, TrainConfig
+
+# The five reference-derived benchmark configurations (BASELINE.json);
+# results.py and test_presets iterate THIS list, not every preset.
+BASELINE_PRESET_NAMES = (
+    "mnist-plain", "mnist-enc", "medical-8", "medical-skew", "cifar-resnet16",
+)
 
 _MNIST_TRAIN = TrainConfig(num_classes=10, warmup_steps=0)
 # Warmup ~= 2 epochs of steps: 8 clients x 200 images -> 180 train, bs 32
@@ -49,5 +56,24 @@ PRESETS: dict[str, ExperimentConfig] = {
         model="resnet20", dataset="cifar10", num_clients=16, rounds=3,
         encrypted=True, train=TrainConfig(num_classes=10), he=HEConfig(),
         seed=0,
+    ),
+    # Robustness smoke (run_chaos_smoke.sh; CPU-sized): an encrypted run
+    # under the ISSUE-2 chaos schedule — 25% scheduled dropout plus one
+    # NaN-poisoned client every round, one simulated device loss — that
+    # must still converge within tolerance of the clean run. Small ring +
+    # tiny mnist so the whole faulted-vs-clean comparison fits in a
+    # CI-sized budget; the ROBUSTNESS knobs, not the model, are under test.
+    "chaos-smoke": ExperimentConfig(
+        model="smallcnn", dataset="mnist", num_clients=8, rounds=4,
+        encrypted=True, he=HEConfig(n=256), seed=0,
+        n_train=512, n_test=128,
+        train=TrainConfig(
+            num_classes=10, epochs=1, batch_size=8, augment=False,
+            val_fraction=0.25, on_overflow="exclude",
+        ),
+        faults=FaultConfig(
+            seed=0, drop_fraction=0.25, nan_clients=1, fail_rounds=(2,),
+        ),
+        max_round_retries=1, retry_backoff_s=0.1,
     ),
 }

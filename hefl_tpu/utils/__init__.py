@@ -20,6 +20,7 @@ from hefl_tpu.utils.serialization import (
     save_secret_key,
 )
 from hefl_tpu.utils.checkpoint import (
+    CheckpointError,
     load_checkpoint,
     load_params,
     save_checkpoint,
@@ -38,6 +39,7 @@ __all__ = [
     "load_relin_key",
     "save_galois_key",
     "load_galois_key",
+    "CheckpointError",
     "save_checkpoint",
     "load_checkpoint",
     "save_params",

@@ -26,6 +26,36 @@ def _npz_path(path: str) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
+class CheckpointError(RuntimeError):
+    """A checkpoint file exists but cannot be read back. Because every
+    writer in this module is atomic (tmp + rename), a corrupt/truncated
+    file can only mean external damage — so resume must fail LOUDLY here
+    rather than let a half-restored state poison the run."""
+
+
+def _read_npz(path: str) -> dict[str, np.ndarray]:
+    """Eagerly read every array of an npz, normalizing unreadable-archive
+    failures (truncation, bad zip, member decompression errors, disk-level
+    corruption) to CheckpointError. Missing file stays FileNotFoundError —
+    'no checkpoint yet' and 'damaged checkpoint' are different conditions.
+    """
+    import zipfile
+    import zlib
+
+    target = _npz_path(path)
+    try:
+        with np.load(target) as z:
+            return {k: z[k] for k in z.files}
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, OSError, EOFError, ValueError, zlib.error) as e:
+        raise CheckpointError(
+            f"checkpoint {target!r} is corrupt or truncated ({e}); every "
+            "writer here is atomic, so this file was damaged after the "
+            "write — delete it and resume from an older checkpoint"
+        ) from e
+
+
 def _flatten_named(params) -> dict[str, np.ndarray]:
     flat, _ = jax.tree_util.tree_flatten_with_path(params)
     out = {}
@@ -43,8 +73,8 @@ def save_params(path: str, params) -> None:
 
 def load_params(path: str, template):
     """Restore a pytree saved by `save_params` into `template`'s structure."""
-    with np.load(_npz_path(path)) as z:
-        named = {k[len("param:"):]: z[k] for k in z.files if k.startswith("param:")}
+    z = _read_npz(path)
+    named = {k[len("param:"):]: v for k, v in z.items() if k.startswith("param:")}
     return _restore_into(template, named)
 
 
@@ -95,10 +125,20 @@ def save_pytree(path: str, tree, meta: dict | None = None) -> None:
 def load_pytree(path: str, template):
     """Restore a `save_pytree` artifact into `template`'s structure.
     -> (tree, meta)."""
-    with np.load(_npz_path(path)) as z:
-        header = json.loads(bytes(z["header"]).decode())
-        named = {k[len("param:"):]: z[k] for k in z.files if k.startswith("param:")}
+    z = _read_npz(path)
+    header = _parse_header(path, z)
+    named = {k[len("param:"):]: v for k, v in z.items() if k.startswith("param:")}
     return _restore_into(template, named), header.get("meta", {})
+
+
+def _parse_header(path: str, arrays: dict[str, np.ndarray]) -> dict:
+    try:
+        return json.loads(bytes(arrays["header"]).decode())
+    except (KeyError, ValueError, UnicodeDecodeError) as e:
+        raise CheckpointError(
+            f"checkpoint {_npz_path(path)!r} has a missing/unreadable "
+            f"header ({e}) — the file is damaged or not a checkpoint"
+        ) from e
 
 
 def save_checkpoint(
@@ -117,12 +157,22 @@ def save_checkpoint(
 
 
 def load_checkpoint(path: str, template):
-    """-> (params, round_index, rng_key, meta)."""
+    """-> (params, round_index, rng_key, meta).
+
+    Raises CheckpointError (loudly, never a silent partial restore) when
+    the file is corrupt/truncated — the atomic writer guarantees a file
+    that exists is complete, so damage means the resume must not proceed.
+    """
     import jax.numpy as jnp
 
-    with np.load(_npz_path(path)) as z:
-        header = json.loads(bytes(z["header"]).decode())
-        named = {k[len("param:"):]: z[k] for k in z.files if k.startswith("param:")}
-        rng_key = jax.random.wrap_key_data(jnp.asarray(z["rng_key"]))
+    z = _read_npz(path)
+    header = _parse_header(path, z)
+    named = {k[len("param:"):]: v for k, v in z.items() if k.startswith("param:")}
+    if "rng_key" not in z or "round" not in header:
+        raise CheckpointError(
+            f"checkpoint {_npz_path(path)!r} is missing its rng_key/round "
+            "record — not a round checkpoint (or damaged)"
+        )
+    rng_key = jax.random.wrap_key_data(jnp.asarray(z["rng_key"]))
     params = _restore_into(template, named)
     return params, int(header["round"]), rng_key, header.get("meta", {})

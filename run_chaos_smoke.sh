@@ -1,0 +1,122 @@
+#!/bin/bash
+# CPU chaos smoke: proves the fault-tolerant round engine end-to-end on the
+# driver box — the robustness analog of run_perf_smoke.sh. Runs the
+# `chaos-smoke` preset (25% scheduled dropout + one NaN-poisoned client per
+# round + one simulated device loss, all deterministic via fl/faults.py)
+# against its clean twin, then gates on:
+#   (a) every round excluded EXACTLY the scheduled/poisoned clients
+#       (asserted via the round metadata the masked engine returns);
+#   (b) zero unflagged NaNs in the artifact: any non-finite per-client
+#       metric must belong to a client the round metadata excluded, and
+#       the final aggregated params must be finite;
+#   (c) the faulted run's final accuracy is within tolerance of the clean
+#       run's (a NaN client that leaks into the aggregate fails this hard);
+#   (d) the simulated device-loss round really exercised the retry path.
+# Artifact: CHAOS_SMOKE.json (both accuracy curves + per-round exclusions).
+# Wired into run_tpu_suite.sh as stage 0b (CPU-only, no TPU probe needed).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+export JAX_PLATFORMS=cpu
+# The preset's 8 clients need the virtual 8-device mesh (same emulation the
+# test suite uses; harmless if XLA_FLAGS already pins a device count).
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
+python - <<'PY'
+import dataclasses
+import json
+import math
+import sys
+
+import numpy as np
+
+from hefl_tpu.experiment import run_experiment
+from hefl_tpu.fl import schedule_for_round
+from hefl_tpu.presets import PRESETS
+
+ACC_TOL = 0.20   # tiny-run noise floor; a leaked NaN fails by orders more
+
+cfg = PRESETS["chaos-smoke"]
+clean_cfg = dataclasses.replace(
+    cfg, faults=None, train=dataclasses.replace(cfg.train, on_overflow="warn")
+)
+
+print("chaos smoke: clean twin ...", flush=True)
+clean = run_experiment(clean_cfg, verbose=False)
+print("chaos smoke: faulted run ...", flush=True)
+chaos = run_experiment(cfg, verbose=False)
+
+fail = []
+rounds = []
+saw_retry = False
+for r, rec in enumerate(chaos["history"]):
+    rob = rec.get("robust")
+    if rob is None:
+        fail.append(f"round {r}: no robustness metadata in history")
+        continue
+    sched = schedule_for_round(cfg.faults, r, cfg.num_clients)
+    expect = set(np.flatnonzero(sched.dropped).tolist()) | set(
+        np.flatnonzero(sched.poison).tolist()
+    )
+    got = {i for i, p in enumerate(rob["participation"]) if not p}
+    if got != expect:
+        fail.append(
+            f"round {r}: excluded {sorted(got)} but schedule says "
+            f"{sorted(expect)}"
+        )
+    saw_retry = saw_retry or rob["round_retries"] > 0
+    # (b) unflagged-NaN gate: every non-finite per-client metric must be an
+    # excluded client's.
+    for name in ("val_loss", "val_acc"):
+        for i, v in enumerate(rec[name]):
+            if not math.isfinite(v) and i not in got:
+                fail.append(
+                    f"round {r}: client {i} has non-finite {name} but was "
+                    "NOT excluded"
+                )
+    rounds.append(
+        {"round": r, "accuracy": rec["accuracy"], "surviving": rob["surviving"],
+         "excluded": rob["excluded"], "retries": rob["round_retries"]}
+    )
+if not saw_retry:
+    fail.append("device-loss round never exercised the retry path")
+import jax
+
+for leaf in jax.tree_util.tree_leaves(chaos["params"]):
+    if not np.all(np.isfinite(np.asarray(leaf))):
+        fail.append("final aggregated params contain non-finite values")
+        break
+
+acc_clean = clean["history"][-1]["accuracy"]
+acc_chaos = chaos["history"][-1]["accuracy"]
+if abs(acc_clean - acc_chaos) > ACC_TOL:
+    fail.append(
+        f"final accuracy diverged: clean {acc_clean:.4f} vs chaos "
+        f"{acc_chaos:.4f} (tol {ACC_TOL})"
+    )
+
+artifact = {
+    "preset": "chaos-smoke",
+    "acc_clean_by_round": [h["accuracy"] for h in clean["history"]],
+    "acc_chaos_by_round": [h["accuracy"] for h in chaos["history"]],
+    "rounds": rounds,
+    "acc_tolerance": ACC_TOL,
+    "passed": not fail,
+    "failures": fail,
+}
+with open("CHAOS_SMOKE.json", "w") as f:
+    json.dump(artifact, f, indent=1)
+
+if fail:
+    print("CHAOS SMOKE FAILED:")
+    for f_ in fail:
+        print(" -", f_)
+    sys.exit(1)
+print(
+    f"chaos smoke OK: clean {acc_clean:.4f} vs chaos {acc_chaos:.4f}, "
+    "exclusions match the schedule exactly, no unflagged NaNs, "
+    "device-loss retry exercised"
+)
+PY

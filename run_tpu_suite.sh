@@ -62,6 +62,14 @@ echo "=== stage 0: CPU perf smoke (MFU/roofline + attribution schema gate)"
 # row, BEFORE the window spends 30-minute stages producing it.
 run_stage stage0 600 "" perf_smoke_err.log bash run_perf_smoke.sh
 
+echo "=== stage 0b: CPU chaos smoke (fault-injection + robustness gate)"
+# CPU-only like stage 0: drops 25% of clients + NaN-poisons one per round
+# (deterministic fl/faults.py schedule) and gates on exclusions matching
+# the schedule, zero unflagged NaNs in artifacts, and final accuracy
+# within tolerance of the clean run — BEFORE any TPU window trusts the
+# robustness machinery. Artifact: CHAOS_SMOKE.json.
+run_stage stage0b 900 "" chaos_smoke_err.log bash run_chaos_smoke.sh
+
 echo "=== stage 1: NTT microbenchmark + on-hardware Pallas parity gate"
 # Runs FIRST: it bit-exact-compares the Pallas kernel against the XLA path
 # on real hardware. If the kernel is broken (exit 42: deterministic parity

@@ -91,6 +91,31 @@ def test_cli_flags_map_to_config():
     assert cfg.train.prox_mu == 0.1 and cfg.train.augment is False
     assert cfg.train.num_classes == 10  # resnet20 registry default
     assert cfg.he.n == 2048
+    assert cfg.faults is None and cfg.max_round_retries == 0  # defaults
+
+
+def test_cli_robustness_flags_map_to_config():
+    args = build_parser().parse_args(
+        [
+            "--drop-fraction", "0.25", "--nan-clients", "1",
+            "--huge-clients", "2", "--straggler-delay", "1.5",
+            "--fail-rounds", "1,3", "--fault-seed", "7",
+            "--max-round-retries", "2", "--retry-backoff", "0.1",
+            "--on-overflow", "exclude", "--max-update-norm", "50",
+        ]
+    )
+    cfg = config_from_args(args)
+    assert cfg.faults is not None
+    assert cfg.faults.drop_fraction == 0.25 and cfg.faults.nan_clients == 1
+    assert cfg.faults.huge_clients == 2 and cfg.faults.seed == 7
+    assert cfg.faults.straggler_delay_s == 1.5
+    assert cfg.faults.straggler_fraction == 0.25
+    assert cfg.faults.fail_rounds == (1, 3)
+    assert cfg.max_round_retries == 2 and cfg.retry_backoff_s == 0.1
+    assert cfg.train.on_overflow == "exclude"
+    assert cfg.train.max_update_norm == 50.0
+    # no fault knob set -> no FaultConfig, legacy fast path
+    assert config_from_args(build_parser().parse_args([])).faults is None
 
 
 def test_data_dir_experiment(tmp_path):
@@ -149,10 +174,11 @@ def test_presets_cover_baseline_configs():
     # BASELINE.json names five configurations; every one must have a preset
     # and each preset must be a valid, internally-consistent config.
     from hefl_tpu.models import MODEL_REGISTRY
-    from hefl_tpu.presets import PRESETS
+    from hefl_tpu.presets import BASELINE_PRESET_NAMES, PRESETS
 
-    assert len(PRESETS) == 5
-    assert [p.encrypted for p in PRESETS.values()].count(False) == 1  # config 1
+    assert len(BASELINE_PRESET_NAMES) == 5
+    baseline = {n: PRESETS[n] for n in BASELINE_PRESET_NAMES}
+    assert [p.encrypted for p in baseline.values()].count(False) == 1  # config 1
     for name, cfg in PRESETS.items():
         assert cfg.model in MODEL_REGISTRY, name
         assert cfg.rounds >= 2, f"{name}: need a warm round to measure"
@@ -160,6 +186,14 @@ def test_presets_cover_baseline_configs():
     assert PRESETS["medical-skew"].partition == "label_skew"
     assert PRESETS["medical-skew"].train.prox_mu > 0
     assert PRESETS["cifar-resnet16"].num_clients == 16
+    # the baseline measurement sweep must stay clean: no fault injection
+    for name, cfg in baseline.items():
+        assert cfg.faults is None, name
+    # the robustness gate preset (run_chaos_smoke.sh)
+    chaos = PRESETS["chaos-smoke"]
+    assert chaos.faults is not None and chaos.faults.drop_fraction == 0.25
+    assert chaos.faults.nan_clients == 1 and chaos.max_round_retries >= 1
+    assert chaos.train.on_overflow == "exclude"
 
 
 def test_cli_main_json_output(capsys):

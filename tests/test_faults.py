@@ -1,0 +1,544 @@
+"""Fault-tolerant round engine tests (ISSUE 2):
+
+  * deterministic fault schedule
+  * all-ones mask == historical program, bit-for-bit, no extra compile
+  * masked-out clients contribute EXACTLY zero to both aggregators
+  * in-program sanitization: NaN filter, update-norm bound, overflow
+  * padding: any client count on any mesh
+  * decrypt_average surviving-count metadata validation
+  * checkpoint corruption fails loudly; killed-then-resumed == uninterrupted
+  * the 4-round encrypted chaos acceptance run
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.ckks.keys import CkksContext, keygen
+from hefl_tpu.ckks.packing import PackSpec
+from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+from hefl_tpu.fl import (
+    FaultConfig,
+    RoundMeta,
+    TrainConfig,
+    decrypt_average,
+    fedavg_round,
+    schedule_for_round,
+    secure_fedavg_round,
+)
+from hefl_tpu.fl.faults import (
+    EXCLUDED_NONFINITE,
+    EXCLUDED_SCHEDULED,
+    POISON_HUGE,
+    POISON_NAN,
+)
+from hefl_tpu.models import SmallCNN
+from hefl_tpu.parallel import make_mesh
+
+CFG = TrainConfig(
+    epochs=1, batch_size=8, num_classes=10, augment=False, val_fraction=0.25
+)
+
+
+def _setup(num_clients, per_client=16, seed=0):
+    n = num_clients * per_client
+    (x, y), _, _ = make_dataset("mnist", seed=seed, n_train=n, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(n, num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    return model, params, jnp.asarray(xs), jnp.asarray(ys)
+
+
+def _leaves(t):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(t)]
+
+
+def test_fault_schedule_is_deterministic_and_exact():
+    fc = FaultConfig(seed=7, drop_fraction=0.25, nan_clients=1, huge_clients=1,
+                     straggler_fraction=0.5, straggler_delay_s=2.0,
+                     fail_rounds=(1, 3))
+    a = schedule_for_round(fc, 2, 8)
+    b = schedule_for_round(fc, 2, 8)
+    np.testing.assert_array_equal(a.dropped, b.dropped)
+    np.testing.assert_array_equal(a.poison, b.poison)
+    np.testing.assert_array_equal(a.straggler_s, b.straggler_s)
+    # exact counts, not Bernoulli
+    assert int(a.dropped.sum()) == 2
+    assert int(np.sum(a.poison == POISON_NAN)) == 1
+    assert int(np.sum(a.poison == POISON_HUGE)) == 1
+    # poison never wasted on a dropped client
+    assert not np.any(a.poison[a.dropped])
+    assert np.count_nonzero(a.straggler_s) == 4
+    # a synchronous round never waits on a client the schedule dropped
+    assert not np.any(a.straggler_s[a.dropped])
+    assert not a.device_loss and schedule_for_round(fc, 3, 8).device_loss
+    # different rounds differ (with overwhelming probability at C=8)
+    c = schedule_for_round(fc, 4, 8)
+    assert not (
+        np.array_equal(a.dropped, c.dropped)
+        and np.array_equal(a.poison, c.poison)
+    )
+
+
+def test_all_ones_mask_is_bitwise_legacy_and_compiles_nothing_new():
+    # The acceptance guarantee: participation=ones reproduces the current
+    # seed outputs bit-for-bit AND adds no compiled program — the trivial
+    # mask routes to the very same legacy executable.
+    from hefl_tpu.fl.fedavg import _build_round_fn
+
+    _build_round_fn.cache_clear()
+    model, params, xs, ys = _setup(2)
+    mesh = make_mesh(2)
+    key = jax.random.key(4)
+    p_legacy, m_legacy = fedavg_round(model, CFG, mesh, params, xs, ys, key)
+    p_ones, m_ones, meta = fedavg_round(
+        model, CFG, mesh, params, xs, ys, key, participation=np.ones(2)
+    )
+    for a, b in zip(_leaves(p_legacy), _leaves(p_ones)):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.asarray(m_legacy), np.asarray(m_ones))
+    assert meta.surviving == 2 and meta.excluded == {
+        "scheduled": 0, "nonfinite": 0, "norm": 0, "overflow": 0
+    }
+    # the fast path traces no predicates and must say so
+    assert meta.sanitized is False and meta.record()["sanitized"] is False
+    assert _build_round_fn.cache_info().currsize == 1, (
+        "the all-ones mask must reuse the legacy executable, not build a "
+        "masked program"
+    )
+
+
+def test_masked_out_client_contributes_exactly_zero_plaintext():
+    # Vary ONLY the excluded client's data: the aggregate must be
+    # bit-identical, proving a masked-out client contributes nothing.
+    model, params, xs, ys = _setup(4)
+    mesh = make_mesh(4)
+    key = jax.random.key(5)
+    part = np.array([1, 1, 1, 0])
+    xs2 = np.array(xs)
+    xs2[3] = np.asarray(xs[0])
+    ys2 = np.array(ys)
+    ys2[3] = np.asarray(ys[0])
+    pa, _, meta_a = fedavg_round(
+        model, CFG, mesh, params, xs, ys, key, participation=part
+    )
+    pb, _, meta_b = fedavg_round(
+        model, CFG, mesh, params, jnp.asarray(xs2), jnp.asarray(ys2), key,
+        participation=part,
+    )
+    assert meta_a.surviving == meta_b.surviving == 3
+    assert meta_a.bits[3] == EXCLUDED_SCHEDULED
+    for a, b in zip(_leaves(pa), _leaves(pb)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_masked_rounds_share_one_compiled_program():
+    # Masks are traced arguments: rounds with DIFFERENT masks must reuse
+    # one executable (the SPMD program shape is mask-independent).
+    from hefl_tpu.fl.fedavg import _build_round_fn
+
+    model, params, xs, ys = _setup(4)
+    mesh = make_mesh(4)
+    key = jax.random.key(6)
+    _build_round_fn.cache_clear()
+    for part in ([1, 1, 1, 0], [0, 1, 1, 1], [1, 0, 1, 0]):
+        fedavg_round(
+            model, CFG, mesh, params, xs, ys, key,
+            participation=np.array(part),
+        )
+    fn = _build_round_fn(model, CFG, mesh, masked=True)
+    assert fn._cache_size() == 1, (
+        f"masked round compiled {fn._cache_size()} times for 3 masks"
+    )
+
+
+def test_nan_poison_is_excluded_plaintext():
+    model, params, xs, ys = _setup(4)
+    mesh = make_mesh(4)
+    pois = np.array([POISON_NAN, 0, 0, 0])
+    newp, _, meta = fedavg_round(
+        model, CFG, mesh, params, xs, ys, jax.random.key(7), poison=pois
+    )
+    assert meta.surviving == 3
+    assert meta.excluded["nonfinite"] == 1
+    assert meta.sanitized is True
+    assert meta.bits[0] & EXCLUDED_NONFINITE
+    for leaf in _leaves(newp):
+        assert np.all(np.isfinite(leaf))
+
+
+def test_update_norm_bound_excludes_huge_update():
+    model, params, xs, ys = _setup(2)
+    mesh = make_mesh(2)
+    cfg = TrainConfig(
+        epochs=1, batch_size=8, num_classes=10, augment=False,
+        val_fraction=0.25, max_update_norm=100.0,
+    )
+    pois = np.array([0, POISON_HUGE])
+    newp, _, meta = fedavg_round(
+        model, cfg, mesh, params, xs, ys, jax.random.key(8), poison=pois
+    )
+    assert meta.surviving == 1
+    assert meta.excluded["norm"] == 1 and meta.excluded["nonfinite"] == 0
+    # the huge update never touched the aggregate
+    for leaf in _leaves(newp):
+        assert np.all(np.isfinite(leaf)) and np.max(np.abs(leaf)) < 1e6
+
+
+def test_padding_any_client_count_on_any_mesh():
+    # 3 clients on a 2-device mesh: padded to 4 slots, identical trainings
+    # (same split(key, 3) streams), so the aggregate matches the 3-device
+    # mesh run to float-summation-grouping tolerance, and the padding
+    # client is excluded in the metadata.
+    model, params, xs, ys = _setup(3)
+    mesh2 = make_mesh(2)
+    mesh3 = make_mesh(3)
+    key = jax.random.key(9)
+    p_pad, mets_pad, meta = fedavg_round(model, CFG, mesh2, params, xs, ys, key)
+    assert meta.surviving == 3 and meta.num_clients == 3
+    assert mets_pad.shape[0] == 3
+    p_ref, _ = fedavg_round(model, CFG, mesh3, params, xs, ys, key)
+    for a, b in zip(_leaves(p_pad), _leaves(p_ref)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_train_clients_pads_non_divisible_counts():
+    from hefl_tpu.fl import train_clients
+
+    model, params, xs, ys = _setup(3)
+    mesh = make_mesh(2)
+    p_out, mets = train_clients(
+        model, CFG, mesh, params, xs, ys, jax.random.key(10)
+    )
+    assert mets.shape[0] == 3
+    for leaf in jax.tree_util.tree_leaves(p_out):
+        assert leaf.shape[0] == 3
+
+
+def test_decrypt_average_meta_validation(tmp_path):
+    from hefl_tpu.fl import aggregate_encrypted, encrypt_params
+
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(0))
+    tree = {"w": jax.random.normal(jax.random.key(1), (64,)) * 0.1}
+    spec = PackSpec.for_params(tree, ctx.n)
+    from hefl_tpu.ckks.ops import Ciphertext
+
+    cts = [
+        encrypt_params(ctx, pk, tree, jax.random.key(10 + i)) for i in range(2)
+    ]
+    stacked = Ciphertext(
+        c0=jnp.stack([c.c0 for c in cts]),
+        c1=jnp.stack([c.c1 for c in cts]),
+        scale=cts[0].scale,
+    )
+    ct_sum = aggregate_encrypted(ctx, stacked)
+    # surviving=1 of 2: denominator must be 1 (the sum holds ONE client's
+    # worth after masking — emulate by decrypting the 2-sum with meta of 2)
+    meta = RoundMeta.from_bits(np.array([0, EXCLUDED_SCHEDULED]))
+    assert meta.surviving == 1 and meta.num_clients == 2
+    with pytest.raises(ValueError, match="disagrees"):
+        decrypt_average(ctx, sk, ct_sum, 3, spec, meta=meta)
+    empty = RoundMeta.from_bits(np.array([EXCLUDED_SCHEDULED] * 2))
+    with pytest.raises(ValueError, match="0 surviving"):
+        decrypt_average(ctx, sk, ct_sum, 2, spec, meta=empty)
+    with pytest.raises(TypeError, match="num_clients or"):
+        decrypt_average(ctx, sk, ct_sum, spec=spec)
+    # matching counts decode fine, denominator = surviving
+    avg2 = decrypt_average(ctx, sk, ct_sum, 2, spec,
+                           meta=RoundMeta.full_participation(2))
+    avg1 = decrypt_average(ctx, sk, ct_sum, None, spec, meta=meta)
+    np.testing.assert_allclose(
+        np.asarray(avg1["w"]), 2 * np.asarray(avg2["w"]), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_secure_masked_round_drop_nan_and_reference():
+    # The encrypted half of the tentpole in one program: schedule client 2
+    # out, NaN-poison client 0, and check (a) metadata attribution, (b) the
+    # decrypted aggregate matches the in-program masked plaintext reference
+    # to HE tolerance, (c) a NaN-poisoned client's zeroed limbs equal a
+    # scheduled-out client's — bitwise — so sanitization IS dropout.
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients, per_client=8)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=512)
+    sk, pk = keygen(ctx, jax.random.key(21))
+    spec = PackSpec.for_params(params, ctx.n)
+    key = jax.random.key(22)
+    cfg = TrainConfig(epochs=1, batch_size=4, num_classes=10, augment=False,
+                      val_fraction=0.25)
+
+    part = np.array([1, 1, 0, 1])
+    pois = np.array([POISON_NAN, 0, 0, 0])
+    ct, mets, ov, meta, ref = secure_fedavg_round(
+        model, cfg, mesh, ctx, pk, params, xs, ys, key,
+        with_plain_reference=True, participation=part, poison=pois,
+    )
+    assert mets.shape == (num_clients, 1, 4)
+    assert meta.surviving == 2
+    assert meta.excluded["scheduled"] == 1 and meta.excluded["nonfinite"] == 1
+    avg = decrypt_average(ctx, sk, ct, num_clients, spec, meta=meta)
+    for a, b in zip(_leaves(avg), _leaves(ref)):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+    # (c): scheduling out exactly the same clients (no poison) must give
+    # the bitwise-identical ciphertext sum — identical trainings +
+    # identical zeroed limbs.
+    ct2, _, _, meta2 = secure_fedavg_round(
+        model, cfg, mesh, ctx, pk, params, xs, ys, key,
+        participation=np.array([0, 1, 0, 1]),
+    )
+    assert meta2.surviving == 2
+    np.testing.assert_array_equal(np.asarray(ct.c0), np.asarray(ct2.c0))
+    np.testing.assert_array_equal(np.asarray(ct.c1), np.asarray(ct2.c1))
+
+
+def test_secure_all_ones_mask_is_bitwise_legacy():
+    num_clients = 2
+    model, params, xs, ys = _setup(num_clients, per_client=8)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(1))
+    key = jax.random.key(2)
+    cfg = TrainConfig(epochs=1, batch_size=4, num_classes=10, augment=False,
+                      val_fraction=0.25)
+    ct_l, m_l, ov_l = secure_fedavg_round(
+        model, cfg, mesh, ctx, pk, params, xs, ys, key
+    )
+    ct_t, m_t, ov_t, meta = secure_fedavg_round(
+        model, cfg, mesh, ctx, pk, params, xs, ys, key,
+        participation=np.ones(num_clients),
+    )
+    np.testing.assert_array_equal(np.asarray(ct_l.c0), np.asarray(ct_t.c0))
+    np.testing.assert_array_equal(np.asarray(ct_l.c1), np.asarray(ct_t.c1))
+    np.testing.assert_array_equal(np.asarray(ov_l), np.asarray(ov_t))
+    assert meta.surviving == num_clients
+
+
+def test_secure_overflow_exclude_mode():
+    # on_overflow="exclude": a huge (finite) update that saturates the
+    # encoder is dropped via the overflow bit — with no norm bound set, the
+    # overflow signal alone must carry the exclusion.
+    num_clients = 2
+    model, params, xs, ys = _setup(num_clients, per_client=8)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(3))
+    spec = PackSpec.for_params(params, ctx.n)
+    cfg = TrainConfig(epochs=1, batch_size=4, num_classes=10, augment=False,
+                      val_fraction=0.25, on_overflow="exclude")
+    ct, mets, ov, meta = secure_fedavg_round(
+        model, cfg, mesh, ctx, pk, params, xs, ys, jax.random.key(4),
+        poison=np.array([0, POISON_HUGE]),
+    )
+    assert int(np.asarray(ov)[1]) > 0
+    assert meta.surviving == 1
+    assert meta.excluded["overflow"] == 1 and meta.excluded["nonfinite"] == 0
+    avg = decrypt_average(ctx, sk, ct, num_clients, spec, meta=meta)
+    for leaf in _leaves(avg):
+        assert np.all(np.isfinite(leaf)) and np.max(np.abs(leaf)) < 1e6
+
+
+def test_checkpoint_corruption_fails_loudly(tmp_path):
+    # The atomic-write guarantee means a readable-but-damaged file must be
+    # treated as external corruption: loud CheckpointError, never a silent
+    # partial restore.
+    from hefl_tpu.utils import load_checkpoint, save_checkpoint
+    from hefl_tpu.utils.checkpoint import CheckpointError
+
+    params = {"w": jnp.arange(4096, dtype=jnp.float32)}
+    path = str(tmp_path / "ck.npz")
+    save_checkpoint(path, params, 3, jax.random.key(0), meta={"x": 1})
+    # sanity: intact file round-trips
+    _, rnd, _, meta = load_checkpoint(path, params)
+    assert rnd == 3 and meta == {"x": 1}
+    # truncate to half: must raise loudly
+    import os
+
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+    with pytest.raises(CheckpointError, match="corrupt or truncated"):
+        load_checkpoint(path, params)
+    # garbage bytes: ditto
+    with open(path, "wb") as f:
+        f.write(b"not a zipfile at all")
+    with pytest.raises(CheckpointError):
+        load_checkpoint(path, params)
+
+
+def test_killed_then_resumed_run_matches_uninterrupted(tmp_path):
+    # Kill-and-resume determinism: a 3-round run interrupted after round 1
+    # and resumed from its checkpoint must produce the SAME final params as
+    # the uninterrupted run (checkpoint carries params + round + RNG).
+    from hefl_tpu.experiment import ExperimentConfig, run_experiment
+
+    train = TrainConfig(epochs=1, batch_size=8, num_classes=10, augment=False,
+                        val_fraction=0.25)
+    base = dict(model="smallcnn", dataset="mnist", num_clients=2, rounds=3,
+                encrypted=False, train=train, n_train=64, n_test=16, seed=11)
+    full = run_experiment(
+        ExperimentConfig(**base, checkpoint_path=str(tmp_path / "a.npz")),
+        verbose=False,
+    )
+    ck = str(tmp_path / "b.npz")
+    run_experiment(
+        ExperimentConfig(**{**base, "rounds": 1}, checkpoint_path=ck),
+        verbose=False,
+    )  # "killed" after round 0
+    resumed = run_experiment(
+        ExperimentConfig(**base, checkpoint_path=ck), resume=True,
+        verbose=False,
+    )
+    assert [r["round"] for r in resumed["history"]] == [1, 2]
+    for a, b in zip(_leaves(full["params"]), _leaves(resumed["params"])):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_chaos_acceptance_4round_encrypted():
+    # The ISSUE-2 acceptance run at the fl layer: 4 encrypted rounds with
+    # 25% scheduled dropout + 1 NaN-poisoned client per round. Every round
+    # must (a) exclude exactly the scheduled/poisoned clients (via round
+    # metadata), (b) decrypt — with the surviving count as denominator —
+    # to the in-program plaintext masked-FedAvg reference within HE
+    # fidelity tolerance, and (c) keep the global model finite while
+    # feeding each decrypted aggregate into the next round.
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients, per_client=8)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(31))
+    spec = PackSpec.for_params(params, ctx.n)
+    cfg = TrainConfig(epochs=1, batch_size=4, num_classes=10, augment=False,
+                      val_fraction=0.25)
+    fc = FaultConfig(seed=5, drop_fraction=0.25, nan_clients=1)
+    key = jax.random.key(32)
+    cur = params
+    for r in range(4):
+        sched = schedule_for_round(fc, r, num_clients)
+        key, k_round = jax.random.split(key)
+        ct, mets, ov, meta, ref = secure_fedavg_round(
+            model, cfg, mesh, ctx, pk, cur, xs, ys, k_round,
+            with_plain_reference=True,
+            participation=sched.participation(), poison=sched.poison,
+        )
+        expect = set(np.flatnonzero(sched.dropped)) | set(
+            np.flatnonzero(sched.poison)
+        )
+        got = {i for i, p in enumerate(meta.participation) if not p}
+        assert got == expect, (r, got, expect)
+        assert meta.surviving == num_clients - len(expect)
+        cur = decrypt_average(ctx, sk, ct, num_clients, spec, meta=meta)
+        for a, b in zip(_leaves(cur), _leaves(ref)):
+            np.testing.assert_allclose(a, b, atol=5e-4)
+        for leaf in _leaves(cur):
+            assert np.all(np.isfinite(leaf))
+
+
+def test_experiment_chaos_history_and_retry(tmp_path):
+    # Driver-level chaos: faults + device loss + retry through
+    # run_experiment; history carries the robustness records.
+    from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
+
+    train = TrainConfig(epochs=1, batch_size=8, num_classes=10, augment=False,
+                        val_fraction=0.25)
+    fc = FaultConfig(seed=1, drop_fraction=0.25, nan_clients=1,
+                     fail_rounds=(1,))
+    cfg = ExperimentConfig(
+        model="smallcnn", dataset="mnist", num_clients=4, rounds=2,
+        train=train, he=HEConfig(n=256), n_train=64, n_test=32, seed=3,
+        faults=fc, max_round_retries=1, retry_backoff_s=0.01,
+        checkpoint_path=str(tmp_path / "ck.npz"),
+    )
+    out = run_experiment(cfg, verbose=False)
+    assert len(out["history"]) == 2
+    for r, rec in enumerate(out["history"]):
+        rob = rec["robust"]
+        sched = schedule_for_round(fc, r, 4)
+        expect = set(np.flatnonzero(sched.dropped)) | set(
+            np.flatnonzero(sched.poison)
+        )
+        got = {i for i, p in enumerate(rob["participation"]) if not p}
+        assert got == expect
+        assert rob["surviving"] == 4 - len(expect)
+        assert rob["faults"]["nan"] == np.flatnonzero(
+            sched.poison == POISON_NAN
+        ).tolist()
+    assert out["history"][1]["robust"]["round_retries"] == 1
+    for leaf in _leaves(out["params"]):
+        assert np.all(np.isfinite(leaf))
+
+
+def test_dp_with_exclusions_fails_loudly():
+    # An excluded client's zeroed limbs also zero its distributed noise
+    # share: a dp round with ANY exclusion must refuse to hand back an
+    # under-noised aggregate (and the driver rejects dp+faults up front).
+    from hefl_tpu.experiment import ExperimentConfig, run_experiment
+    from hefl_tpu.fl.dp import DpConfig
+
+    num_clients = 2
+    model, params, xs, ys = _setup(num_clients, per_client=8)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(1))
+    cfg = TrainConfig(epochs=1, batch_size=4, num_classes=10, augment=False,
+                      val_fraction=0.25)
+    dp = DpConfig(clip_norm=1.0, noise_multiplier=1.0)
+    with pytest.raises(ValueError, match="noise"):
+        secure_fedavg_round(
+            model, cfg, mesh, ctx, pk, params, xs, ys, jax.random.key(2),
+            dp=dp, participation=np.array([1, 0]),
+        )
+    train = TrainConfig(epochs=1, batch_size=8, num_classes=10, augment=False,
+                        val_fraction=0.25)
+    with pytest.raises(ValueError, match="dp and fault injection"):
+        run_experiment(
+            ExperimentConfig(
+                model="smallcnn", dataset="mnist", num_clients=2, rounds=1,
+                train=train, n_train=32, n_test=16, dp=dp,
+                faults=FaultConfig(drop_fraction=0.5),
+            ),
+            verbose=False,
+        )
+
+
+def test_all_excluded_round_keeps_global_model():
+    # drop_fraction=1.0: the encrypted aggregate is an encryption of zero;
+    # the driver must carry the global model over (like the plaintext
+    # masked engine), not decode a 0/0 or crash the run.
+    from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
+
+    train = TrainConfig(epochs=1, batch_size=8, num_classes=10, augment=False,
+                        val_fraction=0.25)
+    base = dict(model="smallcnn", dataset="mnist", num_clients=2,
+                train=train, he=HEConfig(n=256), n_train=32, n_test=16,
+                seed=4)
+    init = run_experiment(
+        ExperimentConfig(**base, rounds=0), verbose=False
+    )["params"]
+    out = run_experiment(
+        ExperimentConfig(
+            **base, rounds=1, faults=FaultConfig(drop_fraction=1.0)
+        ),
+        verbose=False,
+    )
+    rob = out["history"][0]["robust"]
+    assert rob["surviving"] == 0 and rob["excluded"]["scheduled"] == 2
+    for a, b in zip(_leaves(out["params"]), _leaves(init)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_retry_exhaustion_raises():
+    from hefl_tpu.experiment import ExperimentConfig, run_experiment
+    from hefl_tpu.fl import DeviceLost
+
+    train = TrainConfig(epochs=1, batch_size=8, num_classes=10, augment=False,
+                        val_fraction=0.25)
+    cfg = ExperimentConfig(
+        model="smallcnn", dataset="mnist", num_clients=2, rounds=1,
+        encrypted=False, train=train, n_train=32, n_test=16, seed=0,
+        faults=FaultConfig(fail_rounds=(0,)), max_round_retries=0,
+    )
+    with pytest.raises(DeviceLost):
+        run_experiment(cfg, verbose=False)
