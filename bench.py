@@ -143,6 +143,7 @@ def main() -> None:
         fedavg_round,
         secure_fedavg_round,
     )
+    from hefl_tpu.fl.fusion import fusion_report
     from hefl_tpu.flagship import (
         BASELINE_ACC,
         BASELINE_TOTAL_S,
@@ -286,6 +287,7 @@ def main() -> None:
     # cell-6 fields rather than numbers from a config that never ran.
     skip_cell6 = os.environ.get("BENCH_SKIP_CELL6") == "1"
     plaintext_round_s = max_diff = max_diff_exact = cell6_overflow = None
+    fusion_seconds = {}
     ct_bytes = (last_ct_sum.c0.size + last_ct_sum.c1.size) * 4
     param_bytes = count_params(params) * 4
     expansion = ct_bytes / param_bytes
@@ -309,6 +311,33 @@ def main() -> None:
         )
         jax.block_until_ready(plain_params)
         plaintext_round_s = time.perf_counter() - tp0
+        # Fused-vs-vmap comparison rows (ISSUE 3): the same plaintext
+        # round timed warm under each cross-client backend pinned, so the
+        # artifact records both backends' MFU at identical math. Each
+        # pinned variant is its own compiled program (diagnostic tail,
+        # like with_plain_reference — not part of any timed round above).
+        import dataclasses as _dc
+
+        from hefl_tpu.fl.fusion import supports_fusion
+
+        for bk_name in ("vmap", "fused"):
+            if bk_name == "fused" and not supports_fusion(module):
+                continue
+            cfg_bk = _dc.replace(cfg, client_fusion=bk_name)
+            jax.block_until_ready(
+                fedavg_round(
+                    module, cfg_bk, mesh, last_start, xs_d, ys_d, k_train
+                )[0]
+            )  # warm (compile excluded)
+            tb = time.perf_counter()
+            jax.block_until_ready(
+                fedavg_round(
+                    module, cfg_bk, mesh, last_start, xs_d, ys_d, k_train
+                )[0]
+            )
+            fusion_seconds[bk_name] = time.perf_counter() - tb
+            log(f"plaintext round [client_fusion={bk_name}]: "
+                f"{fusion_seconds[bk_name]:.2f}s")
         # (b) fidelity: the PRODUCTION encrypted round (same program family:
         # train + encrypt + hierarchical psum-of-limbs) run once in
         # with_plain_reference mode, which additionally emits the plaintext
@@ -423,6 +452,15 @@ def main() -> None:
                 # Which augment row-shift backend the round programs traced
                 # with (incl. auto-selection micro-timings when in "auto").
                 "augment_backend": augment_backend_report(),
+                # Cross-client training backend record (TrainConfig.
+                # client_fusion; fl.fusion) + fused-vs-vmap MFU rows at
+                # identical math (null rows when the cell-6 tail was
+                # skipped).
+                "client_fusion": fusion_report(),
+                "client_fusion_compare": roofline.backend_compare(
+                    fusion_seconds, flops=train_flops, device=dev,
+                    images=train_images_per_round,
+                ),
                 "device": getattr(dev, "device_kind", str(dev)),
                 "seed": seed,
                 # `accuracy` pairs with `value`: both are the round-0

@@ -49,6 +49,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--skew-alpha", type=float, default=0.5)
     p.add_argument("--prox-mu", type=float, default=0.0, help="FedProx strength")
     p.add_argument("--no-augment", action="store_true")
+    p.add_argument("--client-fusion", default="auto",
+                   choices=["auto", "fused", "vmap"],
+                   help="cross-client training backend: 'fused' folds the "
+                        "client axis into every conv/dense GEMM batch "
+                        "(fl.fusion), 'vmap' is the per-client reference, "
+                        "'auto' micro-times both once per device kind "
+                        "(winner persisted next to the XLA compile cache)")
     p.add_argument("--he-n", type=int, default=4096, help="CKKS ring degree")
     p.add_argument("--he-primes", type=int, default=3, help="RNS limb count")
     p.add_argument("--seed", type=int, default=0)
@@ -162,6 +169,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             warmup_steps=args.warmup_steps,
             prox_mu=args.prox_mu,
             augment=not args.no_augment,
+            client_fusion=args.client_fusion,
             num_classes=num_classes,
             on_overflow=args.on_overflow,
             max_update_norm=args.max_update_norm,

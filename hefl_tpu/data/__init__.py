@@ -35,11 +35,13 @@ from hefl_tpu.data.partition import (
     stack_federated,
     train_val_split,
 )
+from hefl_tpu.data.prefetch import RoundPrefetcher
 from hefl_tpu.data.synthetic import DATASETS, make_dataset
 
 __all__ = [
     "Batcher",
     "one_hot",
+    "RoundPrefetcher",
     "scan_image_folder",
     "load_image_dataset",
     "load_folder_splits",

@@ -76,6 +76,7 @@ _ENV_BACKEND = os.environ.get("HEFL_AUG_SHIFT", "auto")
 # programs actually use, not just the env/auto state.
 _AUTO_CHOICE: str | None = None
 _AUTO_TIMINGS_MS: dict[str, float] | None = None
+_AUTO_PERSISTED: bool = False
 _LAST_RESOLVED: str | None = None
 
 
@@ -248,11 +249,22 @@ def _autoselect_backend() -> str:
     instead of execution — so the probe runs inside
     `jax.ensure_compile_time_eval()`, which forces real eager execution of
     the concrete probe inputs regardless of trace context. The winner is
-    cached for the process; `backend_report()` exposes the choice +
-    timings for bench artifacts.
+    cached for the process AND persisted per device-kind next to the XLA
+    compile cache (utils.autoselect) so short-lived CLI runs skip the
+    first-trace micro-timing entirely; `backend_report()` exposes the
+    choice + timings for bench artifacts.
     """
-    global _AUTO_CHOICE, _AUTO_TIMINGS_MS
+    global _AUTO_CHOICE, _AUTO_TIMINGS_MS, _AUTO_PERSISTED
     if _AUTO_CHOICE is not None:
+        return _AUTO_CHOICE
+    from hefl_tpu.utils.autoselect import load_winner, store_winner
+
+    kind = str(getattr(jax.devices()[0], "device_kind", "unknown"))
+    hit = load_winner("augment_shift", kind)
+    if hit is not None and hit["winner"] in SHIFT_BACKENDS:
+        _AUTO_CHOICE = hit["winner"]
+        _AUTO_TIMINGS_MS = hit.get("timings_ms")
+        _AUTO_PERSISTED = True
         return _AUTO_CHOICE
     with jax.ensure_compile_time_eval():
         # The probe INPUTS must also be built inside the eval context: under
@@ -271,6 +283,7 @@ def _autoselect_backend() -> str:
         }
     _AUTO_TIMINGS_MS = {k: round(v * 1e3, 3) for k, v in timings.items()}
     _AUTO_CHOICE = min(timings, key=timings.get)
+    store_winner("augment_shift", kind, _AUTO_CHOICE, _AUTO_TIMINGS_MS)
     return _AUTO_CHOICE
 
 
@@ -310,6 +323,9 @@ def backend_report() -> dict:
         "requested": env,
         "backend": resolved,
         "auto_timings_ms": _AUTO_TIMINGS_MS,
+        # True when the auto winner came from the persisted per-device-kind
+        # cache (utils.autoselect) instead of a live micro-timing.
+        "auto_persisted": _AUTO_PERSISTED,
     }
 
 
@@ -317,16 +333,15 @@ def _shift_rows(x: jnp.ndarray, delta: jnp.ndarray, backend: str) -> jnp.ndarray
     return _SHIFT_FNS[backend](x, delta)
 
 
-@partial(jax.jit, static_argnames=("shear", "zoom", "flip", "backend"))
-def _random_augment(
-    key: jax.Array,
-    images: jnp.ndarray,
-    shear: float,
-    zoom: float,
-    flip: bool,
-    backend: str,
-) -> jnp.ndarray:
-    b, h, w = images.shape[0], images.shape[1], images.shape[2]
+def draw_affine_params(
+    key: jax.Array, b: int, shear: float, zoom: float, flip: bool
+):
+    """One Keras-style random affine per image: -> (s, zx, zy, f), each
+    f32[b] (shear angle, per-axis zoom, flip sign). The SINGLE source of
+    the augment randomness, shared by the per-client `random_augment` path
+    and the cross-client fused trainer (fl.fusion), which draws with each
+    client's key and applies the warp on the client-folded batch — same
+    key => same affines on both paths by construction."""
     k_shear, k_zx, k_zy, k_flip = jax.random.split(key, 4)
     s = jax.random.uniform(k_shear, (b,), minval=-shear, maxval=shear)
     zx = jax.random.uniform(k_zx, (b,), minval=1.0 - zoom, maxval=1.0 + zoom)
@@ -334,6 +349,22 @@ def _random_augment(
     f = jnp.where(
         flip, jnp.sign(jax.random.uniform(k_flip, (b,)) - 0.5), jnp.ones((b,))
     )
+    return s, zx, zy, f
+
+
+def apply_affine(
+    images: jnp.ndarray,
+    s: jnp.ndarray,
+    zx: jnp.ndarray,
+    zy: jnp.ndarray,
+    f: jnp.ndarray,
+    backend: str,
+) -> jnp.ndarray:
+    """Apply per-image affine params (shapes [b], from `draw_affine_params`)
+    to a float batch [b, H, W, C]. Per-image math only — no cross-image
+    coupling — so callers may fold any outer axis (e.g. clients) into the
+    batch before calling; the per-image results are unchanged."""
+    h, w = images.shape[1], images.shape[2]
     if backend == "gather":
         # The fused two-pass bilinear warp: no one-hot matmuls, no
         # spectral shift — the whole affine is two axis gathers.
@@ -356,6 +387,20 @@ def _random_augment(
     src_x = jnp.clip((f / zx)[:, None] * (xv[None, :] - cx) + cx, 0, w - 1)
     wx = _lin_weights(src_x, w)
     return jnp.einsum("bxu,byuc->byxc", wx, t2, preferred_element_type=jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("shear", "zoom", "flip", "backend"))
+def _random_augment(
+    key: jax.Array,
+    images: jnp.ndarray,
+    shear: float,
+    zoom: float,
+    flip: bool,
+    backend: str,
+) -> jnp.ndarray:
+    b = images.shape[0]
+    s, zx, zy, f = draw_affine_params(key, b, shear, zoom, flip)
+    return apply_affine(images, s, zx, zy, f, backend)
 
 
 def random_augment(

@@ -49,6 +49,15 @@ class TrainConfig:
     # nested scan-of-scans. Same math, same RNG stream; the flag exists so
     # the equivalence stays testable (tests/test_perf.py).
     flat_scan: bool = True
+    # Cross-client training backend (fl.fusion): "fused" reshapes the
+    # client axis into the batch axis of every conv/dense (one GEMM stream
+    # of effective batch C*B per layer, per-client weights via
+    # batch-grouped convs / batched GEMMs), "vmap" is the per-client vmap
+    # reference, "auto" (default) defers to HEFL_CLIENT_FUSION and then to
+    # a one-shot fused-vs-vmap micro-timing per device kind (persisted
+    # next to the XLA compile cache). Same math, same RNG streams, same
+    # callback semantics on both backends (tests/test_perf.py pins it).
+    client_fusion: str = "auto"
     # --- update sanitization (fl.faults / the participation-masked round
     # engine). Both knobs default OFF so the historical all-clients-present
     # round programs (and their seeds) are untouched; turning either on
@@ -72,4 +81,9 @@ class TrainConfig:
             raise ValueError(
                 f"on_overflow={self.on_overflow!r}: must be one of "
                 "'warn' | 'exclude' | 'raise'"
+            )
+        if self.client_fusion not in ("auto", "fused", "vmap"):
+            raise ValueError(
+                f"client_fusion={self.client_fusion!r}: must be one of "
+                "'auto' | 'fused' | 'vmap'"
             )

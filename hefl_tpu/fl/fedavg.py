@@ -39,13 +39,45 @@ def vmapped_train(module, cfg: TrainConfig, gp, x_blk, y_blk, k_blk):
     """Train one device's block of clients from the shared global weights.
 
     x_blk: [cpd, m, ...] — this device's clients; vmap trains them
-    "concurrently" (XLA interleaves). The SINGLE training body shared by the
-    plaintext round, the encrypted round, and the train_clients measurement
-    hook — so "same keys => same trainings" holds across all three by
-    construction. -> (stacked weight trees [cpd, ...], metrics [cpd, E, 4]).
+    "concurrently" (XLA interleaves). The semantics REFERENCE backend of
+    `train_block` (client_fusion="vmap").
+    -> (stacked weight trees [cpd, ...], metrics [cpd, E, 4]).
     """
     train_one = lambda x, y, k: local_train(module, cfg, gp, x, y, k)  # noqa: E731
     return jax.vmap(train_one)(x_blk, y_blk, k_blk)
+
+
+def train_block(
+    module, cfg: TrainConfig, gp, x_blk, y_blk, k_blk,
+    m_blk=None, backend: str | None = None,
+):
+    """Train one device's block of clients through the configured
+    cross-client backend (TrainConfig.client_fusion; fl.fusion). The
+    SINGLE training body shared by the plaintext round, the encrypted
+    round, and the train_clients measurement hook — so "same keys => same
+    trainings" holds across all three by construction.
+
+    `m_blk` is the masked engine's traced participation block: the fused
+    backend applies it as a per-step multiplicative update mask (a
+    scheduled-out client's rows still flow through the fused GEMMs —
+    static SPMD shape — but its shipped weights stay the round's global
+    weights); the vmap reference trains everyone and leaves masking
+    entirely to the aggregation, which is where exclusion is enforced on
+    BOTH backends. `backend` lets a compile-once factory resolve the
+    (possibly auto-selected) backend a single time outside the trace.
+    -> (stacked weight trees [cpd, ...], metrics [cpd, E, 4]).
+    """
+    if backend is None:
+        from hefl_tpu.fl.fusion import resolve_fusion_backend
+
+        backend = resolve_fusion_backend(cfg.client_fusion, module)
+    if backend == "fused":
+        from hefl_tpu.fl.fusion import fused_train
+
+        return fused_train(
+            module, cfg, gp, x_blk, y_blk, k_blk, participation=m_blk
+        )
+    return vmapped_train(module, cfg, gp, x_blk, y_blk, k_blk)
 
 
 def masked_mean_tree(gp, p_out, keep, axes, total: int):
@@ -97,9 +129,18 @@ def _build_round_fn(
 
     axes = client_axes(mesh)   # ("clients",) or ("hosts", "clients")
     total = None if stacked else client_mesh_size(mesh)
+    # Resolve the (possibly auto-selected) cross-client backend ONCE, here
+    # in the factory — concrete context, so the micro-timing probe runs
+    # eagerly — and bake it into the body: every round reuses the choice.
+    from hefl_tpu.fl.fusion import resolve_fusion_backend
+
+    backend = resolve_fusion_backend(cfg.client_fusion, module)
 
     def body(gp, x_blk, y_blk, k_blk, m_blk=None, po_blk=None):
-        p_out, mets = vmapped_train(module, cfg, gp, x_blk, y_blk, k_blk)
+        p_out, mets = train_block(
+            module, cfg, gp, x_blk, y_blk, k_blk,
+            m_blk=m_blk, backend=backend,
+        )
         if stacked:
             return p_out, mets
         if not masked:
@@ -139,6 +180,45 @@ def pad_index(num_clients: int, n_dev: int) -> np.ndarray | None:
     if pad == 0:
         return None
     return np.concatenate([np.arange(num_clients), np.zeros(pad, np.int64)])
+
+
+def pad_federated(xs, ys, n_dev: int):
+    """Pre-pad federated arrays ONCE per experiment: -> (xs, ys, num_real).
+
+    The round wrappers accept `num_real_clients=num_real` alongside the
+    padded arrays and skip their own per-round device-side `xs[pad_idx]`
+    gather — an O(dataset) memcpy that otherwise reruns every round with
+    the identical result. Host (numpy) or device arrays both work; a
+    divisible client count returns the inputs untouched.
+    """
+    num = int(xs.shape[0])
+    idx = pad_index(num, n_dev)
+    if idx is None:
+        return xs, ys, num
+    return xs[idx], ys[idx], num
+
+
+def _round_geometry(xs, n_dev: int, num_real_clients: int | None):
+    """Shared round-entry geometry: -> (num_clients, pad_idx, prepadded).
+
+    `num_real_clients` marks xs/ys as PRE-PADDED by `pad_federated` (the
+    hoisted-gather contract): the wrapper then skips its own data gather
+    and only pads the cheap per-client key/mask arrays. Shape mismatches
+    fail loudly — silently averaging padding rows as real clients is the
+    one outcome this contract must never allow."""
+    if num_real_clients is None:
+        num_clients = int(xs.shape[0])
+        return num_clients, pad_index(num_clients, n_dev), False
+    num_clients = int(num_real_clients)
+    pad_idx = pad_index(num_clients, n_dev)
+    want = num_clients if pad_idx is None else len(pad_idx)
+    if int(xs.shape[0]) != want:
+        raise ValueError(
+            f"num_real_clients={num_clients} on a {n_dev}-device mesh "
+            f"needs federated arrays pre-padded to {want} rows "
+            f"(fedavg.pad_federated), got {int(xs.shape[0])}"
+        )
+    return num_clients, pad_idx, True
 
 
 def _mask_inputs(num_clients: int, participation, poison, pad_idx):
@@ -211,6 +291,7 @@ def fedavg_round(
     key: jax.Array,
     participation=None,
     poison=None,
+    num_real_clients: int | None = None,
 ):
     """One synchronous FedAvg round.
 
@@ -225,10 +306,15 @@ def fedavg_round(
     (who aggregated, who was excluded and why). An all-ones mask with no
     poison and no sanitization knobs takes the historical fast path —
     bit-identical outputs, same compiled program, meta of all-zeros bits.
+
+    `num_real_clients` (with xs/ys pre-padded by `pad_federated`) hoists
+    the per-round padding gather out of the round: masks/keys/meta follow
+    the real count, the data gather is skipped.
     """
-    num_clients = int(xs.shape[0])
     n_dev = client_mesh_size(mesh)
-    pad_idx = pad_index(num_clients, n_dev)
+    num_clients, pad_idx, prepadded = _round_geometry(
+        xs, n_dev, num_real_clients
+    )
     explicit = participation is not None or poison is not None
     masked = masked_mode(cfg, num_clients, n_dev, explicit)
     client_keys = jax.random.split(key, num_clients)
@@ -244,7 +330,9 @@ def fedavg_round(
         return new_p, mets, RoundMeta.full_participation(num_clients)
     part, pois = _mask_inputs(num_clients, participation, poison, pad_idx)
     if pad_idx is not None:
-        xs, ys, client_keys = xs[pad_idx], ys[pad_idx], client_keys[pad_idx]
+        client_keys = client_keys[pad_idx]
+        if not prepadded:
+            xs, ys = xs[pad_idx], ys[pad_idx]
     new_p, mets, bits = _build_round_fn(module, cfg, mesh, masked=True)(
         gp, xs, ys, client_keys, part, pois
     )
@@ -260,6 +348,7 @@ def train_clients(
     xs: jax.Array,
     ys: jax.Array,
     key: jax.Array,
+    num_real_clients: int | None = None,
 ):
     """Train every client from the global weights, returning the stacked
     per-client weight trees (leaves [C, ...]) and metrics [C, E, 4].
@@ -268,15 +357,19 @@ def train_clients(
     so `train_clients(..., k_train)` reproduces the trainings inside
     `secure_fedavg_round(..., key)` when `k_train, _ = jax.random.split(key)`.
     A client count that does not divide the mesh is padded (client 0's data,
-    recycled key) and the padding rows sliced off the outputs.
+    recycled key) and the padding rows sliced off the outputs;
+    `num_real_clients` marks pre-padded inputs (see `fedavg_round`).
     """
-    num_clients = int(xs.shape[0])
     n_dev = client_mesh_size(mesh)
-    pad_idx = pad_index(num_clients, n_dev)
+    num_clients, pad_idx, prepadded = _round_geometry(
+        xs, n_dev, num_real_clients
+    )
     client_keys = jax.random.split(key, num_clients)
     gp = replicate_on(mesh, global_params)
     if pad_idx is not None:
-        xs, ys, client_keys = xs[pad_idx], ys[pad_idx], client_keys[pad_idx]
+        client_keys = client_keys[pad_idx]
+        if not prepadded:
+            xs, ys = xs[pad_idx], ys[pad_idx]
     p_out, mets = _build_round_fn(module, cfg, mesh, stacked=True)(
         gp, xs, ys, client_keys
     )

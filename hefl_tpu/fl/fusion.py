@@ -1,0 +1,365 @@
+"""Cross-client fused training: one GEMM stream for a device's whole block.
+
+`fedavg.vmapped_train` trains a device's C clients by vmapping the whole
+per-client program. JAX's batching rules keep that correct but shape the
+per-layer ops badly for the MXU: a both-operands-batched conv folds the
+client axis into `feature_group_count`, so every layer runs C feature
+groups whose GEMMs each carry only ONE client's batch of rows — the
+MFU~0.02 profile row the ROADMAP's "Cross-client GEMM batching" item names.
+
+This module is the `TrainConfig.client_fusion="fused"` backend: the same
+local-training program (identical math, identical RNG streams, identical
+Keras-callback semantics) restructured so the client axis lives in the
+BATCH dimension of every conv/dense — activations flow client-folded as
+[C*B, ...] through `module.folded_apply` (models.folded: batch-grouped
+convs, client-batched dense GEMMs), the augment warp runs once on the
+folded batch, and the per-epoch validation evals run folded too. One
+forward/backward per step for the whole block, effective batch C*B.
+
+Per-client semantics are preserved exactly:
+
+  * per-client params / Adam state / LR-plateau scale — stacked leaves
+    (leading client axis); the optimizer update is elementwise, applied
+    per client via vmap (no GEMMs there to fuse);
+  * per-client shuffles and augment keys — the identical key derivation as
+    the vmap path (`client._epoch_streams`, `augment.draw_affine_params`),
+    so same keys => same batches => same affines;
+  * per-client early stopping — the callback state machine
+    (`client._epoch_update`) runs vmapped at epoch boundaries; a stopped
+    client's micro-batch still flows through the fused GEMM, but its
+    boundary update discards the phantom-trained weights (the same
+    mask-not-branch lockstep the vmap path uses);
+  * participation masks — a scheduled-out client's rows also keep flowing
+    through the GEMM (static SPMD shape for the masked round engine), but
+    its update is masked out each step, so its shipped weights are the
+    round's unchanged global weights.
+
+Backend selection (`TrainConfig.client_fusion`): "fused" | "vmap" pin a
+backend; "auto" (default) defers to the HEFL_CLIENT_FUSION env var, then
+to a one-shot micro-timing of the two backends on the live device — the
+same pattern as the augment row-shift auto-select — with the winner cached
+in-process and persisted per device-kind next to the XLA compile cache
+(utils.autoselect). `fusion_report()` exposes the choice for bench
+artifacts.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from hefl_tpu.data.augment import (
+    apply_affine,
+    draw_affine_params,
+    rescale,
+    resolve_shift_backend,
+)
+from hefl_tpu.fl.client import (
+    _epoch_streams,
+    _epoch_update,
+    _train_split,
+    client_shipped_params,
+    init_client_state,
+    train_batch_geometry,
+)
+from hefl_tpu.fl.config import TrainConfig
+from hefl_tpu.fl.optimizer import adam_update
+from hefl_tpu.models.folded import fold_clients, stack_params, unfold_clients
+
+FUSION_BACKENDS = ("fused", "vmap")
+
+# One-shot auto-selection state (process-global, same pattern as
+# data.augment): winner per device kind, plus what the last resolution
+# actually returned so fusion_report() describes traced programs.
+_AUTO_CHOICE: dict[str, str] = {}
+_AUTO_TIMINGS_MS: dict[str, float] | None = None
+_AUTO_PERSISTED: bool = False
+_LAST_RESOLVED: str | None = None
+
+
+def supports_fusion(module) -> bool:
+    """Does this model implement the client-folded forward?"""
+    return hasattr(module, "folded_apply")
+
+
+def _mask_select(keep: jax.Array, new_tree, old_tree):
+    """Per-client tree select: keep[c] picks new over old for client c's
+    slice of every stacked leaf."""
+    def sel(a, b):
+        k = keep.reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(k, a, b)
+
+    return jax.tree_util.tree_map(sel, new_tree, old_tree)
+
+
+def fused_train(
+    module,
+    cfg: TrainConfig,
+    global_params,
+    x_blk: jax.Array,
+    y_blk: jax.Array,
+    k_blk: jax.Array,
+    participation: jax.Array | None = None,
+):
+    """Train one device's block of clients through the client-folded path.
+
+    Same contract as `fedavg.vmapped_train` — x_blk: uint8[cpd, m, ...],
+    y_blk: int32[cpd, m], k_blk: per-client keys [cpd] — plus an optional
+    traced `participation` int[cpd] (the masked round engine's m_blk): a
+    0-masked client's data still flows through every fused GEMM (static
+    shape), but its parameter/optimizer/callback updates are masked to
+    no-ops, so it ships the round's global weights unchanged.
+    -> (shipped stacked weight trees [cpd, ...], metrics [cpd, E, 4]).
+    """
+    cpd = int(x_blk.shape[0])
+    m = int(x_blk.shape[1])
+    n_tr, grp, steps = train_batch_geometry(cfg, m)
+    if n_tr < 1:
+        raise ValueError(
+            f"client has {m} sample(s); needs >= 2 to carve out a validation "
+            "split (set val_fraction=0 to train on everything)"
+        )
+    n_val = m - n_tr
+    x_tr, y_tr = x_blk[:, n_val:], y_blk[:, n_val:]
+    if n_val:
+        x_va, y_va = x_blk[:, :n_val], y_blk[:, :n_val]
+    else:  # degenerate config: validate on the train slice
+        x_va, y_va = x_tr, y_tr
+    oh_tr = jax.nn.one_hot(y_tr, cfg.num_classes, dtype=jnp.float32)
+    oh_va = jax.nn.one_hot(y_va, cfg.num_classes, dtype=jnp.float32)
+    xva_folded = fold_clients(rescale(x_va))
+    bk = resolve_shift_backend(cfg.aug_backend) if cfg.augment else None
+
+    e = int(cfg.epochs)
+    epoch_keys = jax.vmap(lambda k: jax.random.split(k, e))(k_blk)  # [cpd, E]
+    # Per-client shuffles + augment keys from the SAME derivation as the
+    # vmap path (client._epoch_streams), vmapped over the block — same
+    # keys => same index/augment streams by construction. The split's
+    # static geometry is shared across clients, so client 0's split
+    # describes the whole block (the throwaway one-hot it builds is DCE'd).
+    sp0 = _train_split(cfg, x_blk[0], y_blk[0])
+    perms, aug_keys = jax.vmap(lambda ek: _epoch_streams(ek, sp0))(epoch_keys)
+    flat_perm = perms.reshape(cpd, e * steps, grp).swapaxes(0, 1)  # [T,cpd,grp]
+    flat_aug = aug_keys.reshape(cpd, e * steps).swapaxes(0, 1)     # [T,cpd]
+    is_end = (jnp.arange(e * steps) % steps) == steps - 1
+
+    params0 = stack_params(global_params, cpd)
+    st0 = jax.vmap(init_client_state)(params0)
+    keep = None if participation is None else participation > 0
+
+    def epoch_update_block(s0, p, o, vl, va):
+        return jax.vmap(
+            lambda s_, p_, o_, vl_, va_: _epoch_update(
+                cfg, s_, p_, o_, vl_, va_, track_best_acc=False
+            )
+        )(s0, p, o, vl, va)
+
+    def folded_metrics(p_stacked, xf, oh):
+        """Per-client (ce, acc) of the folded batch xf under stacked
+        params; oh: [cpd, b, K]."""
+        logits = unfold_clients(
+            module.folded_apply(p_stacked, xf, num_clients=cpd), cpd
+        )
+        ce = jnp.mean(optax.softmax_cross_entropy(logits, oh), axis=1)
+        acc = jnp.mean(
+            (jnp.argmax(logits, -1) == jnp.argmax(oh, -1)).astype(jnp.float32),
+            axis=1,
+        )
+        return ce, acc
+
+    def flat_step(carry, inp):
+        params_run, opt_run, st = carry
+        idx, k_aug, end = inp  # [cpd, grp], [cpd], scalar bool
+        xb = jnp.take_along_axis(
+            x_tr, idx[:, :, None, None, None], axis=1
+        )                                      # [cpd, grp, H, W, ch]
+        xb = fold_clients(rescale(xb))         # [cpd*grp, H, W, ch]
+        if cfg.augment:
+            s, zx, zy, f = jax.vmap(
+                lambda k: draw_affine_params(
+                    k, grp, cfg.aug_shear, cfg.aug_zoom, cfg.aug_flip
+                )
+            )(k_aug)                           # each [cpd, grp]
+            xb = apply_affine(
+                xb, s.reshape(-1), zx.reshape(-1), zy.reshape(-1),
+                f.reshape(-1), bk,
+            )
+        oh = jnp.take_along_axis(oh_tr, idx[:, :, None], axis=1)
+
+        def block_loss(p):
+            # Sum of per-client mean losses: client c's params only touch
+            # client c's term, so ONE backward through the folded graph
+            # yields every client's exact gradient.
+            ce, _ = folded_metrics(p, xb, oh)
+            loss = jnp.sum(ce)
+            if cfg.prox_mu > 0.0:
+                sq = jax.tree_util.tree_map(
+                    lambda t, g: jnp.sum(jnp.square(t - g[None])),
+                    p, global_params,
+                )
+                loss = loss + 0.5 * cfg.prox_mu * jax.tree_util.tree_reduce(
+                    jnp.add, sq
+                )
+            return loss
+
+        grads = jax.grad(block_loss)(params_run)
+        new_params, new_opt = jax.vmap(
+            lambda g, o, p, ls: adam_update(
+                g, o, p, cfg.lr, cfg.lr_decay, ls,
+                warmup_steps=cfg.warmup_steps,
+            )
+        )(grads, opt_run, params_run, st.lr_scale)
+        if keep is not None:
+            # Scheduled-out clients flow through the GEMM but update
+            # nothing — the multiplicative update mask of the fused step.
+            new_params = _mask_select(keep, new_params, params_run)
+            new_opt = _mask_select(keep, new_opt, opt_run)
+        params_run, opt_run = new_params, new_opt
+
+        def boundary(p, o, s0):
+            frozen = s0.stopped
+            eval_params = _mask_select(jnp.logical_not(frozen), p, s0.params)
+            val_loss, val_acc = folded_metrics(eval_params, xva_folded, oh_va)
+            ns, mets = epoch_update_block(s0, p, o, val_loss, val_acc)
+            return ns.params, ns.opt, ns, mets
+
+        def interior(p, o, s0):
+            return p, o, s0, jnp.zeros((cpd, 4), jnp.float32)
+
+        params_run, opt_run, st, mets = jax.lax.cond(
+            end, boundary, interior, params_run, opt_run, st
+        )
+        return (params_run, opt_run, st), mets
+
+    (_, _, final), mets = jax.lax.scan(
+        flat_step, (st0.params, st0.opt, st0), (flat_perm, flat_aug, is_end)
+    )
+    metrics = mets[steps - 1 :: steps].swapaxes(0, 1)  # [cpd, E, 4]
+    return jax.vmap(client_shipped_params)(final), metrics
+
+
+# --------------------------------------------------------------- selection
+
+
+def _time_backend(fn, *args) -> float:
+    import time
+
+    jax.block_until_ready(fn(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+# Micro-timing geometry: a block of 8 clients of batch 8 through a small
+# 2-conv CNN — big enough that the feature-grouped vs batch-grouped conv
+# lowerings separate, small enough to cost well under a second per backend.
+_PROBE_CLIENTS = 8
+_PROBE_BATCH = 8
+_PROBE_HW = 24
+
+
+def _autoselect_backend() -> str:
+    """One-shot fused-vs-vmap micro-timing on the live device: one SGD-step
+    gradient (the hot op mix the backends differ on) per backend, winner
+    cached for the process and persisted per device-kind next to the XLA
+    compile cache. Wrapped in `ensure_compile_time_eval` so a resolution
+    triggered inside an outer trace still times real execution (same
+    rationale as data.augment's probe)."""
+    global _AUTO_TIMINGS_MS, _AUTO_PERSISTED
+    kind = str(getattr(jax.devices()[0], "device_kind", "unknown"))
+    if kind in _AUTO_CHOICE:
+        return _AUTO_CHOICE[kind]
+    from hefl_tpu.utils.autoselect import load_winner, store_winner
+
+    hit = load_winner("client_fusion", kind)
+    if hit is not None and hit["winner"] in FUSION_BACKENDS:
+        _AUTO_CHOICE[kind] = hit["winner"]
+        _AUTO_TIMINGS_MS = hit.get("timings_ms")
+        _AUTO_PERSISTED = True
+        return hit["winner"]
+
+    from hefl_tpu.models.cnn import SmallCNN
+
+    c, b, hw = _PROBE_CLIENTS, _PROBE_BATCH, _PROBE_HW
+    probe = SmallCNN(num_classes=10)
+    with jax.ensure_compile_time_eval():
+        p0 = probe.init(
+            jax.random.key(0), jnp.zeros((1, hw, hw, 1), jnp.float32)
+        )["params"]
+        ps = stack_params(p0, c)
+        x = jax.random.uniform(jax.random.key(1), (c, b, hw, hw, 1))
+        oh = jax.nn.one_hot(jnp.zeros((c, b), jnp.int32), 10)
+
+        def loss_vmap(ps):
+            def one(p, xc, ohc):
+                lg = probe.apply({"params": p}, xc)
+                return jnp.mean(optax.softmax_cross_entropy(lg, ohc))
+
+            return jnp.sum(jax.vmap(one)(ps, x, oh))
+
+        def loss_fused(ps):
+            lg = unfold_clients(
+                probe.folded_apply(ps, fold_clients(x), num_clients=c), c
+            )
+            return jnp.sum(
+                jnp.mean(optax.softmax_cross_entropy(lg, oh), axis=1)
+            )
+
+        timings = {
+            "vmap": _time_backend(jax.jit(jax.grad(loss_vmap)), ps),
+            "fused": _time_backend(jax.jit(jax.grad(loss_fused)), ps),
+        }
+    _AUTO_TIMINGS_MS = {k: round(v * 1e3, 3) for k, v in timings.items()}
+    winner = min(timings, key=timings.get)
+    _AUTO_CHOICE[kind] = winner
+    store_winner("client_fusion", kind, winner, _AUTO_TIMINGS_MS)
+    return winner
+
+
+def resolve_fusion_backend(setting: str | None, module) -> str:
+    """The training backend a round program will trace with.
+
+    Priority: explicit TrainConfig.client_fusion pin > HEFL_CLIENT_FUSION
+    env (consulted only when the config says "auto") > one-shot
+    micro-timing. A model without a `folded_apply` makes "auto" fall back
+    to vmap and makes an explicit "fused" pin an error.
+    """
+    global _LAST_RESOLVED
+    requested = setting or "auto"
+    if requested == "auto":
+        requested = os.environ.get("HEFL_CLIENT_FUSION") or "auto"
+    if requested not in FUSION_BACKENDS + ("auto",):
+        raise ValueError(
+            f"client fusion backend {requested!r}: expected one of "
+            f"{FUSION_BACKENDS + ('auto',)}"
+        )
+    if requested == "fused" and not supports_fusion(module):
+        raise ValueError(
+            f"client_fusion='fused' but {type(module).__name__} has no "
+            "folded_apply — implement the client-folded forward "
+            "(models.folded) or use 'vmap'/'auto'"
+        )
+    if requested == "auto":
+        requested = (
+            _autoselect_backend() if supports_fusion(module) else "vmap"
+        )
+    _LAST_RESOLVED = requested
+    return requested
+
+
+def fusion_report() -> dict:
+    """Which client-training backend round programs traced with — the
+    record every bench/profile artifact embeds (`client_fusion`)."""
+    env = os.environ.get("HEFL_CLIENT_FUSION") or "auto"
+    return {
+        "requested": env,
+        "backend": _LAST_RESOLVED,
+        "auto_timings_ms": _AUTO_TIMINGS_MS,
+        "auto_persisted": _AUTO_PERSISTED,
+    }

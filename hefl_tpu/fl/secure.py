@@ -38,12 +38,13 @@ from hefl_tpu.fl.config import TrainConfig
 from hefl_tpu.fl.faults import RoundMeta, exclusion_bits, poison_tree
 from hefl_tpu.fl.fedavg import (
     _mask_inputs,
+    _round_geometry,
     _trivial_mask,
     masked_mean_tree,
     masked_mode,
     pad_index,
     replicate_on,
-    vmapped_train,
+    train_block,
 )
 from hefl_tpu.ckks.modular import add_mod as modular_add_mod
 from hefl_tpu.parallel import (
@@ -186,6 +187,7 @@ def secure_fedavg_round(
     dp=None,
     participation=None,
     poison=None,
+    num_real_clients: int | None = None,
 ) -> tuple:
     """One encrypted FedAvg round: local training + encrypt + psum, jitted.
 
@@ -225,10 +227,15 @@ def secure_fedavg_round(
     `decrypt_average` needs for its decode denominator. An all-ones mask
     with no poison and no sanitization knobs takes the historical fast
     path: bit-identical ciphertexts, same compiled program.
+
+    `num_real_clients` (with xs/ys pre-padded by `fedavg.pad_federated`)
+    hoists the per-round padding gather out of the round — the same
+    contract as `fedavg_round`.
     """
-    num_clients = int(xs.shape[0])
     n_dev = client_mesh_size(mesh)
-    pad_idx = pad_index(num_clients, n_dev)
+    num_clients, pad_idx, prepadded = _round_geometry(
+        xs, n_dev, num_real_clients
+    )
     sanitizing = cfg.on_overflow == "exclude" or cfg.max_update_norm > 0
     explicit = participation is not None or poison is not None
     masked = masked_mode(cfg, num_clients, n_dev, explicit, secure=True)
@@ -272,10 +279,11 @@ def secure_fedavg_round(
         return outs[:3] + (meta,) + outs[3:]
     part, pois = _mask_inputs(num_clients, participation, poison, pad_idx)
     if pad_idx is not None:
-        xs, ys = xs[pad_idx], ys[pad_idx]
         train_keys, enc_keys = train_keys[pad_idx], enc_keys[pad_idx]
         if dp_keys is not None:
             dp_keys = dp_keys[pad_idx]
+        if not prepadded:
+            xs, ys = xs[pad_idx], ys[pad_idx]
     fn = _build_secure_round_fn(
         module, cfg, mesh, ctx, with_plain_reference, dp, num_clients,
         masked=True,
@@ -335,6 +343,12 @@ def _build_secure_round_fn(
 
     axes = client_axes(mesh)   # ("clients",) or ("hosts", "clients")
     n_dev = client_mesh_size(mesh)
+    # Cross-client backend resolved once per factory call (concrete
+    # context; the auto micro-timing probe runs eagerly) — see
+    # fedavg._build_round_fn.
+    from hefl_tpu.fl.fusion import resolve_fusion_backend
+
+    backend = resolve_fusion_backend(cfg.client_fusion, module)
 
     def body(gp, pk, x_blk, y_blk, kt_blk, ke_blk, *rest):
         i = 0
@@ -342,7 +356,10 @@ def _build_secure_round_fn(
         if dp is not None:
             kd_blk, i = rest[0], 1
         m_blk, po_blk = (rest[i], rest[i + 1]) if masked else (None, None)
-        p_out, mets = vmapped_train(module, cfg, gp, x_blk, y_blk, kt_blk)
+        p_out, mets = train_block(
+            module, cfg, gp, x_blk, y_blk, kt_blk,
+            m_blk=m_blk, backend=backend,
+        )
         if dp is not None:
             from hefl_tpu.fl.dp import dp_sanitize
 

@@ -24,6 +24,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from hefl_tpu.models.folded import folded_conv, folded_dense
+
 
 class MedCNN(nn.Module):
     """The reference's medical-image CNN (FLPyfhelin.py:118-141), 222,722
@@ -59,6 +61,35 @@ class MedCNN(nn.Module):
         x = x.astype(jnp.float32)
         return nn.softmax(x) if self.apply_softmax else x
 
+    def folded_apply(self, stacked_params, x, *, num_clients: int):
+        """The client-folded forward (`TrainConfig.client_fusion="fused"`):
+        same architecture and compute dtypes as `__call__`, but over a
+        client-folded batch with per-client weights.
+
+        x: [C*B, H, W, ch] float activations, client c owning rows
+        [c*B:(c+1)*B]; stacked_params: this module's param pytree with a
+        leading client axis on every leaf (models.folded.stack_params
+        layout). Every conv is ONE batch-grouped conv of batch C*B and
+        every dense ONE client-batched GEMM — identical math /
+        cost_analysis() FLOPs to `jax.vmap(self.apply)`, in one op per
+        layer. -> logits (or probs) [C*B, num_classes] float32.
+        """
+        c = num_clients
+        for i in range(len(self.features)):
+            lyr = stacked_params[f"Conv_{i}"]
+            x = folded_conv(x, lyr["kernel"], lyr["bias"], num_clients=c)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        b = x.shape[0] // c
+        x = x.reshape(c, b, -1)
+        for j in range(len(self.dense)):
+            lyr = stacked_params[f"Dense_{j}"]
+            x = nn.relu(folded_dense(x, lyr["kernel"], lyr["bias"]))
+        head = stacked_params[f"Dense_{len(self.dense)}"]
+        x = folded_dense(x, head["kernel"], head["bias"])
+        x = x.astype(jnp.float32).reshape(c * b, -1)
+        return nn.softmax(x) if self.apply_softmax else x
+
 
 class SmallCNN(MedCNN):
     """2-conv CNN for the MNIST baseline configs (BASELINE.json configs 1-2):
@@ -88,6 +119,17 @@ class LogReg(nn.Module):
             self.num_classes, dtype=jnp.bfloat16, param_dtype=jnp.float32
         )(x)
         x = x.astype(jnp.float32)
+        return nn.softmax(x) if self.apply_softmax else x
+
+    def folded_apply(self, stacked_params, x, *, num_clients: int):
+        """Client-folded forward (see MedCNN.folded_apply): one batched
+        GEMM for the whole cohort's logistic regression."""
+        c = num_clients
+        b = x.shape[0] // c
+        x = x.reshape(c, b, -1)
+        lyr = stacked_params["Dense_0"]
+        x = folded_dense(x, lyr["kernel"], lyr["bias"])
+        x = x.astype(jnp.float32).reshape(c * b, -1)
         return nn.softmax(x) if self.apply_softmax else x
 
 

@@ -1,0 +1,184 @@
+"""Client-folded layer primitives: per-client weights, one GEMM stream.
+
+The cross-client training backend (`TrainConfig.client_fusion="fused"`,
+fl.fusion) trains a device's whole block of C clients through ONE forward/
+backward per step instead of a vmap over clients. The layer math lives
+here, and the key decision is how per-client convolutions lower:
+
+  * What vmap emits: JAX's conv batching rule folds a both-operands-
+    batched conv into GROUPED convolutions (`feature_group_count *= C`;
+    see jax._src.lax.convolution._conv_general_dilated_batch_rule), and
+    its autodiff transposes are grouped convs too. Grouped convs keep each
+    client's GEMM separate — the MXU never sees a tile-filling batch, and
+    XLA backends routinely hit slow paths on the grouped transpose forms
+    (measured on XLA:CPU: the weight-gradient of one 13x13 conv layer at
+    8 clients is ~440 ms as a grouped conv vs ~10 ms as the GEMM below).
+  * What `folded_conv` emits: direct convolution by kernel-offset
+    decomposition — for each of the kh*kw kernel taps, one
+    client-batched `dot_general` ('cbpqi,cio->cbpqo') over the strided
+    input window, accumulated in f32 and rounded once. Every stage of
+    training — forward, input-gradient, weight-gradient — then lowers to
+    the SAME shape of batched GEMM whose leading dimensions stream
+    C*B*H'*W' rows through the MXU, with the client axis as the
+    dot_general batch. Identical math, identical `cost_analysis()` FLOPs
+    (kh*kw*C * 2*M*N*K is exactly the conv's count), no grouped convs
+    anywhere.
+
+All primitives are mathematically exact per client (block-structured:
+client c's outputs depend only on client c's inputs and weights — the
+batched GEMM never mixes batch groups), so fused-vs-vmap equivalence is a
+float-tolerance property, not an approximation (tests/test_perf.py pins
+it).
+
+Layout contract shared by every primitive:
+
+  * folded activations: [C*B, ...] with client c owning the contiguous
+    rows [c*B : (c+1)*B] (`fold_clients` / `unfold_clients` — pure
+    reshapes, client-major order makes them free);
+  * stacked params: the pytree of per-client weights with a leading client
+    axis on every leaf (`stack_params`).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def fold_clients(x: jax.Array) -> jax.Array:
+    """[C, B, ...] -> [C*B, ...] (client-major, contiguous per client)."""
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+
+def unfold_clients(x: jax.Array, num_clients: int) -> jax.Array:
+    """[C*B, ...] -> [C, B, ...]."""
+    return x.reshape((num_clients, x.shape[0] // num_clients) + x.shape[1:])
+
+
+def stack_params(params, num_clients: int):
+    """Broadcast one parameter pytree to the stacked per-client layout
+    (leaves gain a leading client axis). The fused trainer's round entry:
+    every client starts from the round's global weights."""
+    return jax.tree_util.tree_map(
+        lambda t: jnp.broadcast_to(t[None], (num_clients,) + t.shape), params
+    )
+
+
+def folded_conv(
+    x: jax.Array,
+    kernel: jax.Array,
+    bias: jax.Array | None,
+    *,
+    num_clients: int,
+    strides: tuple[int, int] = (1, 1),
+    padding: str = "VALID",
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Per-client 2-D convolution as kh*kw client-batched GEMMs.
+
+    x: [C*B, H, W, ch] folded activations; kernel: [C, kh, kw, ch, f]
+    stacked per-client filters; bias: [C, f] or None. -> [C*B, H', W', f].
+
+    Direct convolution by kernel-offset decomposition (module docstring):
+    each kernel tap contributes one `dot_general` with the client axis as
+    the GEMM batch, partials accumulate in f32 (XLA's own conv
+    accumulation dtype) and round ONCE to `dtype` — matching
+    flax.linen.Conv(dtype=bf16, param_dtype=f32) numerics at equal
+    inputs. Autodiff of this form stays in the same GEMM family: the
+    weight- and input-gradients are the einsum transposes, never a
+    grouped-conv slow path.
+    """
+    c = num_clients
+    kh, kw, ch, f = kernel.shape[1:]
+    xb = x.astype(dtype)
+    k = kernel.astype(dtype)
+    cb, h, w = x.shape[0], x.shape[1], x.shape[2]
+    b = cb // c
+    sh, sw = strides
+    if padding == "SAME":
+        ph = max((math.ceil(h / sh) - 1) * sh + kh - h, 0)
+        pw = max((math.ceil(w / sw) - 1) * sw + kw - w, 0)
+        xb = jnp.pad(
+            xb, ((0, 0), (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2), (0, 0))
+        )
+        h, w = xb.shape[1], xb.shape[2]
+    elif padding != "VALID":
+        raise ValueError(f"folded_conv: unsupported padding {padding!r}")
+    ho = (h - kh) // sh + 1
+    wo = (w - kw) // sw + 1
+    xg = xb.reshape(c, b, h, w, ch)
+    acc = None
+    for i in range(kh):
+        for j in range(kw):
+            xs = lax.slice(
+                xg,
+                (0, 0, i, j, 0),
+                (c, b, i + (ho - 1) * sh + 1, j + (wo - 1) * sw + 1, ch),
+                (1, 1, sh, sw, 1),
+            )
+            t = jnp.einsum(
+                "cbpqi,cio->cbpqo", xs, k[:, i, j],
+                preferred_element_type=jnp.float32,
+            )
+            acc = t if acc is None else acc + t
+    out = acc.astype(dtype)
+    if bias is not None:
+        out = out + bias.astype(dtype)[:, None, None, None, :]
+    return out.reshape(cb, ho, wo, f)
+
+
+def folded_dense(
+    x: jax.Array,
+    kernel: jax.Array,
+    bias: jax.Array | None,
+    *,
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Per-client dense layer as ONE batched GEMM.
+
+    x: [C, B, d_in]; kernel: [C, d_in, d_out]; bias: [C, d_out] or None.
+    -> [C, B, d_out] in `dtype` (flax Dense compute-dtype semantics).
+    """
+    out = jnp.einsum(
+        "cbi,cio->cbo", x.astype(dtype), kernel.astype(dtype)
+    )
+    if bias is not None:
+        out = out + bias[:, None, :].astype(dtype)
+    return out
+
+
+def folded_group_norm(
+    x: jax.Array,
+    scale: jax.Array,
+    bias: jax.Array,
+    *,
+    num_clients: int,
+    num_groups: int,
+    eps: float = 1e-6,
+) -> jax.Array:
+    """flax.linen.GroupNorm on a client-folded batch with per-client
+    scale/bias. GroupNorm statistics are per-SAMPLE (mean/var over spatial
+    dims and the channels inside each group), so folding clients into the
+    batch leaves the normalization untouched; only the learned affine is
+    per-client. x: [C*B, H, W, f] (any float dtype; computed in f32, like
+    the models' GroupNorm(dtype=f32)); scale/bias: [C, f]. -> f32.
+    """
+    c = num_clients
+    n, h, w, f = x.shape
+    g = num_groups
+    xf = x.astype(jnp.float32).reshape(n, h, w, g, f // g)
+    # flax _compute_stats fast-variance form: var = E[x^2] - E[x]^2 —
+    # matched exactly so fused-vs-vmap ResNet parity is reduction-order
+    # noise, not a formula difference.
+    mean = jnp.mean(xf, axis=(1, 2, 4), keepdims=True)
+    mean2 = jnp.mean(jnp.square(xf), axis=(1, 2, 4), keepdims=True)
+    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    xn = ((xf - mean) * lax.rsqrt(var + eps)).reshape(n, h, w, f)
+    # Per-client affine: client c's scale/bias applies to its contiguous
+    # rows [c*B:(c+1)*B] of the folded batch.
+    sc = jnp.repeat(scale.astype(jnp.float32), n // c, axis=0)[:, None, None, :]
+    bi = jnp.repeat(bias.astype(jnp.float32), n // c, axis=0)[:, None, None, :]
+    return xn * sc + bi

@@ -18,6 +18,12 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
+from hefl_tpu.models.folded import (
+    folded_conv,
+    folded_dense,
+    folded_group_norm,
+)
+
 
 class BasicBlock(nn.Module):
     features: int
@@ -68,4 +74,57 @@ class ResNet20(nn.Module):
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.bfloat16, param_dtype=jnp.float32)(x)
         x = x.astype(jnp.float32)
+        return nn.softmax(x) if self.apply_softmax else x
+
+    def folded_apply(self, stacked_params, x, *, num_clients: int):
+        """Client-folded forward (`TrainConfig.client_fusion="fused"`; see
+        models.folded and MedCNN.folded_apply): the same depth-20 network
+        over a client-folded batch with per-client weights — every conv one
+        batch-grouped conv of batch C*B, GroupNorm per-sample (folding-
+        invariant) with per-client affines. x: [C*B, H, W, ch];
+        stacked_params: this module's params with a leading client axis.
+        -> [C*B, num_classes] float32.
+        """
+        c = num_clients
+
+        def gn(p, h):
+            return folded_group_norm(
+                h, p["scale"], p["bias"], num_clients=c, num_groups=8
+            )
+
+        def block(p, h, stride):
+            y = folded_conv(
+                h, p["Conv_0"]["kernel"], None, num_clients=c,
+                strides=(stride, stride), padding="SAME",
+            )
+            y = nn.relu(gn(p["GroupNorm_0"], y))
+            y = folded_conv(
+                y, p["Conv_1"]["kernel"], None, num_clients=c, padding="SAME"
+            )
+            y = gn(p["GroupNorm_1"], y)
+            residual = h
+            if "Conv_2" in p:  # projection shortcut (shape change)
+                residual = folded_conv(
+                    h, p["Conv_2"]["kernel"], None, num_clients=c,
+                    strides=(stride, stride), padding="SAME",
+                )
+                residual = gn(p["GroupNorm_2"], residual)
+            return nn.relu(y + residual)
+
+        x = folded_conv(
+            x, stacked_params["Conv_0"]["kernel"], None, num_clients=c,
+            padding="SAME",
+        )
+        x = nn.relu(gn(stacked_params["GroupNorm_0"], x))
+        i = 0
+        for stage, blocks in enumerate(self.stage_sizes):
+            for b_idx in range(blocks):
+                stride = 2 if (stage > 0 and b_idx == 0) else 1
+                x = block(stacked_params[f"BasicBlock_{i}"], x, stride)
+                i += 1
+        x = jnp.mean(x, axis=(1, 2))
+        b = x.shape[0] // c
+        head = stacked_params["Dense_0"]
+        x = folded_dense(x.reshape(c, b, -1), head["kernel"], head["bias"])
+        x = x.astype(jnp.float32).reshape(c * b, -1)
         return nn.softmax(x) if self.apply_softmax else x

@@ -76,4 +76,17 @@ PRESETS: dict[str, ExperimentConfig] = {
         ),
         max_round_retries=1, retry_backoff_s=0.1,
     ),
+    # Cross-client fusion smoke (README "Client fusion"): a plaintext
+    # 8-client run with the fused GEMM-stream backend pinned — the
+    # CPU-sized config for eyeballing fused-vs-vmap behavior end to end
+    # (the equivalence itself is pinned by tests/test_perf.py; the timed
+    # comparison rows live in profile_round.py / bench artifacts).
+    "fusion-smoke": ExperimentConfig(
+        model="smallcnn", dataset="mnist", num_clients=8, rounds=2,
+        encrypted=False, seed=0, n_train=512, n_test=128,
+        train=TrainConfig(
+            num_classes=10, epochs=2, batch_size=8, val_fraction=0.25,
+            client_fusion="fused",
+        ),
+    ),
 }

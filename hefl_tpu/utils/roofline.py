@@ -133,6 +133,33 @@ def train_flops_per_round(
     return bwd_multiplier * fwd_flops * steps_per_epoch * epochs * num_clients
 
 
+def backend_compare(
+    seconds_by_backend: Mapping[str, float | None],
+    flops: float | None = None,
+    device: Any = None,
+    images: int | None = None,
+) -> dict[str, Any]:
+    """Fused-vs-vmap (or any backend shootout) roofline rows.
+
+    -> {backend: phase_stats(...), "fused_speedup_vs_vmap": ratio} — the
+    comparison record bench.py / profile_round.py artifacts embed so every
+    artifact carries both backends' MFU at the same math (same `flops`
+    numerator: the backends run identical FLOPs by construction, only the
+    wall-clock differs). The speedup field is present (null when either
+    side is missing) so schema gates can demand it.
+    """
+    rows: dict[str, Any] = {
+        k: phase_stats(v, flops=flops, device=device, images=images)
+        for k, v in seconds_by_backend.items()
+    }
+    vmap_s = seconds_by_backend.get("vmap")
+    fused_s = seconds_by_backend.get("fused")
+    rows["fused_speedup_vs_vmap"] = (
+        round(vmap_s / fused_s, 3) if (vmap_s and fused_s) else None
+    )
+    return rows
+
+
 def clamp_attribution(
     raw: Mapping[str, float]
 ) -> tuple[dict[str, float], bool]:

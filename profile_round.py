@@ -162,6 +162,25 @@ def main() -> None:
         times[name] = _steady(fn)
         log(f"{name}: {times[name]:.3f}s")
 
+    # Fused-vs-vmap comparison rows (ISSUE 3): the SAME plain round timed
+    # under each cross-client training backend (fl.fusion) — identical
+    # math/FLOPs, different per-layer GEMM shaping — so every profile
+    # artifact records what client fusion buys on this device.
+    from hefl_tpu.fl.fusion import fusion_report, supports_fusion
+
+    fusion_times: dict[str, float] = {}
+    for bk_name in ("vmap", "fused"):
+        if bk_name == "fused" and not supports_fusion(module):
+            continue
+        cfg_bk = dataclasses.replace(cfg, client_fusion=bk_name)
+        fusion_times[bk_name] = _steady(
+            lambda c=cfg_bk: fedavg_round(
+                module, c, mesh, params, xs_d, ys_d, key
+            )[0]
+        )
+        log(f"plain round [client_fusion={bk_name}]: "
+            f"{fusion_times[bk_name]:.3f}s")
+
     # Standalone HE stages (not inside the big program): encrypt both
     # clients' params + aggregate + decrypt + evaluate.
     enc2 = jax.jit(
@@ -236,6 +255,9 @@ def main() -> None:
         "decrypt": roofline.phase_stats(t_decrypt, device=dev),
         "evaluate": roofline.phase_stats(t_evaluate, device=dev, images=len(xt)),
     }
+    client_fusion_compare = roofline.backend_compare(
+        fusion_times, flops=train_flops, device=dev, images=train_images
+    )
 
     att = {
         "full_round_s": round(full, 3),
@@ -251,6 +273,9 @@ def main() -> None:
             f"augment_{b}_ms": round(t * 1e3, 3) for b, t in aug_times.items()
         },
         "augment_backend": {**backend_report(), "backend": chosen},
+        # Cross-client backend record + the timed fused-vs-vmap MFU rows.
+        "client_fusion": fusion_report(),
+        "client_fusion_compare": client_fusion_compare,
         "phase_roofline": phase_roofline,
         "device": roofline.device_kind(dev),
     }
@@ -296,6 +321,15 @@ def main() -> None:
     for b in SHIFT_BACKENDS:
         tag = " (selected)" if b == chosen else ""
         print(f"| {b}{tag} | {att[f'augment_{b}_ms']} |")
+    print()
+    print("| client-fusion backend (plain round) | seconds | MFU |")
+    print("|---|---|---|")
+    for b, t in fusion_times.items():
+        row = client_fusion_compare[b]
+        print(f"| {b} | {t:.3f} | {row['mfu']} |")
+    sp = client_fusion_compare.get("fused_speedup_vs_vmap")
+    if sp is not None:
+        print(f"\nfused train-round speedup vs vmap: {sp}x")
     print(json.dumps({"metric": "phase_attribution", **att}))
 
 

@@ -8,7 +8,10 @@
 #       phase and the augment backend choice;
 #   (c) no clamped attribution row is negative, and any negative RAW delta
 #       is flagged attribution_unreliable (the PROFILE.md -17.7% row class
-#       of bug fails here, on CPU, instead of poisoning TPU evidence).
+#       of bug fails here, on CPU, instead of poisoning TPU evidence);
+#   (d) the client_fusion backend record and the fused-vs-vmap comparison
+#       rows (seconds/mfu/images_per_s per backend + speedup) are present
+#       — the ISSUE-3 schema every bench artifact now carries.
 # Wired into run_tpu_suite.sh as stage 0 (cheap pre-stage, no backend
 # probe needed — both harnesses pin themselves to CPU in smoke mode).
 set -euo pipefail
@@ -91,6 +94,33 @@ else:
             fail.append(f"profile: clamped attribution row {k} is negative")
     if "augment_backend" not in rec:
         fail.append("profile: missing augment_backend record")
+    # Client-fusion schema gate (ISSUE 3): every profile artifact must
+    # record the cross-client backend and the fused-vs-vmap comparison.
+    cf = rec.get("client_fusion")
+    if not isinstance(cf, dict) or "backend" not in cf:
+        fail.append("profile: missing client_fusion backend record")
+    cmp_rows = rec.get("client_fusion_compare")
+    if not isinstance(cmp_rows, dict):
+        fail.append("profile: missing client_fusion_compare rows")
+    else:
+        if "fused_speedup_vs_vmap" not in cmp_rows:
+            fail.append("profile: client_fusion_compare missing "
+                        "fused_speedup_vs_vmap")
+        for bk in ("vmap", "fused"):
+            row = cmp_rows.get(bk)
+            if not isinstance(row, dict) or not {
+                "seconds", "mfu", "images_per_s"
+            } <= set(row):
+                fail.append(
+                    f"profile: client_fusion_compare[{bk!r}] missing the "
+                    "seconds/mfu/images_per_s schema"
+                )
+        speedup = cmp_rows.get("fused_speedup_vs_vmap")
+        if isinstance(speedup, (int, float)) and speedup < 1.0:
+            print(
+                f"WARNING: fused train round is {speedup}x vmap on this "
+                "device — auto mode will keep picking vmap here"
+            )
 
 if fail:
     print("PERF SMOKE FAILED:")

@@ -16,6 +16,14 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Auto-selection determinism for the suite: pin the cross-client training
+# backend to the vmap reference (tests that exercise the fused backend pin
+# client_fusion="fused" per-config), and disable the persisted
+# auto-selection winners so auto-mode tests always exercise the live
+# micro-timing path instead of a previous run's cached choice.
+os.environ.setdefault("HEFL_CLIENT_FUSION", "vmap")
+os.environ.setdefault("HEFL_AUTOSELECT_CACHE", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

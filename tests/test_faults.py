@@ -542,3 +542,109 @@ def test_retry_exhaustion_raises():
     )
     with pytest.raises(DeviceLost):
         run_experiment(cfg, verbose=False)
+
+
+# ----------------------------------------------- fused backend x masked engine
+
+
+import dataclasses
+
+_FUSED_CFG = dataclasses.replace(CFG, client_fusion="fused")
+
+
+def test_fused_masked_round_matches_vmap_aggregate():
+    # The masked engine must aggregate the same global model whichever
+    # cross-client backend trained the block: participation + NaN poison,
+    # fused vs vmap, aggregate within float tolerance and identical meta.
+    model, params, xs, ys = _setup(4)
+    mesh = make_mesh(4)
+    key = jax.random.key(31)
+    part = np.array([1, 1, 0, 1])
+    pois = np.array([POISON_NAN, 0, 0, 0])
+    p_v, _, meta_v = fedavg_round(
+        model, CFG, mesh, params, xs, ys, key,
+        participation=part, poison=pois,
+    )
+    p_f, _, meta_f = fedavg_round(
+        model, _FUSED_CFG, mesh, params, xs, ys, key,
+        participation=part, poison=pois,
+    )
+    assert meta_f.bits == meta_v.bits and meta_f.surviving == 2
+    for a, b in zip(_leaves(p_v), _leaves(p_f)):
+        np.testing.assert_allclose(a, b, atol=2e-2)
+
+
+def test_fused_mask_cannot_perturb_surviving_clients():
+    # Same compiled fused program, different mask values: a dropped
+    # client's zeroed update must leave every surviving client's
+    # contribution BITWISE identical (the static-SPMD-shape guarantee the
+    # masked round engine relies on).
+    from hefl_tpu.fl.fusion import fused_train
+
+    model, params, xs, ys = _setup(4)
+    keys = jax.random.split(jax.random.key(33), 4)
+    f = jax.jit(
+        lambda p, part: fused_train(
+            model, _FUSED_CFG, p, xs, ys, keys, participation=part
+        )
+    )
+    pa, _ = f(params, jnp.asarray([1, 0, 1, 1], jnp.int32))
+    pb, _ = f(params, jnp.asarray([1, 1, 1, 1], jnp.int32))
+    for a, b in zip(_leaves(pa), _leaves(pb)):
+        np.testing.assert_array_equal(a[[0, 2, 3]], b[[0, 2, 3]])
+    # and the masked client ships the round's global weights unchanged
+    for a, g in zip(_leaves(pa), _leaves(params)):
+        np.testing.assert_array_equal(a[1], g)
+
+
+def test_fused_all_ones_mask_reuses_legacy_executable():
+    # Acceptance: no new compile per round under the all-ones mask, fused
+    # backend included — the trivial mask routes to the one legacy
+    # executable, and repeated rounds hit the same compiled program.
+    from hefl_tpu.fl.fedavg import _build_round_fn
+
+    _build_round_fn.cache_clear()
+    model, params, xs, ys = _setup(2)
+    mesh = make_mesh(2)
+    outs = []
+    for r in range(2):
+        new_p, _, meta = fedavg_round(
+            model, _FUSED_CFG, mesh, params, xs, ys, jax.random.key(40 + r),
+            participation=np.ones(2),
+        )
+        outs.append(new_p)
+        assert meta.surviving == 2
+    assert _build_round_fn.cache_info().currsize == 1
+    fn = _build_round_fn(model, _FUSED_CFG, mesh)
+    assert fn._cache_size() == 1, (
+        f"fused all-ones rounds compiled {fn._cache_size()} programs"
+    )
+
+
+def test_fused_secure_masked_round_drop_nan_and_reference():
+    # The encrypted masked engine end-to-end on the fused backend: drop +
+    # NaN-poison, decrypted aggregate vs the in-program masked plaintext
+    # reference, metadata attribution intact.
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients, per_client=8)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=512)
+    sk, pk = keygen(ctx, jax.random.key(51))
+    spec = PackSpec.for_params(params, ctx.n)
+    cfg = dataclasses.replace(
+        TrainConfig(epochs=1, batch_size=4, num_classes=10, augment=False,
+                    val_fraction=0.25),
+        client_fusion="fused",
+    )
+    part = np.array([1, 1, 0, 1])
+    pois = np.array([POISON_NAN, 0, 0, 0])
+    ct, mets, ov, meta, ref = secure_fedavg_round(
+        model, cfg, mesh, ctx, pk, params, xs, ys, jax.random.key(52),
+        with_plain_reference=True, participation=part, poison=pois,
+    )
+    assert mets.shape == (num_clients, 1, 4)
+    assert meta.surviving == 2
+    assert meta.excluded["scheduled"] == 1 and meta.excluded["nonfinite"] == 1
+    avg = decrypt_average(ctx, sk, ct, num_clients, spec, meta=meta)
+    for a, b in zip(_leaves(avg), _leaves(ref)):
+        np.testing.assert_allclose(a, b, atol=5e-4)

@@ -1,0 +1,375 @@
+"""Client-fusion primitives + auto-selection + prefetch (ISSUE 3).
+
+Unit-level coverage of the fused cross-client backend's building blocks:
+
+  * folded layer math — `folded_apply` / `folded_conv` against the
+    vmapped flax reference (forward AND gradients, strides/padding);
+  * backend resolution — pins, junk, unsupported-model fallback;
+  * persisted auto-selection — the per-device-kind winner written next to
+    the XLA compile cache and reloaded without re-probing;
+  * RoundPrefetcher — identity short-circuit, staged promotion, stale
+    buffer retirement.
+
+Trainer-level fused-vs-vmap equivalence lives in tests/test_perf.py; the
+masked round engine on the fused backend in tests/test_faults.py.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.models import LogReg, MedCNN, ResNet20, SmallCNN
+from hefl_tpu.models.folded import (
+    fold_clients,
+    folded_conv,
+    stack_params,
+    unfold_clients,
+)
+
+
+def _stacked(model, shape, c, seed=0):
+    p0 = model.init(jax.random.key(seed), jnp.zeros((1,) + shape))["params"]
+    # distinct per-client weights: fusion must be exact for DIVERGED
+    # clients, not just the all-identical round entry
+    return jax.tree_util.tree_map(
+        lambda t: jnp.stack([t * (1 + 0.05 * i) for i in range(c)]), p0
+    )
+
+
+@pytest.mark.parametrize(
+    "model,shape,atol",
+    [
+        (SmallCNN(num_classes=10), (28, 28, 1), 1e-4),
+        (LogReg(num_classes=10), (28, 28, 1), 1e-6),
+        # 20 bf16 layers accumulate reduction-order drift; tolerance, not
+        # approximation (every layer is exact math — see models.folded).
+        (ResNet20(num_classes=10), (32, 32, 3), 5e-2),
+    ],
+)
+def test_folded_apply_matches_vmap_forward(model, shape, atol):
+    c, b = 3, 4
+    ps = _stacked(model, shape, c)
+    x = jax.random.uniform(jax.random.key(1), (c, b) + shape)
+    ref = jax.vmap(lambda p, xx: model.apply({"params": p}, xx))(ps, x)
+    got = unfold_clients(
+        jax.jit(
+            lambda ps, xf: model.folded_apply(ps, xf, num_clients=c)
+        )(ps, fold_clients(x)),
+        c,
+    )
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=atol)
+
+
+def test_folded_apply_matches_vmap_forward_medcnn():
+    # The flagship model at its real 256x256 geometry (6 VALID conv/pool
+    # stages collapse smaller inputs to nothing), tiny batch.
+    c, b = 2, 2
+    model = MedCNN()
+    ps = _stacked(model, (256, 256, 3), c)
+    x = jax.random.uniform(jax.random.key(2), (c, b, 256, 256, 3))
+    ref = jax.vmap(lambda p, xx: model.apply({"params": p}, xx))(ps, x)
+    got = unfold_clients(
+        jax.jit(
+            lambda ps, xf: model.folded_apply(ps, xf, num_clients=c)
+        )(ps, fold_clients(x)),
+        c,
+    )
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=5e-3)
+
+
+@pytest.mark.parametrize("strides,padding", [((1, 1), "VALID"), ((2, 2), "SAME")])
+def test_folded_conv_matches_flax_forward_and_grad(strides, padding):
+    import flax.linen as nn
+
+    c, b, h, w, ch, f = 3, 4, 16, 16, 8, 16
+    kern = jax.random.normal(jax.random.key(1), (c, 3, 3, ch, f)) * 0.1
+    x = jax.random.uniform(jax.random.key(0), (c, b, h, w, ch))
+
+    class Cv(nn.Module):
+        @nn.compact
+        def __call__(self, t):
+            return nn.Conv(
+                f, (3, 3), strides=strides, padding=padding, use_bias=False,
+                dtype=jnp.bfloat16, param_dtype=jnp.float32,
+            )(t)
+
+    m = Cv()
+    ref_fwd = lambda k: jax.vmap(  # noqa: E731
+        lambda kk, xx: m.apply({"params": {"Conv_0": {"kernel": kk}}}, xx)
+    )(k, x).astype(jnp.float32)
+    fold_fwd = lambda k: unfold_clients(  # noqa: E731
+        folded_conv(
+            fold_clients(x), k, None, num_clients=c,
+            strides=strides, padding=padding,
+        ), c
+    ).astype(jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(ref_fwd(kern)), np.asarray(fold_fwd(kern)), atol=1e-2
+    )
+    ga = jax.grad(lambda k: jnp.sum(ref_fwd(k)))(kern)
+    gb = jax.grad(lambda k: jnp.sum(fold_fwd(k)))(kern)
+    scale = float(jnp.max(jnp.abs(ga))) + 1e-9
+    np.testing.assert_allclose(
+        np.asarray(ga) / scale, np.asarray(gb) / scale, atol=1e-3
+    )
+
+
+def test_folded_conv_clients_are_independent():
+    # Block structure: perturbing client 1's folded rows must leave client
+    # 0's outputs BITWISE untouched (what the masked round engine's
+    # same-program independence rests on).
+    c, b = 3, 4
+    kern = jax.random.normal(jax.random.key(1), (c, 3, 3, 2, 8)) * 0.1
+    x = jax.random.uniform(jax.random.key(0), (c, b, 12, 12, 2))
+    f = jax.jit(
+        lambda xf: folded_conv(xf, kern, None, num_clients=c)
+    )
+    base = np.asarray(f(fold_clients(x)).astype(jnp.float32))
+    x2 = x.at[1].multiply(3.0)
+    pert = np.asarray(f(fold_clients(x2)).astype(jnp.float32))
+    np.testing.assert_array_equal(base[:b], pert[:b])
+    np.testing.assert_array_equal(base[2 * b :], pert[2 * b :])
+    assert not np.array_equal(base[b : 2 * b], pert[b : 2 * b])
+
+
+# ----------------------------------------------------- backend resolution
+
+
+def test_resolve_fusion_backend_pins_and_errors(monkeypatch):
+    from hefl_tpu.fl import fusion
+
+    model = SmallCNN(num_classes=10)
+    assert fusion.resolve_fusion_backend("vmap", model) == "vmap"
+    assert fusion.resolve_fusion_backend("fused", model) == "fused"
+    with pytest.raises(ValueError):
+        fusion.resolve_fusion_backend("fancy", model)
+
+    class NoFold:
+        pass
+
+    # explicit fused pin on an unsupported model fails loudly; auto falls
+    # back to the vmap reference
+    with pytest.raises(ValueError):
+        fusion.resolve_fusion_backend("fused", NoFold())
+    monkeypatch.delenv("HEFL_CLIENT_FUSION", raising=False)
+    assert fusion.resolve_fusion_backend("auto", NoFold()) == "vmap"
+    # env pin consulted only in auto mode
+    monkeypatch.setenv("HEFL_CLIENT_FUSION", "vmap")
+    assert fusion.resolve_fusion_backend("auto", model) == "vmap"
+    assert fusion.resolve_fusion_backend("fused", model) == "fused"
+
+
+def test_fusion_autoselect_times_and_caches(monkeypatch):
+    from hefl_tpu.fl import fusion
+
+    monkeypatch.delenv("HEFL_CLIENT_FUSION", raising=False)
+    monkeypatch.setattr(fusion, "_AUTO_CHOICE", {})
+    monkeypatch.setattr(fusion, "_AUTO_TIMINGS_MS", None)
+    monkeypatch.setattr(fusion, "_PROBE_CLIENTS", 2)
+    monkeypatch.setattr(fusion, "_PROBE_BATCH", 2)
+    monkeypatch.setattr(fusion, "_PROBE_HW", 12)
+    chosen = fusion.resolve_fusion_backend("auto", SmallCNN(num_classes=10))
+    assert chosen in fusion.FUSION_BACKENDS
+    assert set(fusion._AUTO_TIMINGS_MS) == set(fusion.FUSION_BACKENDS)
+    rep = fusion.fusion_report()
+    assert rep["backend"] == chosen and rep["auto_timings_ms"]
+
+
+def test_autoselect_winner_persists_per_device_kind(monkeypatch, tmp_path):
+    # The satellite contract: auto winners live next to the XLA compile
+    # cache, so a fresh process (simulated by clearing the in-process
+    # caches) skips the micro-timing entirely.
+    import hefl_tpu.data.augment as aug
+    from hefl_tpu.fl import fusion
+    from hefl_tpu.utils import autoselect
+
+    monkeypatch.setenv("HEFL_AUTOSELECT_CACHE", "1")
+    prev_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+    try:
+        _check_persistence(monkeypatch, tmp_path)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+
+
+def _check_persistence(monkeypatch, tmp_path):
+    import hefl_tpu.data.augment as aug
+    from hefl_tpu.fl import fusion
+    from hefl_tpu.utils import autoselect
+
+    # fusion winner: probe once, persist, reload without probing
+    monkeypatch.delenv("HEFL_CLIENT_FUSION", raising=False)
+    monkeypatch.setattr(fusion, "_AUTO_CHOICE", {})
+    monkeypatch.setattr(fusion, "_AUTO_TIMINGS_MS", None)
+    monkeypatch.setattr(fusion, "_AUTO_PERSISTED", False)
+    monkeypatch.setattr(fusion, "_PROBE_CLIENTS", 2)
+    monkeypatch.setattr(fusion, "_PROBE_BATCH", 2)
+    monkeypatch.setattr(fusion, "_PROBE_HW", 12)
+    first = fusion.resolve_fusion_backend("auto", SmallCNN(num_classes=10))
+    assert (tmp_path / "hefl_autoselect.json").exists()
+    monkeypatch.setattr(fusion, "_AUTO_CHOICE", {})  # "new process"
+    probed = []
+    monkeypatch.setattr(
+        fusion, "_time_backend",
+        lambda *a: probed.append(1) or 0.0,
+    )
+    second = fusion.resolve_fusion_backend("auto", SmallCNN(num_classes=10))
+    assert second == first and not probed
+    assert fusion.fusion_report()["auto_persisted"] is True
+    # augment winner: same file, different decision key
+    monkeypatch.setattr(aug, "_AUTO_CHOICE", None)
+    monkeypatch.setattr(aug, "_AUTO_TIMINGS_MS", None)
+    monkeypatch.setattr(aug, "_AUTO_PERSISTED", False)
+    monkeypatch.setattr(aug, "_ENV_BACKEND", "auto")
+    monkeypatch.setattr(aug, "_PROBE_SHAPE", (2, 16, 16, 1))
+    win = aug.resolve_shift_backend(None)
+    kind = str(getattr(jax.devices()[0], "device_kind", "unknown"))
+    assert autoselect.load_winner("augment_shift", kind)["winner"] == win
+    monkeypatch.setattr(aug, "_AUTO_CHOICE", None)
+    aug_probed = []
+    monkeypatch.setattr(
+        aug, "_time_backend", lambda *a: aug_probed.append(1) or 0.0
+    )
+    assert aug.resolve_shift_backend(None) == win and not aug_probed
+    assert aug.backend_report()["auto_persisted"] is True
+
+
+def test_autoselect_cache_disabled_by_env(monkeypatch, tmp_path):
+    from hefl_tpu.utils import autoselect
+
+    monkeypatch.setenv("HEFL_AUTOSELECT_CACHE", "0")
+    prev_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+    try:
+        autoselect.store_winner("augment_shift", "cpu", "gather", {})
+        assert not (tmp_path / "hefl_autoselect.json").exists()
+        assert autoselect.load_winner("augment_shift", "cpu") is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+
+
+# ------------------------------------------------------------- prefetcher
+
+
+def test_round_prefetcher_identity_short_circuit():
+    from hefl_tpu.data import RoundPrefetcher
+
+    xs = np.arange(24, dtype=np.uint8).reshape(2, 12)
+    ys = np.arange(2, dtype=np.int32)
+    pf = RoundPrefetcher()
+    a = pf.get(xs, ys)
+    np.testing.assert_array_equal(np.asarray(a[0]), xs)
+    # same host arrays -> the SAME resident device buffers, no new copy
+    b = pf.get(xs, ys)
+    assert a[0] is b[0] and a[1] is b[1]
+    pf.prefetch(xs, ys)  # no-op: already resident
+    assert pf.get(xs, ys)[0] is a[0]
+
+
+def test_round_prefetcher_stages_and_retires():
+    from hefl_tpu.data import RoundPrefetcher
+
+    pf = RoundPrefetcher()
+    r0 = (np.zeros((2, 4), np.float32), np.zeros(2, np.int32))
+    r1 = (np.ones((2, 4), np.float32), np.ones(2, np.int32))
+    cur = pf.get(*r0)
+    pf.prefetch(*r1)                    # async copy overlaps "round 0"
+    staged = pf._next[0][0]
+    nxt = pf.get(*r1)                   # promote the staged buffers
+    assert nxt[0] is staged
+    np.testing.assert_array_equal(np.asarray(nxt[0]), r1[0])
+    # round 0's buffers were retired (deleted) on promotion
+    assert cur[0].is_deleted()
+
+
+def test_round_prefetcher_never_deletes_caller_arrays():
+    # A caller-owned DEVICE-resident array passed straight through must
+    # survive the ring's retirement (the ring only deletes buffers it
+    # copied itself).
+    from hefl_tpu.data import RoundPrefetcher
+
+    pf = RoundPrefetcher()
+    dev0 = jnp.arange(8, dtype=jnp.float32)   # already device-resident
+    got = pf.get(dev0)
+    r1 = (np.ones(8, np.float32),)
+    pf.get(*r1)                               # retires round 0's entry
+    assert not dev0.is_deleted()
+    np.testing.assert_array_equal(np.asarray(dev0), np.asarray(got[0]))
+
+
+# -------------------------------------------------- hoisted padding gather
+
+
+def test_prepadded_round_matches_per_round_gather():
+    from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+    from hefl_tpu.fl import TrainConfig, fedavg_round
+    from hefl_tpu.fl.fedavg import pad_federated
+    from hefl_tpu.parallel import client_mesh_size, make_mesh
+
+    num_clients = 3  # does not divide the 4-device mesh -> 1 padding slot
+    (x, y), _, _ = make_dataset("mnist", seed=0, n_train=48, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(48, num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    cfg = TrainConfig(
+        epochs=1, batch_size=8, num_classes=10, augment=False,
+        val_fraction=0.25,
+    )
+    mesh = make_mesh(4)
+    key = jax.random.key(9)
+    p_legacy, m_legacy, meta_legacy = fedavg_round(
+        model, cfg, mesh, params, jnp.asarray(xs), jnp.asarray(ys), key
+    )
+    xs_p, ys_p, num_real = pad_federated(xs, ys, client_mesh_size(mesh))
+    assert num_real == num_clients
+    p_pre, m_pre, meta_pre = fedavg_round(
+        model, cfg, mesh, params, jnp.asarray(xs_p), jnp.asarray(ys_p), key,
+        num_real_clients=num_real,
+    )
+    # identical program, identical inputs -> bitwise identical round
+    for a, b in zip(jax.tree_util.tree_leaves(p_legacy),
+                    jax.tree_util.tree_leaves(p_pre)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(m_legacy), np.asarray(m_pre))
+    assert meta_pre.num_clients == num_clients
+    assert meta_pre.surviving == meta_legacy.surviving == num_clients
+    # wrong-shape contract violation fails loudly
+    with pytest.raises(ValueError, match="pre-padded"):
+        fedavg_round(
+            model, cfg, mesh, params, jnp.asarray(xs), jnp.asarray(ys), key,
+            num_real_clients=num_clients,
+        )
+
+
+def test_train_clients_prepadded_matches_gather():
+    from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+    from hefl_tpu.fl import TrainConfig, train_clients
+    from hefl_tpu.fl.fedavg import pad_federated
+    from hefl_tpu.parallel import client_mesh_size, make_mesh
+
+    num_clients = 3
+    (x, y), _, _ = make_dataset("mnist", seed=1, n_train=48, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(48, num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    cfg = TrainConfig(
+        epochs=1, batch_size=8, num_classes=10, augment=False,
+        val_fraction=0.25,
+    )
+    mesh = make_mesh(4)
+    key = jax.random.key(3)
+    p_a, m_a = train_clients(
+        model, cfg, mesh, params, jnp.asarray(xs), jnp.asarray(ys), key
+    )
+    xs_p, ys_p, num_real = pad_federated(xs, ys, client_mesh_size(mesh))
+    p_b, m_b = train_clients(
+        model, cfg, mesh, params, jnp.asarray(xs_p), jnp.asarray(ys_p), key,
+        num_real_clients=num_real,
+    )
+    for a, b in zip(jax.tree_util.tree_leaves(p_a),
+                    jax.tree_util.tree_leaves(p_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(m_a), np.asarray(m_b))

@@ -231,6 +231,100 @@ def test_accum_steps_clamps_on_tiny_clients():
     assert sp.steps >= 1 and sp.grp <= sp.n_tr
 
 
+# ------------------------------------------------- cross-client fusion parity
+
+
+def _block_fixture(num_clients=4, per_client=40, seed=3):
+    (x, y), _, _ = make_dataset(
+        "mnist", seed=seed, n_train=num_clients * per_client, n_test=16
+    )
+    from hefl_tpu.data import iid_contiguous, stack_federated
+
+    xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    keys = jax.random.split(jax.random.key(7), num_clients)
+    return model, params, jnp.asarray(xs), jnp.asarray(ys), keys
+
+
+# Patience tight enough that the fixture exercises plateau + early stop, the
+# per-client semantics the fused GEMM-stream backend must preserve.
+_FUSE_CFG = TrainConfig(
+    epochs=4, batch_size=8, num_classes=10, augment=True,
+    aug_backend="gather", val_fraction=0.25, es_patience=2,
+    plateau_patience=1,
+)
+
+
+def test_fused_train_matches_vmap_reference():
+    # The ISSUE-3 golden equivalence: the fused backend (client axis folded
+    # into every conv/dense GEMM, fl.fusion) against the vmap reference —
+    # identical RNG streams, identical callback DECISIONS (lr ladder,
+    # stopped flags), float-tolerance weights/metrics (two XLA programs of
+    # the same math), per-client early stopping included.
+    from hefl_tpu.fl.fedavg import vmapped_train
+    from hefl_tpu.fl.fusion import fused_train
+
+    model, params, xs, ys, keys = _block_fixture()
+    pv, mv = jax.jit(
+        lambda p: vmapped_train(model, _FUSE_CFG, p, xs, ys, keys)
+    )(params)
+    pf, mf = jax.jit(
+        lambda p: fused_train(model, _FUSE_CFG, p, xs, ys, keys)
+    )(params)
+    mv, mf = np.asarray(mv), np.asarray(mf)
+    assert bool(mv[:, :, 3].any()), "fixture must exercise early stopping"
+    np.testing.assert_array_equal(mv[:, :, 2], mf[:, :, 2])  # lr ladder
+    np.testing.assert_array_equal(mv[:, :, 3], mf[:, :, 3])  # stopped
+    np.testing.assert_allclose(mv[:, :, :2], mf[:, :, :2], atol=2e-2)
+    for a, b in zip(jax.tree_util.tree_leaves(pv),
+                    jax.tree_util.tree_leaves(pf)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-2)
+
+
+def test_fused_accum_steps_matches_vmap():
+    # accum_steps>1 changes the fused-batch geometry (grp = bs*accum); the
+    # fused backend must keep the identical geometry AND the identical
+    # accum==larger-batch math the vmap path has.
+    from hefl_tpu.fl.fedavg import vmapped_train
+    from hefl_tpu.fl.fusion import fused_train
+
+    model, params, xs, ys, keys = _block_fixture()
+    cfg = dataclasses.replace(
+        _FUSE_CFG, epochs=3, augment=False, batch_size=4, accum_steps=2
+    )
+    pv, mv = jax.jit(lambda p: vmapped_train(model, cfg, p, xs, ys, keys))(params)
+    pf, mf = jax.jit(lambda p: fused_train(model, cfg, p, xs, ys, keys))(params)
+    np.testing.assert_allclose(np.asarray(mv), np.asarray(mf), atol=2e-2)
+    for a, b in zip(jax.tree_util.tree_leaves(pv),
+                    jax.tree_util.tree_leaves(pf)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-2)
+
+
+def test_fused_train_flops_match_vmap():
+    # Acceptance: same math, fewer dispatches — cost_analysis() of the
+    # fused program stays within counting noise of the vmap reference (the
+    # kernel-offset decomposition counts its f32 partial-sum adds, ~7%; a
+    # recompute blowup would be 2-3x).
+    from hefl_tpu.fl.fedavg import vmapped_train
+    from hefl_tpu.fl.fusion import fused_train
+
+    model, params, xs, ys, keys = _block_fixture()
+    cfg = dataclasses.replace(_FUSE_CFG, epochs=2, augment=False)
+    fv = roofline.program_flops(
+        lambda p: vmapped_train(model, cfg, p, xs, ys, keys), params
+    )
+    ff = roofline.program_flops(
+        lambda p: fused_train(model, cfg, p, xs, ys, keys), params
+    )
+    if fv is None or ff is None:
+        pytest.skip("backend offers no cost_analysis")
+    ratio = ff / fv
+    assert 0.9 < ratio < 1.15, (
+        f"fused program FLOPs {ff:.3g} vs vmap {fv:.3g} (ratio {ratio:.3f})"
+    )
+
+
 # ----------------------------------------------------------- FLOP regression
 
 
