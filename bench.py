@@ -381,6 +381,38 @@ def main() -> None:
             + (f" | ENCODE OVERFLOW: {cell6_overflow}" if cell6_overflow else "")
         )
 
+    # Standalone HE phase timings (warm, min-over-reps): the numerators for
+    # the int-op/bandwidth he_roofline rows — encrypt is 1 client, aggregate
+    # a 2-stack, decrypt the core (no decode). Cheap relative to a round;
+    # runs on every config so no artifact ships null HE rows (ISSUE 4).
+    from hefl_tpu.ckks import ops as ckks_ops
+    from hefl_tpu.ckks.backend import he_backend_report
+    from hefl_tpu.fl.secure import aggregate_encrypted, encrypt_params
+
+    enc_one = jax.jit(lambda prm, k: encrypt_params(ctx, pk, prm, k))
+    ct_he = enc_one(cur, flagship_keygen_key())
+    t_he_encrypt = roofline.steady_seconds(
+        lambda: enc_one(cur, flagship_keygen_key()).c0
+    )
+    agg2 = jax.jit(lambda c0, c1: aggregate_encrypted(
+        ctx, type(ct_he)(c0=jnp.stack([c0, c0]), c1=jnp.stack([c1, c1]),
+                         scale=ct_he.scale)).c0)
+    t_he_aggregate = roofline.steady_seconds(agg2, ct_he.c0, ct_he.c1)
+    dec_core = jax.jit(lambda c0, c1: ckks_ops.decrypt(
+        ctx, sk, type(ct_he)(c0=c0, c1=c1, scale=ct_he.scale)))
+    t_he_decrypt = roofline.steady_seconds(dec_core, ct_he.c0, ct_he.c1)
+    he_rows = roofline.he_roofline(
+        {"encrypt": t_he_encrypt, "aggregate": t_he_aggregate,
+         "decrypt": t_he_decrypt},
+        n=ctx.n, num_limbs=ctx.num_primes, n_ct=pack.n_ct,
+        num_clients=num_clients, encrypt_clients=1, device=dev,
+    )
+    log(
+        f"HE phases: encrypt {t_he_encrypt:.3f}s | aggregate "
+        f"{t_he_aggregate:.3f}s | decrypt-core {t_he_decrypt:.3f}s | "
+        f"backend {he_backend_report()['backend']}"
+    )
+
     cold = round_stats[0]
     warm = round_stats[1:]
     warm_round_s = float(np.mean([s["total"] for s in warm])) if warm else None
@@ -396,17 +428,36 @@ def main() -> None:
     # to the cold round when only one round ran, labeled by steady=null
     # above). The train numerator is TRAIN math only — the fused program
     # also encrypts+aggregates, so its MFU is a lower bound.
+    # decrypt/evaluate rows no longer ship flops/mfu nulls (ISSUE 4): the
+    # decrypt row carries the HE int-op model (op_kind marks the unit;
+    # utilization is vs the ESTIMATED VPU int peak), evaluate its real
+    # forward FLOPs from cost analysis.
+    # seconds stays the round's full decrypt_average step; flops/mfu are
+    # the CORE int-op model over the CORE time (same numerator AND
+    # denominator as the he_roofline decrypt row, so the two records agree
+    # by construction), with core_seconds carrying the denominator.
+    decrypt_s_row = steady_decrypt_s if warm else cold["decrypt"]
+    decrypt_phase = roofline.phase_stats(decrypt_s_row, device=dev)
+    decrypt_phase.update(
+        flops=he_rows["decrypt"]["int_ops"],
+        mfu=he_rows["decrypt"]["util_vs_peak_int_ops"],
+        core_seconds=round(t_he_decrypt, 4),
+        op_kind="int32",
+        peak_is_estimate=True,
+    )
+    eval_flops = roofline.program_flops(
+        lambda p, xb: module.apply({"params": p}, xb), cur,
+        jnp.zeros((len(xt), *x.shape[1:]), jnp.float32),
+    )
     phase_roofline = {
         "train+encrypt+aggregate": roofline.phase_stats(
             steady_train_s if warm else cold["train"],
             flops=train_flops, device=dev, images=train_images_per_round,
         ),
-        "decrypt": roofline.phase_stats(
-            steady_decrypt_s if warm else cold["decrypt"], device=dev
-        ),
+        "decrypt": decrypt_phase,
         "evaluate": roofline.phase_stats(
-            steady_eval_s if warm else cold["evaluate"], device=dev,
-            images=len(xt),
+            steady_eval_s if warm else cold["evaluate"], flops=eval_flops,
+            device=dev, images=len(xt),
         ),
     }
     mfu = roofline.mfu(train_flops, steady_train_s, dev)
@@ -461,6 +512,10 @@ def main() -> None:
                     fusion_seconds, flops=train_flops, device=dev,
                     images=train_images_per_round,
                 ),
+                # HE backend (fused Pallas vs XLA reference) + int-op /
+                # bandwidth roofline rows for every HE phase (ISSUE 4).
+                "he_backend": he_backend_report(),
+                "he_roofline": he_rows,
                 "device": getattr(dev, "device_kind", str(dev)),
                 "seed": seed,
                 # `accuracy` pairs with `value`: both are the round-0

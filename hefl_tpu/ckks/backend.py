@@ -1,0 +1,132 @@
+"""HE backend selection: fused Pallas kernels vs the XLA graph reference.
+
+Mirrors the augment / client-fusion selection machinery (data.augment,
+fl.fusion): an env pin (`HEFL_HE=xla|pallas|auto`), a one-shot micro-timing
+in "auto" mode on TPU, and per-device-kind persistence next to the XLA
+compile cache (utils.autoselect) so short-lived CLI runs skip the probe.
+`he_backend_report()` exposes the resolved choice for bench/profile
+artifacts — recorded alongside `augment_backend` / `client_fusion`.
+
+The XLA path is the bit-exact semantics reference; the fused Pallas path
+(`pallas_ntt.encrypt_fused_pallas` / `decrypt_fused_pallas`) produces
+identical canonical residues (parity-tested interpreted on CPU, and on
+hardware by `bench_ntt.py`'s stage-1 gate), so selection is purely a speed
+decision:
+
+  * off-TPU, "auto" resolves to "xla" without probing — interpreted Pallas
+    is a test vehicle, never a fast path;
+  * on TPU, "auto" micro-times one fused encrypt per backend at the
+    flagship row shape and persists the winner per device kind;
+  * rings too small for the (>=8, 128) tile always take the XLA path,
+    whatever the pin (the kernels cannot tile them).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+HE_BACKENDS = ("xla", "pallas")
+
+_ENV = os.environ.get("HEFL_HE", "auto")
+
+# One-shot auto-selection state (process-global, same shape as
+# data.augment's): winner per device kind + what the last resolution
+# actually returned, so he_backend_report() describes traced programs.
+_AUTO_CHOICE: dict[str, str] = {}
+_AUTO_TIMINGS_MS: dict[str, float] | None = None
+_AUTO_PERSISTED: bool = False
+_LAST_RESOLVED: str | None = None
+
+
+def _probe_shapes(ctx) -> tuple:
+    """Flagship-row probe batch: enough rows to amortize dispatch."""
+    return (8, ctx.num_primes, ctx.n)
+
+
+def _autoselect(ctx) -> str:
+    """Micro-time one fused encrypt per backend on the live TPU; persist."""
+    global _AUTO_TIMINGS_MS, _AUTO_PERSISTED
+    kind = str(getattr(jax.devices()[0], "device_kind", "unknown"))
+    if kind in _AUTO_CHOICE:
+        return _AUTO_CHOICE[kind]
+    from hefl_tpu.utils.autoselect import load_winner, store_winner
+
+    hit = load_winner("he_backend", kind)
+    if hit is not None and hit["winner"] in HE_BACKENDS:
+        _AUTO_CHOICE[kind] = hit["winner"]
+        _AUTO_TIMINGS_MS = hit.get("timings_ms")
+        _AUTO_PERSISTED = True
+        return hit["winner"]
+    from hefl_tpu.ckks import ops, pallas_ntt
+    from hefl_tpu.utils.roofline import steady_seconds
+
+    with jax.ensure_compile_time_eval():
+        # Probe inputs built inside the eval context (concrete even when an
+        # outer jit is tracing — see augment._autoselect_backend).
+        b, num_l, n = _probe_shapes(ctx)
+        rng = np.random.default_rng(0)
+        p_col = np.asarray(ctx.ntt.p)[:, 0][None, :, None]
+        mk = lambda: jnp.asarray(  # noqa: E731
+            (rng.integers(0, 2**31, size=(b, num_l, n), dtype=np.int64) % p_col)
+            .astype(np.uint32)
+        )
+        m, u, e0, e1 = mk(), mk(), mk(), mk()
+        bk = mk()[0]
+        ak = mk()[0]
+        # BOTH candidates jitted: production encrypt runs inside jitted
+        # round programs, so an eager per-primitive XLA op chain would time
+        # dispatch overhead (~100 dispatches for the 4 stage-unrolled NTTs)
+        # against the kernel's single dispatch and bias the probe.
+        cands = {
+            "xla": jax.jit(lambda mm: ops._encrypt_core_xla(
+                ctx, mm, u, e0, e1, bk, ak)[0]),
+            "pallas": jax.jit(lambda mm: pallas_ntt.encrypt_fused_pallas(
+                ctx.ntt, mm, u, e0, e1, bk, ak)[0]),
+        }
+        timings = {name: steady_seconds(fn, m) for name, fn in cands.items()}
+    _AUTO_TIMINGS_MS = {k: round(v * 1e3, 3) for k, v in timings.items()}
+    winner = min(timings, key=timings.get)
+    _AUTO_CHOICE[kind] = winner
+    store_winner("he_backend", kind, winner, _AUTO_TIMINGS_MS)
+    return winner
+
+
+def resolve_he_backend(ctx, override: str | None = None) -> str:
+    """The backend encrypt/decrypt will actually run for this context.
+
+    Priority: explicit `override` > HEFL_HE env > "auto". Small rings (the
+    CPU test rings) always resolve to "xla" — the kernels cannot tile them.
+    """
+    global _LAST_RESOLVED
+    from hefl_tpu.ckks import pallas_ntt
+    from hefl_tpu.ckks.ntt import on_tpu_backend
+
+    requested = override or _ENV or "auto"
+    if requested not in HE_BACKENDS + ("auto",):
+        raise ValueError(
+            f"HE backend {requested!r}: expected one of {HE_BACKENDS + ('auto',)}"
+        )
+    if not pallas_ntt.supported(ctx.ntt):
+        backend = "xla"
+    elif requested == "auto":
+        backend = _autoselect(ctx) if on_tpu_backend() else "xla"
+    else:
+        backend = requested
+    _LAST_RESOLVED = backend
+    return backend
+
+
+def he_backend_report() -> dict:
+    """What the HE layer is running — for bench/profile artifacts."""
+    env = _ENV or "auto"
+    resolved = _LAST_RESOLVED or (env if env in HE_BACKENDS else None)
+    return {
+        "requested": env,
+        "backend": resolved,
+        "auto_timings_ms": _AUTO_TIMINGS_MS,
+        "auto_persisted": _AUTO_PERSISTED,
+    }

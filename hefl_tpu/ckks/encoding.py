@@ -72,10 +72,14 @@ def encode(ctx: NTTContext, values: jnp.ndarray, scale: float) -> jnp.ndarray:
     lo = jnp.clip(jnp.round(r * _SPLIT), -_SPLIT, _SPLIT).astype(jnp.int32)
     hi = hi_f.astype(jnp.int32)
     p = jnp.asarray(ctx.p)                    # uint32[L, 1]
-    p_i32 = p.astype(jnp.int32)
-    # numpy-style remainder: sign follows divisor, so residues are canonical.
-    hi_res = jnp.remainder(hi[..., None, :], p_i32).astype(jnp.uint32)
-    lo_res = jnp.remainder(lo[..., None, :], p_i32).astype(jnp.uint32)
+    # numpy-remainder semantics (sign follows divisor -> canonical residues)
+    # via shift-multiply Barrett: bitwise-identical to `jnp.remainder` but
+    # with no hardware divide per element (ISSUE 4). |lo| <= 2**15 < p needs
+    # only the conditional add; |hi| can reach 2**31 and takes the full
+    # signed Barrett.
+    hi_res = modular.barrett_mod_signed(hi[..., None, :], p)
+    lo_l = lo[..., None, :]
+    lo_res = jnp.where(lo_l < 0, lo_l + p.astype(jnp.int32), lo_l).astype(jnp.uint32)
     shift_mont = jnp.asarray(
         [[host_to_mont(1 << _SPLIT_BITS, int(pi))] for pi in np.asarray(ctx.p)[:, 0]],
         dtype=jnp.uint32,
@@ -115,8 +119,11 @@ def _mixed_radix_digits(ctx: NTTContext, residues: jnp.ndarray):
         run = 1
         for j, d in enumerate(digits):
             coeff_mont = jnp.uint32(host_to_mont(run, pi))
-            # d_j is a centered int32; numpy-style remainder re-canonicalizes.
-            d_res = jnp.remainder(d, jnp.int32(pi)).astype(jnp.uint32)
+            # d_j is a centered int32 with |d_j| <= p_j/2 < p_i... not quite:
+            # |d_j| <= p_j/2 where p_j can exceed p_i, so one conditional add
+            # may leave a residue of p_i..p_j/2. Use the signed Barrett —
+            # still division-free, exact for the full int32 range.
+            d_res = modular.barrett_mod_signed(d, jnp.uint32(pi))
             term = modular.mont_mul(d_res, coeff_mont, pi_u, pinv_i)
             acc = modular.sub_mod(acc, term, pi_u)
             run *= int(p[j])

@@ -175,11 +175,23 @@ class GaloisKey:
     g: int = dataclasses.field(metadata=dict(static=True), kw_only=True)
 
 
+def _small_signed_residues(v: jnp.ndarray, ctx: CkksContext) -> jnp.ndarray:
+    """Residues [..., L, N] of small signed coefficients int32 |v| < p.
+
+    Division-free (ISSUE 4): for |v| < p the numpy-remainder is just a
+    conditional add of p, so the hot-path `jnp.remainder` (one hardware
+    divide per element per limb) collapses to a select — bitwise-identical
+    residues.
+    """
+    p = jnp.asarray(ctx.ntt.p).astype(jnp.int32)
+    lifted = v[..., None, :]
+    return jnp.where(lifted < 0, lifted + p, lifted).astype(jnp.uint32)
+
+
 def sample_ternary_residues(ctx: CkksContext, key: jax.Array, batch=()) -> jnp.ndarray:
     """Uniform ternary polynomial {-1,0,1}^N as canonical residues [..., L, N]."""
     coeffs = jax.random.randint(key, batch + (ctx.n,), -1, 2, dtype=jnp.int32)
-    p = jnp.asarray(ctx.ntt.p).astype(jnp.int32)
-    return jnp.remainder(coeffs[..., None, :], p).astype(jnp.uint32)
+    return _small_signed_residues(coeffs, ctx)
 
 
 def sample_gaussian_residues(ctx: CkksContext, key: jax.Array, batch=()) -> jnp.ndarray:
@@ -188,8 +200,7 @@ def sample_gaussian_residues(ctx: CkksContext, key: jax.Array, batch=()) -> jnp.
         jax.random.normal(key, batch + (ctx.n,), dtype=jnp.float32) * ctx.sigma
     )
     e = jnp.clip(e, -6.0 * ctx.sigma, 6.0 * ctx.sigma).astype(jnp.int32)
-    p = jnp.asarray(ctx.ntt.p).astype(jnp.int32)
-    return jnp.remainder(e[..., None, :], p).astype(jnp.uint32)
+    return _small_signed_residues(e, ctx)
 
 
 def sample_uniform_eval(ctx: CkksContext, key: jax.Array, batch=()) -> jnp.ndarray:

@@ -85,15 +85,73 @@ def neg_mod(a: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(a == 0, a, p - a)
 
 
+def barrett_mu(p: jnp.ndarray) -> jnp.ndarray:
+    """floor(2**32 / p) as uint32 — the shift-multiply Barrett constant.
+
+    For odd p (every RNS prime) floor(2**32/p) == floor((2**32-1)/p), so the
+    constant is computable in uint32. The one divide here runs on the [L, 1]
+    constant table and XLA constant-folds it; the per-element reduction below
+    is divide-free.
+    """
+    return jnp.uint32(0xFFFFFFFF) // p
+
+
+def barrett_mod(x: jnp.ndarray, p: jnp.ndarray, mu: jnp.ndarray | None = None) -> jnp.ndarray:
+    """x mod p for ANY uint32 x, division-free (shift-multiply Barrett).
+
+    q = hi32(x * mu) with mu = floor(2**32/p) satisfies
+    floor(x/p) - 1 <= q <= floor(x/p) (for x < 2**32 the dropped
+    x*(2**32 mod p)/(p*2**32) < 1), so r = x - q*p < 2p and one conditional
+    subtract restores canonical form. q*p <= x < 2**32 keeps every product in
+    the low word. Replaces `lax.rem`/`jnp.remainder` (a hardware divide per
+    element) on the hot aggregation paths.
+    """
+    if mu is None:
+        mu = barrett_mu(p)
+    x = x.astype(jnp.uint32)
+    q = mul32_wide(x, mu)[0]
+    r = x - q * p
+    return jnp.where(r >= p, r - p, r)
+
+
+def barrett_mod_signed(x: jnp.ndarray, p: jnp.ndarray, mu: jnp.ndarray | None = None) -> jnp.ndarray:
+    """numpy-remainder semantics (sign follows divisor) for int32 x, division-free.
+
+    Matches `jnp.remainder(x, p)` bitwise for |x| < 2**31: Barrett-reduce
+    |x| and reflect negative inputs (p - r, except when r == 0).
+    """
+    if mu is None:
+        mu = barrett_mu(p)
+    neg = x < 0
+    r = barrett_mod(jnp.abs(x).astype(jnp.uint32), p, mu)
+    return jnp.where(neg & (r != 0), p - r, r)
+
+
 def barrett_mod_small(x: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
     """x mod p for 0 <= x < 2**31 held in int32/uint32 (post-psum reduction).
 
-    Used once after the FedAvg `psum` of residues: with primes < 2**27 and up
-    to 16 clients the lane sum stays below 2**31, so a single remainder
-    restores canonical form. XLA lowers integer Rem natively; this is not in
-    the NTT hot loop.
+    Used after the FedAvg `psum` of residues: with primes < 2**27 and up
+    to 16 clients the lane sum stays below 2**31, so a single reduction
+    restores canonical form. Now routed through the shift-multiply
+    `barrett_mod` (bitwise-equal to the historical `jnp.remainder` across
+    the whole uint32 range) instead of a hardware divide per element.
     """
-    return jnp.remainder(x.astype(jnp.uint32), p)
+    return barrett_mod(x.astype(jnp.uint32), p)
+
+
+def shoup_mul(a: jnp.ndarray, w: jnp.ndarray, w_shoup: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """a * w mod p with the Harvey/Shoup precomputed quotient, canonical out.
+
+    `w_shoup` = floor(w * 2**32 / p) (host-precomputed, exact). Then
+    q = hi32(a * w_shoup) gives a*w - q*p in [0, 2p) for any a < 2**32 and
+    w < p, so the product needs ONE wide multiply (for the quotient) plus
+    two low-word multiplies — ~22 int ops vs ~40 for `mont_mul`. This is the
+    butterfly multiply of the NTT hot path; operands stay in the plain
+    domain (no Montgomery lift on either side).
+    """
+    q = mul32_wide(a, w_shoup)[0]
+    r = a * w - q * p                    # low 32 bits; true value < 2p
+    return jnp.where(r >= p, r - p, r)
 
 
 def to_signed_center(x: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:

@@ -58,6 +58,83 @@ class Ciphertext:
     scale: float = dataclasses.field(metadata=dict(static=True))
 
 
+def encrypt_samples(
+    ctx: CkksContext, key: jax.Array, batch: tuple = ()
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The (u, e0, e1) coefficient-domain randomness of one encrypt call.
+
+    Split out of `encrypt` so callers with a pre-stacked ciphertext batch
+    (fl.secure.encrypt_stack) can sample per client with the HISTORICAL key
+    derivation (bitwise-identical streams) and then run ONE fused core call
+    over the whole stack instead of a vmap of kernels.
+    """
+    k_u, k_e0, k_e1 = jax.random.split(key, 3)
+    return (
+        sample_ternary_residues(ctx, k_u, batch),
+        sample_gaussian_residues(ctx, k_e0, batch),
+        sample_gaussian_residues(ctx, k_e1, batch),
+    )
+
+
+def _encrypt_core_xla(
+    ctx: CkksContext,
+    m_res: jax.Array,
+    u: jax.Array,
+    e0: jax.Array,
+    e1: jax.Array,
+    b_mont: jax.Array,
+    a_mont: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """The deterministic encrypt core on the XLA graph path (the bit-exact
+    semantics reference the fused Pallas kernel is tested against).
+
+    The four forward transforms ride ONE stacked NTT call — identical math
+    and bitwise-identical residues to four separate calls, but a quarter of
+    the stage-graph ops for XLA to schedule."""
+    ntt = ctx.ntt
+    p = jnp.asarray(ntt.p)
+    pinv = jnp.asarray(ntt.pinv_neg)
+    u_eval, e0_eval, e1_eval, m_eval = ntt_forward(
+        ntt, jnp.stack([u, e0, e1, m_res])
+    )
+    c0 = modular.add_mod(
+        modular.add_mod(modular.mont_mul(u_eval, b_mont, p, pinv), e0_eval, p),
+        m_eval,
+        p,
+    )
+    c1 = modular.add_mod(modular.mont_mul(u_eval, a_mont, p, pinv), e1_eval, p)
+    return c0, c1
+
+
+def encrypt_core(
+    ctx: CkksContext,
+    pk: PublicKey,
+    m_res: jax.Array,
+    u: jax.Array,
+    e0: jax.Array,
+    e1: jax.Array,
+    backend: str | None = None,
+) -> Ciphertext:
+    """Deterministic encrypt of sampled randomness, backend-dispatched.
+
+    ct = (b*u + e0 + m, a*u + e1), all eval-domain. The fused Pallas
+    backend runs the whole thing (4 NTTs + pointwise key combination) as
+    one Mosaic dispatch per (prime, ciphertext) row; XLA is the reference.
+    Selection: `backend` override > HEFL_HE env > auto (ckks.backend).
+    """
+    from hefl_tpu.ckks.backend import resolve_he_backend
+
+    if resolve_he_backend(ctx, backend) == "pallas":
+        from hefl_tpu.ckks import pallas_ntt
+
+        c0, c1 = pallas_ntt.encrypt_fused_pallas(
+            ctx.ntt, m_res, u, e0, e1, pk.b_mont, pk.a_mont
+        )
+    else:
+        c0, c1 = _encrypt_core_xla(ctx, m_res, u, e0, e1, pk.b_mont, pk.a_mont)
+    return Ciphertext(c0=c0, c1=c1, scale=ctx.scale)
+
+
 @partial(jax.jit, static_argnums=0)
 def encrypt(
     ctx: CkksContext, pk: PublicKey, m_res: jax.Array, key: jax.Array
@@ -68,26 +145,23 @@ def encrypt(
     of `m_res` with independent (u, e0, e1) per ciphertext.
     """
     batch = m_res.shape[:-2]
-    k_u, k_e0, k_e1 = jax.random.split(key, 3)
-    ntt = ctx.ntt
-    p = jnp.asarray(ntt.p)
-    pinv = jnp.asarray(ntt.pinv_neg)
-    u_eval = ntt_forward(ntt, sample_ternary_residues(ctx, k_u, batch))
-    e0_eval = ntt_forward(ntt, sample_gaussian_residues(ctx, k_e0, batch))
-    e1_eval = ntt_forward(ntt, sample_gaussian_residues(ctx, k_e1, batch))
-    m_eval = ntt_forward(ntt, m_res)
-    c0 = modular.add_mod(
-        modular.add_mod(modular.mont_mul(u_eval, pk.b_mont, p, pinv), e0_eval, p),
-        m_eval,
-        p,
-    )
-    c1 = modular.add_mod(modular.mont_mul(u_eval, pk.a_mont, p, pinv), e1_eval, p)
-    return Ciphertext(c0=c0, c1=c1, scale=ctx.scale)
+    u, e0, e1 = encrypt_samples(ctx, key, batch)
+    return encrypt_core(ctx, pk, m_res, u, e0, e1)
 
 
 @partial(jax.jit, static_argnums=0)
 def decrypt(ctx: CkksContext, sk: SecretKey, ct: Ciphertext) -> jax.Array:
-    """-> coefficient-domain residues uint32[..., L, N] of m*scale + noise."""
+    """-> coefficient-domain residues uint32[..., L, N] of m*scale + noise.
+
+    Backend-dispatched like `encrypt_core`: the fused Pallas kernel runs
+    c0 + c1*s and the inverse NTT as one dispatch; XLA is the reference.
+    """
+    from hefl_tpu.ckks.backend import resolve_he_backend
+
+    if resolve_he_backend(ctx) == "pallas":
+        from hefl_tpu.ckks import pallas_ntt
+
+        return pallas_ntt.decrypt_fused_pallas(ctx.ntt, ct.c0, ct.c1, sk.s_mont)
     p = jnp.asarray(ctx.ntt.p)
     d_eval = modular.add_mod(
         ct.c0,

@@ -1,4 +1,4 @@
-"""Fused Pallas TPU kernels for the negacyclic NTT.
+"""Fused Pallas TPU kernels for the CKKS hot path: NTT, encrypt, decrypt.
 
 The XLA path in :mod:`hefl_tpu.ckks.ntt` expresses each butterfly stage as
 reshape/stack graph ops, which XLA may materialize between stages. Here the
@@ -6,6 +6,14 @@ whole log2(N)-stage transform runs inside ONE Pallas kernel: each grid step
 pulls a single (prime, polynomial) row of N uint32 residues into VMEM as an
 (N/128, 128) tile, runs every stage in-register with roll+select butterflies,
 and writes the finished row once — no HBM traffic between stages.
+
+Beyond the bare transforms, this module is the fused-HE kernel family the
+encrypted aggregation runs on (ISSUE 4): `encrypt_fused_pallas` runs the
+ENTIRE public-key encrypt per (prime, ciphertext) row — four forward NTTs
+(u, e0, e1, m) plus the pointwise pk·u + e + m combination — as one Mosaic
+dispatch, and `decrypt_fused_pallas` fuses c0 + c1·s with the inverse NTT
+the same way. The XLA graph path (`ops` module) stays the bit-exact
+semantics reference; both paths produce identical canonical residues.
 
 This replaces the role SEAL's hand-written C++ NTT plays for the reference
 (SURVEY.md §2.12): the hot polynomial transform as a native kernel, but
@@ -19,6 +27,12 @@ static mask `(i & t) == 0`. Twiddles are pre-broadcast per stage to
 full-length tables (uint32[L, logn, N]) so the kernel's stage loop is pure
 elementwise math. Wrapped (circular) reads land only at positions the
 select masks out, so the roll's wraparound is harmless.
+
+Butterfly multiplies use the Harvey/Shoup quotient trick (`modular.
+shoup_mul`, plain-domain twiddles + precomputed floor(w*2**32/p) tables
+from `ntt.shoup_tables`): one wide multiply per butterfly instead of the
+two a Montgomery REDC needs. Key polynomials (pk, sk) remain in Montgomery
+form — their pointwise multiplies keep `mont_mul`.
 
 Grid is (L, B) — primes outer, polynomials inner — so a prime's twiddle
 table block stays resident in VMEM across the whole polynomial batch.
@@ -35,8 +49,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from hefl_tpu.ckks.modular import add_mod, mont_mul, sub_mod
-from hefl_tpu.ckks.ntt import NTTContext
+from hefl_tpu.ckks.modular import add_mod, mont_mul, shoup_mul, sub_mod
+from hefl_tpu.ckks.ntt import NTTContext, shoup_tables
 
 LANES = 128
 
@@ -48,13 +62,20 @@ def supported(ctx: NTTContext) -> bool:
 
 @dataclasses.dataclass(frozen=True)
 class _Tables:
-    """Per-stage full-length twiddles + per-prime scalars, device-ready."""
+    """Per-stage full-length twiddles + per-prime scalars, device-ready.
 
-    tw_fwd: np.ndarray    # uint32[L, logn, S, 128]  (Montgomery form)
-    tw_inv: np.ndarray    # uint32[L, logn, S, 128]  (iteration order)
-    p: np.ndarray         # uint32[L, 1]
-    pinv_neg: np.ndarray  # uint32[L, 1]
-    n_inv: np.ndarray     # uint32[L, 1]  (Montgomery form)
+    Twiddles are plain-domain values paired with their Shoup quotient
+    constants (uint32[L, logn, S, 128] each); per-prime scalars ride SMEM.
+    """
+
+    tw_fwd: np.ndarray        # plain-domain forward twiddles
+    tw_fwd_shoup: np.ndarray
+    tw_inv: np.ndarray        # plain-domain inverse twiddles (iteration order)
+    tw_inv_shoup: np.ndarray
+    p: np.ndarray             # uint32[L, 1]
+    pinv_neg: np.ndarray      # uint32[L, 1]  (Montgomery REDC, key multiplies)
+    n_inv: np.ndarray         # uint32[L, 1]  plain domain
+    n_inv_shoup: np.ndarray   # uint32[L, 1]
 
 
 @functools.lru_cache(maxsize=8)
@@ -63,19 +84,30 @@ def _tables(ctx: NTTContext) -> _Tables:
     num_l = ctx.p.shape[0]
     s_rows = n // LANES
     i = np.arange(n)
+    sh = shoup_tables(ctx)
     fwd = np.empty((num_l, logn, n), np.uint32)
+    fwd_sh = np.empty((num_l, logn, n), np.uint32)
     inv = np.empty((num_l, logn, n), np.uint32)
+    inv_sh = np.empty((num_l, logn, n), np.uint32)
     for s in range(logn):
         # forward stage s: block m + i // (2t) with 2t = n >> s
-        fwd[:, s, :] = ctx.psi_rev[:, (1 << s) + (i >> (logn - s))]
+        idx = (1 << s) + (i >> (logn - s))
+        fwd[:, s, :] = sh.psi[:, idx]
+        fwd_sh[:, s, :] = sh.psi_shoup[:, idx]
     for k, s in enumerate(range(logn - 1, -1, -1)):
-        inv[:, k, :] = ctx.psi_inv_rev[:, (1 << s) + (i >> (logn - s))]
+        idx = (1 << s) + (i >> (logn - s))
+        inv[:, k, :] = sh.psi_inv[:, idx]
+        inv_sh[:, k, :] = sh.psi_inv_shoup[:, idx]
+    shape4 = (num_l, logn, s_rows, LANES)
     return _Tables(
-        tw_fwd=fwd.reshape(num_l, logn, s_rows, LANES),
-        tw_inv=inv.reshape(num_l, logn, s_rows, LANES),
+        tw_fwd=fwd.reshape(shape4),
+        tw_fwd_shoup=fwd_sh.reshape(shape4),
+        tw_inv=inv.reshape(shape4),
+        tw_inv_shoup=inv_sh.reshape(shape4),
         p=ctx.p.copy(),
         pinv_neg=ctx.pinv_neg.copy(),
-        n_inv=ctx.n_inv_mont.copy(),
+        n_inv=sh.n_inv.copy(),
+        n_inv_shoup=sh.n_inv_shoup.copy(),
     )
 
 
@@ -102,88 +134,165 @@ def _flat_index(shape) -> jnp.ndarray:
     return row * LANES + lane
 
 
-def _fwd_kernel(p_ref, pinv_ref, x_ref, tw_ref, o_ref, *, logn: int):
-    l = pl.program_id(0)
-    p = p_ref[l, 0]
-    pinv = pinv_ref[l, 0]
-    x = x_ref[0, 0]
+def _fwd_stages(x, twp_ref, tws_ref, p, logn: int):
+    """All forward butterfly stages on one (S, 128) row, in-register."""
     i_flat = _flat_index(x.shape)
     n = x.shape[0] * LANES
     for s in range(logn):
         t = n >> (s + 1)
-        tw = tw_ref[0, s]
+        tw = twp_ref[0, s]
+        tw_sh = tws_ref[0, s]
         is_lo = (i_flat & t) == 0
-        v = mont_mul(x, tw, p, pinv)                   # tw*hi, valid at hi slots
+        v = shoup_mul(x, tw, tw_sh, p)                 # tw*hi, valid at hi slots
         lo_out = add_mod(x, _read_ahead_flat(v, t), p)
         hi_out = sub_mod(_read_ahead_flat(x, -t), v, p)
         x = jnp.where(is_lo, lo_out, hi_out)
-    o_ref[0, 0] = x
+    return x
 
 
-def _inv_kernel(p_ref, pinv_ref, ninv_ref, x_ref, tw_ref, o_ref, *, logn: int):
-    l = pl.program_id(0)
-    p = p_ref[l, 0]
-    pinv = pinv_ref[l, 0]
-    x = x_ref[0, 0]
+def _inv_stages(x, twp_ref, tws_ref, p, logn: int):
+    """All inverse butterfly stages (excl. the final N^-1 scaling)."""
     i_flat = _flat_index(x.shape)
     n = x.shape[0] * LANES
     for k in range(logn):
         s = logn - 1 - k
         t = n >> (s + 1)
-        tw = tw_ref[0, k]
+        tw = twp_ref[0, k]
+        tw_sh = tws_ref[0, k]
         is_lo = (i_flat & t) == 0
         lo_out = add_mod(x, _read_ahead_flat(x, t), p)
         diff = sub_mod(_read_ahead_flat(x, -t), x, p)  # lo - hi, valid at hi
-        hi_out = mont_mul(diff, tw, p, pinv)
+        hi_out = shoup_mul(diff, tw, tw_sh, p)
         x = jnp.where(is_lo, lo_out, hi_out)
-    o_ref[0, 0] = mont_mul(x, ninv_ref[l, 0], p, pinv)
+    return x
 
 
-def _run(ctx: NTTContext, a: jnp.ndarray, inverse: bool, interpret: bool | None) -> jnp.ndarray:
+def _fwd_kernel(p_ref, x_ref, twp_ref, tws_ref, o_ref, *, logn: int):
+    l = pl.program_id(0)
+    o_ref[0, 0] = _fwd_stages(x_ref[0, 0], twp_ref, tws_ref, p_ref[l, 0], logn)
+
+
+def _inv_kernel(
+    p_ref, ninv_ref, ninvs_ref, x_ref, twp_ref, tws_ref, o_ref, *, logn: int
+):
+    l = pl.program_id(0)
+    p = p_ref[l, 0]
+    x = _inv_stages(x_ref[0, 0], twp_ref, tws_ref, p, logn)
+    o_ref[0, 0] = shoup_mul(x, ninv_ref[l, 0], ninvs_ref[l, 0], p)
+
+
+def _enc_kernel(
+    p_ref, pinv_ref, u_ref, e0_ref, e1_ref, m_ref, b_ref, a_ref,
+    twp_ref, tws_ref, c0_ref, c1_ref, *, logn: int,
+):
+    """One Mosaic dispatch per (prime, ciphertext) row: the whole encrypt.
+
+    Four forward NTTs (u, e0, e1, m) run back-to-back in VMEM, then the
+    pointwise RLWE combination against the Montgomery-form public key —
+    c0 = b·u + e0 + m, c1 = a·u + e1 — without any canonical-domain
+    round-trip through HBM between the stages.
+    """
+    l = pl.program_id(0)
+    p = p_ref[l, 0]
+    pinv = pinv_ref[l, 0]
+    u = _fwd_stages(u_ref[0, 0], twp_ref, tws_ref, p, logn)
+    e0 = _fwd_stages(e0_ref[0, 0], twp_ref, tws_ref, p, logn)
+    e1 = _fwd_stages(e1_ref[0, 0], twp_ref, tws_ref, p, logn)
+    m = _fwd_stages(m_ref[0, 0], twp_ref, tws_ref, p, logn)
+    b_key = b_ref[0]
+    a_key = a_ref[0]
+    c0_ref[0, 0] = add_mod(add_mod(mont_mul(u, b_key, p, pinv), e0, p), m, p)
+    c1_ref[0, 0] = add_mod(mont_mul(u, a_key, p, pinv), e1, p)
+
+
+def _dec_kernel(
+    p_ref, pinv_ref, ninv_ref, ninvs_ref, c0_ref, c1_ref, s_ref,
+    twp_ref, tws_ref, o_ref, *, logn: int,
+):
+    """Fused decrypt row: c0 + c1·s then the inverse NTT, one dispatch."""
+    l = pl.program_id(0)
+    p = p_ref[l, 0]
+    pinv = pinv_ref[l, 0]
+    d = add_mod(c0_ref[0, 0], mont_mul(c1_ref[0, 0], s_ref[0], p, pinv), p)
+    x = _inv_stages(d, twp_ref, tws_ref, p, logn)
+    o_ref[0, 0] = shoup_mul(x, ninv_ref[l, 0], ninvs_ref[l, 0], p)
+
+
+def _resolve_interpret(interpret: bool | None) -> bool:
+    if interpret is not None:
+        return interpret
+    # Mosaic lowering needs real TPU hardware; elsewhere (CPU test mesh,
+    # HEFL_NTT=pallas forced off-TPU) run the kernel interpreted.
+    from hefl_tpu.ckks.ntt import on_tpu_backend
+
+    return not on_tpu_backend()
+
+
+def _check_supported(ctx: NTTContext) -> None:
     if not supported(ctx):
-        raise ValueError(f"n={ctx.n} not tileable as (>=8, {LANES}) uint32 rows")
-    if interpret is None:
-        # Mosaic lowering needs real TPU hardware; elsewhere (CPU test mesh,
-        # HEFL_NTT=pallas forced off-TPU) run the kernel interpreted.
-        from hefl_tpu.ckks.ntt import on_tpu_backend
+        raise ValueError(
+            f"n={ctx.n} not tileable as (>=8, {LANES}) uint32 rows"
+        )
 
-        interpret = not on_tpu_backend()
-    tabs = _tables(ctx)
-    n, logn = ctx.n, ctx.logn
+
+def _row_layout(ctx: NTTContext, arrs):
+    """[..., L, N] tensors (shared batch) -> (L, B, S, 128) kernel layout."""
+    n = ctx.n
     s_rows = n // LANES
-    batch = a.shape[:-2]
-    num_l = a.shape[-2]
+    batch = arrs[0].shape[:-2]
+    num_l = arrs[0].shape[-2]
     b = 1
     for d in batch:
         b *= d
     # (B, L, N) -> (L, B, S, 128): primes lead so the twiddle block is
     # revisited (not re-fetched) across the inner polynomial sweep.
-    x = jnp.moveaxis(a.reshape(b, num_l, n), 0, 1).reshape(num_l, b, s_rows, LANES)
-    tw = jnp.asarray(tabs.tw_inv if inverse else tabs.tw_fwd)
+    out = [
+        jnp.moveaxis(a.reshape(b, num_l, n), 0, 1).reshape(num_l, b, s_rows, LANES)
+        for a in arrs
+    ]
+    return out, batch, num_l, b, s_rows
+
+
+def _specs(ctx: NTTContext, num_l: int, s_rows: int):
+    """The BlockSpec family every kernel here shares."""
     # Per-prime scalars ride whole in SMEM (full-array blocks — Mosaic
     # rejects sub-(8,128) partial blocks); kernels index them by program_id.
     smem = lambda: pl.BlockSpec((num_l, 1), lambda l, i: (0, 0), memory_space=pltpu.SMEM)  # noqa: E731
-    row_spec = pl.BlockSpec(
+    row = pl.BlockSpec(
         (1, 1, s_rows, LANES), lambda l, i: (l, i, 0, 0), memory_space=pltpu.VMEM
     )
-    tw_spec = pl.BlockSpec(
-        (1, logn, s_rows, LANES), lambda l, i: (l, 0, 0, 0), memory_space=pltpu.VMEM
+    key = pl.BlockSpec(
+        (1, s_rows, LANES), lambda l, i: (l, 0, 0), memory_space=pltpu.VMEM
     )
-    scalars = [jnp.asarray(tabs.p), jnp.asarray(tabs.pinv_neg)]
+    tw = pl.BlockSpec(
+        (1, ctx.logn, s_rows, LANES), lambda l, i: (l, 0, 0, 0), memory_space=pltpu.VMEM
+    )
+    return smem, row, key, tw
+
+
+def _run(ctx: NTTContext, a: jnp.ndarray, inverse: bool, interpret: bool | None) -> jnp.ndarray:
+    _check_supported(ctx)
+    interpret = _resolve_interpret(interpret)
+    tabs = _tables(ctx)
+    (x,), batch, num_l, b, s_rows = _row_layout(ctx, [a])
+    smem, row_spec, _, tw_spec = _specs(ctx, num_l, s_rows)
     if inverse:
-        kernel = functools.partial(_inv_kernel, logn=logn)
-        scalars.append(jnp.asarray(tabs.n_inv))
+        kernel = functools.partial(_inv_kernel, logn=ctx.logn)
+        scalars = [jnp.asarray(tabs.p), jnp.asarray(tabs.n_inv), jnp.asarray(tabs.n_inv_shoup)]
+        tw = [jnp.asarray(tabs.tw_inv), jnp.asarray(tabs.tw_inv_shoup)]
     else:
-        kernel = functools.partial(_fwd_kernel, logn=logn)
+        kernel = functools.partial(_fwd_kernel, logn=ctx.logn)
+        scalars = [jnp.asarray(tabs.p)]
+        tw = [jnp.asarray(tabs.tw_fwd), jnp.asarray(tabs.tw_fwd_shoup)]
     out = pl.pallas_call(
         kernel,
         grid=(num_l, b),
-        in_specs=[smem() for _ in scalars] + [row_spec, tw_spec],
+        in_specs=[smem() for _ in scalars] + [row_spec, tw_spec, tw_spec],
         out_specs=row_spec,
         out_shape=jax.ShapeDtypeStruct(x.shape, jnp.uint32),
         interpret=interpret,
-    )(*scalars, x, tw)
-    return jnp.moveaxis(out.reshape(num_l, b, n), 0, 1).reshape(*batch, num_l, n)
+    )(*scalars, x, *tw)
+    return jnp.moveaxis(out.reshape(num_l, b, ctx.n), 0, 1).reshape(*batch, num_l, ctx.n)
 
 
 def ntt_forward_pallas(ctx: NTTContext, a: jnp.ndarray, *, interpret: bool | None = None) -> jnp.ndarray:
@@ -194,3 +303,84 @@ def ntt_forward_pallas(ctx: NTTContext, a: jnp.ndarray, *, interpret: bool | Non
 def ntt_inverse_pallas(ctx: NTTContext, a: jnp.ndarray, *, interpret: bool | None = None) -> jnp.ndarray:
     """Evaluation -> coefficient domain incl. N^-1; bit-exact vs `ntt.ntt_inverse`."""
     return _run(ctx, a, inverse=True, interpret=interpret)
+
+
+def encrypt_fused_pallas(
+    ctx: NTTContext,
+    m_res: jnp.ndarray,
+    u: jnp.ndarray,
+    e0: jnp.ndarray,
+    e1: jnp.ndarray,
+    b_mont: jnp.ndarray,
+    a_mont: jnp.ndarray,
+    *,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The deterministic encrypt core as ONE fused kernel dispatch.
+
+    Inputs are coefficient-domain residues uint32[..., L, N] (message m and
+    the sampled u/e0/e1 — sampling and encoding stay outside, they are
+    cheap elementwise XLA) plus the eval-domain Montgomery-form public key
+    [L, N]. Returns eval-domain (c0, c1), bit-exact vs the XLA path in
+    `ops.encrypt`.
+    """
+    _check_supported(ctx)
+    interpret = _resolve_interpret(interpret)
+    tabs = _tables(ctx)
+    rows, batch, num_l, b, s_rows = _row_layout(ctx, [u, e0, e1, m_res])
+    smem, row_spec, key_spec, tw_spec = _specs(ctx, num_l, s_rows)
+    keys = [
+        k.reshape(num_l, s_rows, LANES) for k in (b_mont, a_mont)
+    ]
+    scalars = [jnp.asarray(tabs.p), jnp.asarray(tabs.pinv_neg)]
+    out_shape = jax.ShapeDtypeStruct(rows[0].shape, jnp.uint32)
+    c0, c1 = pl.pallas_call(
+        functools.partial(_enc_kernel, logn=ctx.logn),
+        grid=(num_l, b),
+        in_specs=[smem() for _ in scalars]
+        + [row_spec] * 4 + [key_spec] * 2 + [tw_spec] * 2,
+        out_specs=(row_spec, row_spec),
+        out_shape=(out_shape, out_shape),
+        interpret=interpret,
+    )(
+        *scalars, *rows, *keys,
+        jnp.asarray(tabs.tw_fwd), jnp.asarray(tabs.tw_fwd_shoup),
+    )
+    unrow = lambda o: jnp.moveaxis(  # noqa: E731
+        o.reshape(num_l, b, ctx.n), 0, 1
+    ).reshape(*batch, num_l, ctx.n)
+    return unrow(c0), unrow(c1)
+
+
+def decrypt_fused_pallas(
+    ctx: NTTContext,
+    c0: jnp.ndarray,
+    c1: jnp.ndarray,
+    s_mont: jnp.ndarray,
+    *,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Fused decrypt: (c0 + c1*s) -> iNTT -> coefficient residues, one
+    dispatch per (prime, ciphertext) row; bit-exact vs `ops.decrypt`."""
+    _check_supported(ctx)
+    interpret = _resolve_interpret(interpret)
+    tabs = _tables(ctx)
+    rows, batch, num_l, b, s_rows = _row_layout(ctx, [c0, c1])
+    smem, row_spec, key_spec, tw_spec = _specs(ctx, num_l, s_rows)
+    scalars = [
+        jnp.asarray(tabs.p), jnp.asarray(tabs.pinv_neg),
+        jnp.asarray(tabs.n_inv), jnp.asarray(tabs.n_inv_shoup),
+    ]
+    out = pl.pallas_call(
+        functools.partial(_dec_kernel, logn=ctx.logn),
+        grid=(num_l, b),
+        in_specs=[smem() for _ in scalars]
+        + [row_spec] * 2 + [key_spec] + [tw_spec] * 2,
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct(rows[0].shape, jnp.uint32),
+        interpret=interpret,
+    )(
+        *scalars, *rows, s_mont.reshape(num_l, s_rows, LANES),
+        jnp.asarray(tabs.tw_inv), jnp.asarray(tabs.tw_inv_shoup),
+    )
+    return jnp.moveaxis(out.reshape(num_l, b, ctx.n), 0, 1).reshape(*batch, num_l, ctx.n)

@@ -559,6 +559,7 @@ def run_experiment(
         save_params(cfg.save_model_path, params)
         say(f"saved aggregated model to {cfg.save_model_path}")
 
+    from hefl_tpu.ckks.backend import he_backend_report
     from hefl_tpu.data.augment import backend_report
     from hefl_tpu.fl.fusion import fusion_report
 
@@ -572,4 +573,7 @@ def run_experiment(
         # Which cross-client training backend the round programs traced
         # with (TrainConfig.client_fusion; fl.fusion auto-selection).
         "client_fusion": fusion_report(),
+        # Which HE backend (fused Pallas kernels vs the XLA reference) the
+        # encrypt/decrypt programs traced with (HEFL_HE; ckks.backend).
+        "he_backend": he_backend_report(),
     }

@@ -47,6 +47,7 @@ from hefl_tpu.fl.fedavg import (
     train_block,
 )
 from hefl_tpu.ckks.modular import add_mod as modular_add_mod
+from hefl_tpu.ckks.modular import barrett_mod, barrett_mu
 from hefl_tpu.parallel import (
     client_axes,
     client_mesh_size,
@@ -76,13 +77,17 @@ def _lazy_sum_mod(x: jax.Array, p: jax.Array) -> jax.Array:
     Up to MAX_PSUM_CLIENTS summands of <2**27 each fit uint32 without
     wraparound (the `psum_mod` argument), so reduction happens once per
     chunk of 32; chunk results are canonical and fold together with
-    `add_mod` — any client count works, still O(1) `rem`s per ~32 clients.
+    `add_mod` — any client count works, still O(1) reductions per ~32
+    clients. The per-chunk reduction is shift-multiply Barrett
+    (`modular.barrett_mod`, bitwise-equal to the historical `lax.rem`), so
+    the hot path issues no hardware divides (ISSUE 4).
     """
     num = x.shape[0]
     p_full = jnp.broadcast_to(p, x.shape[1:])
+    mu_full = jnp.broadcast_to(barrett_mu(p), x.shape[1:])
 
     def chunk_sum(c):
-        return jax.lax.rem(jnp.sum(c, axis=0, dtype=jnp.uint32), p_full)
+        return barrett_mod(jnp.sum(c, axis=0, dtype=jnp.uint32), p_full, mu_full)
 
     acc = chunk_sum(x[:MAX_PSUM_CLIENTS])
     for lo in range(MAX_PSUM_CLIENTS, num, MAX_PSUM_CLIENTS):
@@ -94,9 +99,135 @@ def encrypt_stack(ctx: CkksContext, pk: PublicKey, p_out, enc_keys) -> Ciphertex
     """Encrypt stacked per-client weight trees (leaves [C, ...]) into one
     [C, n_ct, L, N]-batched Ciphertext — the encrypt half of the round for
     weights that are already materialized (bench.py's cell-6 artifact, the
-    secure-round tests)."""
-    enc_one = lambda prm, k: encrypt_params(ctx, pk, prm, k)  # noqa: E731
-    return jax.vmap(enc_one)(p_out, enc_keys)
+    secure-round tests).
+
+    Pack/encode/sampling run per client (vmapped elementwise XLA, the
+    HISTORICAL per-client key derivation so ciphertexts stay bitwise
+    stable), then the whole [C, n_ct] stack goes through ONE
+    `ops.encrypt_core` call — a single fused kernel dispatch on the Pallas
+    backend instead of a vmap of per-client kernels, and one stacked NTT
+    graph on XLA.
+    """
+    enc_one = lambda prm: encoding.encode(  # noqa: E731
+        ctx.ntt, pack_pytree(prm, ctx.n), ctx.scale
+    )
+    m_res = jax.vmap(enc_one)(p_out)                    # [C, n_ct, L, N]
+    n_ct = int(m_res.shape[1])
+    u, e0, e1 = jax.vmap(
+        lambda k: ops.encrypt_samples(ctx, k, (n_ct,))
+    )(enc_keys)
+    return ops.encrypt_core(ctx, pk, m_res, u, e0, e1)
+
+
+def _pad_rows(arr: jax.Array, mult: int) -> jax.Array:
+    """Zero-pad axis 0 to a multiple of `mult` (ciphertext-shard padding)."""
+    pad = (-arr.shape[0]) % mult
+    if pad:
+        arr = jnp.concatenate(
+            [arr, jnp.zeros((pad, *arr.shape[1:]), arr.dtype)], axis=0
+        )
+    return arr
+
+
+@functools.lru_cache(maxsize=8)
+def _build_sharded_he(ctx: CkksContext, mesh):
+    """Compile-once factory for ciphertext-sharded encrypt/decrypt (ISSUE 4).
+
+    The [n_ct, L, N] residue tensors are embarrassingly parallel over the
+    ciphertext axis, so both cores run under `shard_map` with the rows
+    partitioned over the 1-D ``"ct"`` mesh (`parallel.make_ct_mesh`) and
+    the key polynomials replicated. Every row's math is identical to the
+    replicated path, so sharded results are BITWISE equal — sharding is
+    pure throughput, no numerics knob.
+
+    Callers reshard inputs onto THIS mesh first (`_onto_mesh`): a
+    ciphertext straight out of a round program is committed to the round's
+    client mesh, and jit refuses to mix device sets otherwise.
+    """
+    from hefl_tpu.parallel import CT_AXIS
+
+    spec = P(CT_AXIS)
+
+    def enc_body(m_res, u, e0, e1, b_mont, a_mont):
+        ct = ops.encrypt_core(
+            ctx, PublicKey(b_mont=b_mont, a_mont=a_mont), m_res, u, e0, e1
+        )
+        return ct.c0, ct.c1
+
+    def dec_body(c0, c1, s_mont):
+        return ops.decrypt(
+            ctx, SecretKey(s_mont=s_mont),
+            Ciphertext(c0=c0, c1=c1, scale=ctx.scale),
+        )
+
+    enc = jax.jit(shard_map(
+        enc_body, mesh=mesh,
+        in_specs=(spec, spec, spec, spec, P(), P()),
+        out_specs=(spec, spec),
+        check_vma=False,
+    ))
+    dec = jax.jit(shard_map(
+        dec_body, mesh=mesh,
+        in_specs=(spec, spec, P()),
+        out_specs=spec,
+        check_vma=False,
+    ))
+    return enc, dec
+
+
+def _onto_mesh(mesh, arr: jax.Array, sharded: bool) -> jax.Array:
+    """Reshard one array onto the ct mesh (row-sharded or replicated).
+
+    A plain argument pass is not enough: arrays committed to a different
+    device set (e.g. a ciphertext from the round program's client mesh)
+    make jit raise "incompatible devices". device_put performs the copy.
+    """
+    from jax.sharding import NamedSharding
+
+    from hefl_tpu.parallel import CT_AXIS
+
+    return jax.device_put(
+        arr, NamedSharding(mesh, P(CT_AXIS) if sharded else P())
+    )
+
+
+def encrypt_params_sharded(
+    ctx: CkksContext, pk: PublicKey, params, key: jax.Array, mesh
+) -> Ciphertext:
+    """`encrypt_params` with the ciphertext batch sharded over `mesh`.
+
+    Pack/encode/sampling run at the LOGICAL [n_ct] shape with the identical
+    key derivation as the replicated path (so ciphertexts are bitwise
+    equal); only the deterministic core — the NTT-heavy part — is padded to
+    the device count and sharded over the ``"ct"`` axis.
+    """
+    blocks = pack_pytree(params, ctx.n)
+    m_res = encoding.encode(ctx.ntt, blocks, ctx.scale)
+    n_ct = int(m_res.shape[0])
+    u, e0, e1 = ops.encrypt_samples(ctx, key, (n_ct,))
+    n_dev = int(mesh.devices.size)
+    enc, _ = _build_sharded_he(ctx, mesh)
+    c0, c1 = enc(
+        *(_onto_mesh(mesh, _pad_rows(t, n_dev), True)
+          for t in (m_res, u, e0, e1)),
+        _onto_mesh(mesh, pk.b_mont, False),
+        _onto_mesh(mesh, pk.a_mont, False),
+    )
+    return Ciphertext(c0=c0[:n_ct], c1=c1[:n_ct], scale=ctx.scale)
+
+
+def decrypt_sharded(ctx: CkksContext, sk: SecretKey, ct: Ciphertext, mesh) -> jax.Array:
+    """`ops.decrypt` with the [n_ct] ciphertext batch sharded over `mesh`;
+    bitwise-equal coefficient residues."""
+    n_ct = int(ct.c0.shape[0])
+    n_dev = int(mesh.devices.size)
+    _, dec = _build_sharded_he(ctx, mesh)
+    res = dec(
+        _onto_mesh(mesh, _pad_rows(ct.c0, n_dev), True),
+        _onto_mesh(mesh, _pad_rows(ct.c1, n_dev), True),
+        _onto_mesh(mesh, sk.s_mont, False),
+    )
+    return res[:n_ct]
 
 
 def aggregate_encrypted(ctx: CkksContext, cts: Ciphertext) -> Ciphertext:
@@ -121,6 +252,7 @@ def decrypt_average(
     spec: PackSpec = None,
     exact: bool = False,
     meta: "RoundMeta | None" = None,
+    mesh=None,
 ):
     """Owner-side decrypt of the aggregated sum -> averaged parameter pytree.
 
@@ -128,6 +260,9 @@ def decrypt_average(
     client count happens in the decode scale — exact, no ciphertext op.
     `exact=True` routes through the host bignum CRT (the trust-boundary
     path used for final model export); default is the jittable f32 decode.
+    `mesh` (a `parallel.make_ct_mesh` mesh) shards the decrypt over the
+    ciphertext axis — bitwise-equal residues, owner-side throughput scaling
+    with devices (ISSUE 4).
 
     Under partial participation the denominator MUST be the round's
     surviving-client count, not the static experiment-wide total — dividing
@@ -162,7 +297,10 @@ def decrypt_average(
         )
     else:
         surviving = int(num_clients)
-    res = ops.decrypt(ctx, sk, ct_sum)
+    if mesh is not None:
+        res = decrypt_sharded(ctx, sk, ct_sum, mesh)
+    else:
+        res = ops.decrypt(ctx, sk, ct_sum)
     denom = ct_sum.scale * surviving
     if exact:
         blocks = jnp.asarray(

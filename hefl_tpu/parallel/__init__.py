@@ -12,10 +12,12 @@ encrypted path.
 
 from hefl_tpu.parallel.mesh import (
     CLIENT_AXIS,
+    CT_AXIS,
     HOST_AXIS,
     client_axes,
     client_mesh_size,
     local_client_count,
+    make_ct_mesh,
     make_host_mesh,
     make_mesh,
     shard_map,
@@ -29,7 +31,9 @@ from hefl_tpu.parallel.collectives import (
 
 __all__ = [
     "CLIENT_AXIS",
+    "CT_AXIS",
     "HOST_AXIS",
+    "make_ct_mesh",
     "client_axes",
     "client_mesh_size",
     "make_mesh",

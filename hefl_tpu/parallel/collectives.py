@@ -9,7 +9,8 @@ IS the aggregation: one XLA collective per round.
 "distributed communication backend"): a psum of uint32 RNS residues
 followed by one modular reduction. Residues are < p < 2**27 and the psum
 adds at most 32 of them, so the sum stays < 2**32 with no wraparound —
-lazy reduction, one `%` per round instead of one per pairwise add.
+lazy reduction, one reduction per round instead of one per pairwise add,
+and that reduction is shift-multiply Barrett (no hardware divide).
 """
 
 from __future__ import annotations
@@ -37,10 +38,14 @@ def psum_mod(residues: jax.Array, p: jax.Array, axis_name: str) -> jax.Array:
 
     The homomorphic FedAvg sum: psum of ciphertext limbs over ICI = ct+ct
     for every client simultaneously (the reference's loop at
-    FLPyfhelin.py:378-381 collapsed into one collective).
+    FLPyfhelin.py:378-381 collapsed into one collective). The post-psum
+    canonicalization is division-free Barrett, bitwise-equal to the
+    historical `lax.rem`.
     """
+    from hefl_tpu.ckks.modular import barrett_mod
+
     total = jax.lax.psum(residues, axis_name)
-    return jax.lax.rem(total, jnp.broadcast_to(p, total.shape))
+    return barrett_mod(total, jnp.broadcast_to(p, total.shape))
 
 
 def pmean_tree(tree, axis_name: str | tuple[str, ...]):

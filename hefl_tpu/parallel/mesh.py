@@ -20,6 +20,7 @@ from jax.sharding import Mesh
 
 CLIENT_AXIS = "clients"
 HOST_AXIS = "hosts"
+CT_AXIS = "ct"
 
 
 def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
@@ -105,3 +106,19 @@ def make_host_mesh(
 def local_client_count(mesh: Mesh, num_clients: int) -> int:
     """Clients simulated per device (>=1)."""
     return num_clients // client_mesh_size(mesh)
+
+
+def make_ct_mesh(devices: list | None = None, max_devices: int | None = None) -> Mesh:
+    """1-D mesh over the ciphertext-batch axis ``"ct"`` (ISSUE 4).
+
+    The [n_ct, L, N] ciphertext residue tensors are embarrassingly parallel
+    over `n_ct` (every ciphertext row is independent; RNS limbs too), so
+    owner-side encrypt/decrypt shards the ciphertext batch over every
+    device of the slice instead of running replicated — HE throughput then
+    scales with devices exactly like training does. `fl.secure`'s
+    `encrypt_params_sharded` / `decrypt_average(..., mesh=)` consume this.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if max_devices is not None:
+        devs = devs[:max_devices]
+    return Mesh(np.array(devs), (CT_AXIS,))

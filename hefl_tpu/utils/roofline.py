@@ -160,6 +160,162 @@ def backend_compare(
     return rows
 
 
+def steady_seconds(fn, *args, reps: int = 3, warmup: int = 1) -> float:
+    """Warm-then-min-over-reps wall-clock of `fn(*args)` (blocking).
+
+    THE timing helper every measurement driver shares (bench.py,
+    profile_round.py, the HE backend auto-probe) so the methodology cannot
+    drift between artifacts. `bench_ntt.py` deliberately uses a device-side
+    `fori_loop` rep chain instead — per-dispatch amortization, see its
+    docstring — and is the one intentional exception.
+    """
+    import time
+
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# HE roofline (ISSUE 4). The HE phases run integer (uint32) vector math, so
+# their `flops`-shaped rows were null in every artifact — "we literally
+# cannot say how far from peak they run". This section gives encrypt /
+# aggregate / decrypt real rows: an ANALYTIC int-op count from the modular
+# cost model below (ops per element of the [n_ct, L, N] residue tensors),
+# the ideal fused byte traffic, and the measured int-ops/s / bytes/s.
+#
+# Cost model (counted from hefl_tpu.ckks.modular's elementwise uint32 ops):
+#   mul32_wide 17, mont_mul 40, shoup_mul 22, barrett_mod 22, add/sub_mod 3.
+# NTT: one butterfly (2 elements) = shoup_mul + add_mod + sub_mod = 28
+# -> 14 int ops per element per stage, logn stages.
+# ---------------------------------------------------------------------------
+
+_OPS_MONT_MUL = 40
+_OPS_SHOUP_MUL = 22
+_OPS_BARRETT = 22
+_OPS_ADD_MOD = 3
+_NTT_OPS_PER_ELEM_STAGE = 14
+
+# Peak uint32 VPU ops/s by device kind. TPU spec sheets publish MXU flops,
+# not VPU integer throughput, so these are ESTIMATES (bf16 peak / 16 — the
+# VPU is roughly 1/16th of the MXU's mac rate); every row derived from them
+# carries `peak_is_estimate`. Interpret utilization shape, not absolutes.
+_PEAK_INT_DIVISOR = 16.0
+CPU_PLACEHOLDER_INT_OPS = 2e10
+
+
+def peak_int_ops(device: Any) -> tuple[float | None, bool]:
+    """-> (estimated peak uint32 ops/s, is_estimate). Always an estimate."""
+    peak, placeholder = peak_flops(device)
+    if peak is None:
+        return None, True
+    if placeholder:
+        return CPU_PLACEHOLDER_INT_OPS, True
+    return peak / _PEAK_INT_DIVISOR, True
+
+
+def he_phase_counts(
+    phase: str, *, n: int, num_limbs: int, n_ct: int, num_clients: int = 1
+) -> dict[str, float]:
+    """Analytic {int_ops, bytes} of one HE phase at the given geometry.
+
+    `bytes` is the ideal fused-kernel traffic (inputs + outputs + key
+    polynomials once; twiddle tables amortized over the ciphertext batch) —
+    the denominator for a bandwidth roofline, not a measured DMA count.
+    """
+    logn = n.bit_length() - 1
+    elems = float(n_ct) * num_limbs * n          # one residue tensor
+    ntt = _NTT_OPS_PER_ELEM_STAGE * logn
+    table_bytes = 2 * num_limbs * n * 4 * logn   # twiddle + shoup tables
+    if phase == "encrypt":
+        # 4 forward NTTs + pointwise 2 mont_mul + 3 add_mod, per client.
+        int_ops = num_clients * elems * (4 * ntt + 2 * _OPS_MONT_MUL + 3 * _OPS_ADD_MOD)
+        byts = num_clients * (elems * 4 * (4 + 2)) + 2 * num_limbs * n * 4 + table_bytes
+    elif phase == "aggregate":
+        # Lazy uint32 sum over 2*C ciphertext components + one Barrett.
+        int_ops = 2 * elems * (max(num_clients - 1, 1) + _OPS_BARRETT)
+        byts = 2 * (num_clients * elems * 4 + elems * 4)
+    elif phase == "decrypt":
+        # c0 + c1*s, inverse NTT, final N^-1 multiply.
+        int_ops = elems * (_OPS_MONT_MUL + _OPS_ADD_MOD + ntt + _OPS_SHOUP_MUL)
+        byts = elems * 4 * 3 + num_limbs * n * 4 + table_bytes
+    else:
+        raise ValueError(f"unknown HE phase {phase!r}")
+    return {"int_ops": float(int_ops), "bytes": float(byts)}
+
+
+def he_phase_stats(
+    seconds: float | None,
+    counts: Mapping[str, float],
+    device: Any = None,
+) -> dict[str, Any]:
+    """One HE phase's roofline record — the int-op analog of `phase_stats`.
+
+    Fields always PRESENT; int_ops/bytes are analytic (never null), the
+    rates null only when `seconds` is. `util_vs_peak_int_ops` divides by
+    the ESTIMATED VPU peak and carries `peak_is_estimate` accordingly.
+    """
+    peak, estimate = peak_int_ops(device) if device is not None else (None, True)
+    int_ops = counts["int_ops"]
+    byts = counts["bytes"]
+    rec: dict[str, Any] = {
+        "seconds": round(seconds, 4) if seconds is not None else None,
+        "int_ops": int_ops,
+        "bytes": byts,
+        "int_ops_per_s": round(int_ops / seconds, 1) if seconds else None,
+        "bytes_per_s": round(byts / seconds, 1) if seconds else None,
+        "util_vs_peak_int_ops": (
+            round(int_ops / seconds / peak, 5) if (seconds and peak) else None
+        ),
+    }
+    if estimate and rec["util_vs_peak_int_ops"] is not None:
+        rec["peak_is_estimate"] = True
+    return rec
+
+
+def he_roofline(
+    seconds_by_phase: Mapping[str, float | None],
+    *,
+    n: int,
+    num_limbs: int,
+    n_ct: int,
+    num_clients: int,
+    encrypt_clients: int = 1,
+    device: Any = None,
+) -> dict[str, Any]:
+    """The `he_roofline` record every bench/profile artifact embeds:
+    {phase: he_phase_stats} for encrypt/aggregate/decrypt at one geometry.
+
+    `num_clients` sizes the aggregation; `encrypt_clients` sizes the
+    encrypt row (the drivers time a 1-client standalone encrypt, so the
+    default matches the measurement). Pass None seconds to still get the
+    analytic counts (rates null).
+    """
+    rows: dict[str, Any] = {}
+    by_phase = {
+        "encrypt": encrypt_clients, "aggregate": num_clients, "decrypt": 1,
+    }
+    for phase, clients in by_phase.items():
+        counts = he_phase_counts(
+            phase, n=n, num_limbs=num_limbs, n_ct=n_ct, num_clients=clients
+        )
+        rows[phase] = he_phase_stats(
+            seconds_by_phase.get(phase), counts, device=device
+        )
+    rows["geometry"] = {
+        "n": n, "num_limbs": num_limbs, "n_ct": n_ct,
+        "num_clients": num_clients, "encrypt_clients": encrypt_clients,
+    }
+    return rows
+
+
 def clamp_attribution(
     raw: Mapping[str, float]
 ) -> tuple[dict[str, float], bool]:

@@ -42,7 +42,6 @@ import dataclasses
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -52,16 +51,9 @@ def log(msg: str) -> None:
 
 
 def _steady(fn, reps: int = 3, warmup: int = 1) -> float:
-    import jax
+    from hefl_tpu.utils.roofline import steady_seconds
 
-    for _ in range(warmup):
-        jax.block_until_ready(fn())
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn())
-        best = min(best, time.perf_counter() - t0)
-    return best
+    return steady_seconds(fn, reps=reps, warmup=warmup)
 
 
 def main() -> None:
@@ -92,6 +84,7 @@ def main() -> None:
         fedavg_round,
         secure_fedavg_round,
     )
+    from hefl_tpu.ckks.backend import he_backend_report
     from hefl_tpu.fl.secure import aggregate_encrypted, encrypt_params
     from hefl_tpu.models import create_model
     from hefl_tpu.parallel import make_mesh
@@ -183,6 +176,8 @@ def main() -> None:
 
     # Standalone HE stages (not inside the big program): encrypt both
     # clients' params + aggregate + decrypt + evaluate.
+    from hefl_tpu.ckks import ops as ckks_ops
+
     enc2 = jax.jit(
         lambda prm, k: encrypt_params(ctx, pk, prm, k)
     )
@@ -196,6 +191,12 @@ def main() -> None:
         ).c0
     )
     t_aggregate = _steady(lambda: stacked(ct0.c0, ct0.c1))
+    # Decrypt CORE (c0 + c1*s + iNTT) timed apart from the full owner step
+    # (which also runs the CRT decode + unpack) — the core is what the HE
+    # int-op roofline models.
+    dec_core = jax.jit(lambda c0, c1: ckks_ops.decrypt(
+        ctx, sk, type(ct0)(c0=c0, c1=c1, scale=ct0.scale)))
+    t_decrypt_core = _steady(lambda: dec_core(ct0.c0, ct0.c1))
     t_decrypt = _steady(
         lambda: jax.tree_util.tree_leaves(
             decrypt_average(ctx, sk, ct0, 1, pack)
@@ -203,7 +204,8 @@ def main() -> None:
     )
     t_evaluate = _steady(lambda: evaluate(module, params, xt_d, yt)["accuracy"])
     log(f"standalone encrypt(1 client): {t_encrypt:.3f}s, aggregate(2): "
-        f"{t_aggregate:.3f}s, decrypt: {t_decrypt:.3f}s, evaluate: {t_evaluate:.3f}s")
+        f"{t_aggregate:.3f}s, decrypt: {t_decrypt:.3f}s (core "
+        f"{t_decrypt_core:.3f}s), evaluate: {t_evaluate:.3f}s")
 
     # Augment backend shootout at the training batch shape (always the
     # flagship 256x256 image — augment cost is what this PR attacks, so
@@ -245,6 +247,35 @@ def main() -> None:
         fwd_flops, steps_per_epoch, cfg.epochs, num_clients
     )
     train_images = num_clients * cfg.epochs * steps_per_epoch * grp
+    # HE roofline (ISSUE 4): analytic int-op/bandwidth rows for the HE
+    # phases at this geometry — the encrypt row is the 1-client standalone
+    # timing, aggregate the 2-stack, decrypt the core (no decode).
+    he_rows = roofline.he_roofline(
+        {"encrypt": t_encrypt, "aggregate": t_aggregate,
+         "decrypt": t_decrypt_core},
+        n=ctx.n, num_limbs=ctx.num_primes, n_ct=pack.n_ct,
+        num_clients=num_clients, encrypt_clients=1, device=dev,
+    )
+    # The decrypt/evaluate phase rows used to carry flops/mfu nulls: decrypt
+    # now reports the HE int-op model (op_kind marks the unit — uint32 ops,
+    # not flops; mfu is utilization vs the ESTIMATED VPU int peak), and
+    # evaluate gets its real forward FLOPs from cost analysis.
+    eval_flops = roofline.program_flops(
+        lambda p, xb: module.apply({"params": p}, xb), params,
+        jnp.zeros((len(xt), *x.shape[1:]), jnp.float32),
+    )
+    # seconds stays the full owner step; flops/mfu are the CORE int-op
+    # model over the CORE time (identical numerator AND denominator to the
+    # he_roofline decrypt row, so the two records cannot disagree), with
+    # core_seconds carrying the denominator explicitly.
+    decrypt_phase = roofline.phase_stats(t_decrypt, device=dev)
+    decrypt_phase.update(
+        flops=he_rows["decrypt"]["int_ops"],
+        mfu=he_rows["decrypt"]["util_vs_peak_int_ops"],
+        core_seconds=round(t_decrypt_core, 4),
+        op_kind="int32",
+        peak_is_estimate=True,
+    )
     phase_roofline = {
         "fused_round": roofline.phase_stats(
             full, flops=train_flops, device=dev, images=train_images
@@ -252,8 +283,10 @@ def main() -> None:
         "train_only": roofline.phase_stats(
             train_only, flops=train_flops, device=dev, images=train_images
         ),
-        "decrypt": roofline.phase_stats(t_decrypt, device=dev),
-        "evaluate": roofline.phase_stats(t_evaluate, device=dev, images=len(xt)),
+        "decrypt": decrypt_phase,
+        "evaluate": roofline.phase_stats(
+            t_evaluate, flops=eval_flops, device=dev, images=len(xt)
+        ),
     }
     client_fusion_compare = roofline.backend_compare(
         fusion_times, flops=train_flops, device=dev, images=train_images
@@ -268,6 +301,7 @@ def main() -> None:
         "standalone_encrypt_s": round(t_encrypt, 3),
         "standalone_aggregate_s": round(t_aggregate, 3),
         "decrypt_s": round(t_decrypt, 3),
+        "decrypt_core_s": round(t_decrypt_core, 3),
         "evaluate_s": round(t_evaluate, 3),
         **{
             f"augment_{b}_ms": round(t * 1e3, 3) for b, t in aug_times.items()
@@ -277,6 +311,10 @@ def main() -> None:
         "client_fusion": fusion_report(),
         "client_fusion_compare": client_fusion_compare,
         "phase_roofline": phase_roofline,
+        # HE backend (fused Pallas vs XLA reference) + the int-op/bandwidth
+        # roofline rows for encrypt/aggregate/decrypt (ISSUE 4).
+        "he_backend": he_backend_report(),
+        "he_roofline": he_rows,
         "device": roofline.device_kind(dev),
     }
 
@@ -330,6 +368,14 @@ def main() -> None:
     sp = client_fusion_compare.get("fused_speedup_vs_vmap")
     if sp is not None:
         print(f"\nfused train-round speedup vs vmap: {sp}x")
+    print()
+    print(f"| HE phase (backend={att['he_backend']['backend']}) | seconds "
+          "| int-ops/s | bytes/s |")
+    print("|---|---|---|---|")
+    for ph in ("encrypt", "aggregate", "decrypt"):
+        row = he_rows[ph]
+        print(f"| {ph} | {row['seconds']} | {row['int_ops_per_s']:.3g} "
+              f"| {row['bytes_per_s']:.3g} |")
     print(json.dumps({"metric": "phase_attribution", **att}))
 
 

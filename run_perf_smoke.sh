@@ -11,7 +11,11 @@
 #       of bug fails here, on CPU, instead of poisoning TPU evidence);
 #   (d) the client_fusion backend record and the fused-vs-vmap comparison
 #       rows (seconds/mfu/images_per_s per backend + speedup) are present
-#       — the ISSUE-3 schema every bench artifact now carries.
+#       — the ISSUE-3 schema every bench artifact now carries;
+#   (e) the he_backend record and the he_roofline rows (ISSUE 4): every HE
+#       phase (encrypt/aggregate/decrypt) must carry non-null int_ops /
+#       int_ops_per_s / bytes / bytes_per_s, and the decrypt/evaluate
+#       phase_roofline rows must no longer ship flops/mfu nulls.
 # Wired into run_tpu_suite.sh as stage 0 (cheap pre-stage, no backend
 # probe needed — both harnesses pin themselves to CPU in smoke mode).
 set -euo pipefail
@@ -121,6 +125,36 @@ else:
                 f"WARNING: fused train round is {speedup}x vmap on this "
                 "device — auto mode will keep picking vmap here"
             )
+    # HE backend + roofline schema gate (ISSUE 4).
+    hb = rec.get("he_backend")
+    if not isinstance(hb, dict) or not hb.get("backend"):
+        fail.append("profile: missing he_backend record")
+    he = rec.get("he_roofline")
+    if not isinstance(he, dict):
+        fail.append("profile: missing he_roofline rows")
+    else:
+        for phase in ("encrypt", "aggregate", "decrypt"):
+            row = he.get(phase)
+            need = ("seconds", "int_ops", "int_ops_per_s", "bytes", "bytes_per_s")
+            if not isinstance(row, dict) or not set(need) <= set(row):
+                fail.append(
+                    f"profile: he_roofline[{phase!r}] missing the "
+                    "int-op/bandwidth schema"
+                )
+            else:
+                nulls = [k for k in need if row.get(k) is None]
+                if nulls:
+                    fail.append(
+                        f"profile: he_roofline[{phase!r}] null fields {nulls}"
+                    )
+    for phase in ("decrypt", "evaluate"):
+        row = (rec.get("phase_roofline") or {}).get(phase) or {}
+        for field in ("flops", "mfu"):
+            if row.get(field) is None:
+                fail.append(
+                    f"profile: phase_roofline[{phase!r}].{field} is still "
+                    "null — the HE roofline must fill it"
+                )
 
 if fail:
     print("PERF SMOKE FAILED:")
@@ -129,6 +163,6 @@ if fail:
     sys.exit(1)
 print(
     "perf smoke OK: MFU + roofline schema present on both artifacts, "
-    "no unflagged negative attribution rows"
+    "he_roofline rows non-null, no unflagged negative attribution rows"
 )
 PY

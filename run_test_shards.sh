@@ -31,7 +31,17 @@ if [ -n "$1" ]; then
   exit 0
 fi
 run "fast tier" -m "not slow"
+# NTT-backend shard (ISSUE 4): re-run ONLY the CKKS-layer tests with every
+# supported ring routed through the Pallas kernel family (interpreted on
+# CPU; `pallas-interpret` falls back to XLA on untileable test rings).
+# The default fast tier covers HEFL_NTT=xla everywhere, so both backends
+# get CI coverage without doubling the suite's wall clock.
+t0=$SECONDS
+HEFL_NTT=pallas-interpret python -m pytest -q -m "not slow" \
+  tests/test_modular.py tests/test_ntt.py tests/test_pallas_ntt.py \
+  tests/test_pallas_he.py tests/test_ckks.py
+echo "== HEFL_NTT=pallas-interpret ckks shard: $((SECONDS - t0))s"
 for k in $(seq 1 "$N"); do
   run "slow shard $k/$N" -m slow --shard "$k/$N"
 done
-echo "== full suite green (fast + $N slow shards)"
+echo "== full suite green (fast + NTT-backend shard + $N slow shards)"

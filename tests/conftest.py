@@ -66,6 +66,8 @@ _SLOW_PATTERNS = (
     "test_fl.py::test_fedavg_round_2_clients",
     "test_fl.py::test_early_stopping",
     "test_pallas_ntt.py::test_forward_parity",
+    "test_pallas_he.py::test_fused_encrypt_parity_production",
+    "test_pallas_he.py::test_fused_decrypt_parity_production",
     "test_ntt.py::test_roundtrip_full_size",
     "test_entry.py::test_dryrun",
     "test_experiment.py::test_encrypted_experiment",

@@ -88,6 +88,78 @@ def test_barrett_mod_small_post_psum_range():
     np.testing.assert_array_equal(np.asarray(got, dtype=np.int64), x.astype(np.int64) % p)
 
 
+def test_barrett_mod_full_range_parity():
+    # ISSUE 4: the shift-multiply Barrett replaces `lax.rem`/`jnp.remainder`
+    # on the hot paths — bitwise parity against the old path across the
+    # full uint32 residue range: every multiple-of-p boundary neighborhood,
+    # the extremes, and a large random sweep, for every production prime
+    # width (27-bit RNS limbs, a 30-bit stress prime).
+    rng = np.random.default_rng(7)
+    for p in primes.find_ntt_primes(3, 27, 8192) + primes.find_ntt_primes(1, 30, 8192):
+        edges = []
+        for k in range(0, 2**32 // p + 1, max(1, (2**32 // p) // 64)):
+            base = k * p
+            edges += [base - 1, base, base + 1]
+        edges += [0, 1, p - 1, p, p + 1, 2**31 - 1, 2**31, 2**32 - 2, 2**32 - 1]
+        xs = np.array([e % 2**32 for e in edges], dtype=np.uint64)
+        xs = np.concatenate([xs, rng.integers(0, 2**32, size=200_000, dtype=np.uint64)])
+        got = modular.barrett_mod(jnp.asarray(xs.astype(np.uint32)), jnp.uint32(p))
+        np.testing.assert_array_equal(
+            np.asarray(got, dtype=np.uint64), xs % p,
+            err_msg=f"barrett_mod mismatch for p={p}",
+        )
+
+
+def test_barrett_mod_signed_matches_remainder():
+    # The encode path's numpy-remainder semantics (sign follows divisor)
+    # across the full int32 domain |x| < 2**31.
+    rng = np.random.default_rng(8)
+    for p in primes.find_ntt_primes(2, 27, 8192):
+        xs = np.concatenate([
+            np.array([0, 1, -1, p - 1, p, -p, p + 1, -(p + 1),
+                      2**31 - 1, -(2**31) + 1], dtype=np.int64),
+            rng.integers(-(2**31) + 1, 2**31, size=100_000, dtype=np.int64),
+        ])
+        got = modular.barrett_mod_signed(
+            jnp.asarray(xs.astype(np.int32)), jnp.uint32(p)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got, dtype=np.int64), xs % p,
+            err_msg=f"barrett_mod_signed mismatch for p={p}",
+        )
+
+
+def test_barrett_mod_small_full_uint31_range():
+    # The historical contract (post-psum int32 sums) plus the new
+    # division-free implementation's extended uint32 soundness.
+    rng = np.random.default_rng(9)
+    p = primes.find_ntt_primes(1, 27, 8192)[0]
+    x = rng.integers(0, 2**31, size=(4096,), dtype=np.int64).astype(np.int32)
+    got = modular.barrett_mod_small(jnp.asarray(x), jnp.uint32(p))
+    np.testing.assert_array_equal(
+        np.asarray(got, dtype=np.int64), x.astype(np.int64) % p
+    )
+
+
+def test_shoup_mul_matches_bignum():
+    # The Harvey/Shoup butterfly multiply: exact for any a < 2**32 and
+    # w < p with the host-precomputed quotient constant.
+    rng = np.random.default_rng(10)
+    for p in primes.find_ntt_primes(2, 27, 8192):
+        a = rng.integers(0, 2**32, size=(4096,), dtype=np.uint64)
+        w = rng.integers(0, p, size=(4096,), dtype=np.uint64)
+        w_shoup = (w.astype(object) << 32) // p
+        got = modular.shoup_mul(
+            jnp.asarray(a.astype(np.uint32)),
+            jnp.asarray(w.astype(np.uint32)),
+            jnp.asarray(w_shoup.astype(np.uint64).astype(np.uint32)),
+            jnp.uint32(p),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got, dtype=np.uint64), (a * w) % p
+        )
+
+
 def test_to_signed_center():
     p = primes.find_ntt_primes(1, 27, 8192)[0]
     x = np.array([0, 1, p // 2, p // 2 + 1, p - 1], dtype=np.uint32)

@@ -1,0 +1,115 @@
+"""Bit-exact parity of the fused Pallas encrypt/decrypt kernels vs XLA.
+
+The kernel family (pallas_ntt: `encrypt_fused_pallas`, `decrypt_fused_pallas`)
+runs the whole HE op — 4 forward NTTs + pointwise key combination for
+encrypt, c0 + c1·s + inverse NTT for decrypt — as one dispatch. These tests
+run the kernels in interpreter mode on the CPU test mesh against the XLA
+graph reference (`ops._encrypt_core_xla` / `ops.decrypt`), at the three
+production shapes ([55|18|2, 3, 4096] — slow tier) and at a fast small-ring
+shape, plus the `ckks.backend` dispatch plumbing end-to-end.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.ckks import ops, pallas_ntt
+from hefl_tpu.ckks import backend as he_backend
+from hefl_tpu.ckks.keys import CkksContext, keygen
+
+
+@pytest.fixture(scope="module")
+def ctx1024():
+    ctx = CkksContext.create(n=1024)
+    sk, pk = keygen(ctx, jax.random.key(7))
+    return ctx, sk, pk
+
+
+@pytest.fixture(scope="module")
+def ctx4096():
+    return CkksContext.create()  # flagship ring: N=4096, L=3
+
+
+def _rand_res(ctx, batch, seed=0):
+    rng = np.random.default_rng(seed)
+    p = np.asarray(ctx.ntt.p)[:, 0][None, :, None]
+    return jnp.asarray(
+        (rng.integers(0, 2**31, size=(*batch, p.shape[1], ctx.n), dtype=np.int64) % p)
+        .astype(np.uint32)
+    )
+
+
+def _enc_both(ctx, n_ct, seed):
+    m = _rand_res(ctx, (n_ct,), seed)
+    u = _rand_res(ctx, (n_ct,), seed + 1)
+    e0 = _rand_res(ctx, (n_ct,), seed + 2)
+    e1 = _rand_res(ctx, (n_ct,), seed + 3)
+    bk = _rand_res(ctx, (), seed + 4)
+    ak = _rand_res(ctx, (), seed + 5)
+    want = ops._encrypt_core_xla(ctx, m, u, e0, e1, bk, ak)
+    got = pallas_ntt.encrypt_fused_pallas(
+        ctx.ntt, m, u, e0, e1, bk, ak, interpret=True
+    )
+    return want, got
+
+
+@pytest.mark.parametrize("n_ct", [55, 18, 2])
+def test_fused_encrypt_parity_production(ctx4096, n_ct):
+    # All three production shapes: flagship encrypt batch, ksk gadget,
+    # keygen pair — bitwise c0 AND c1.
+    want, got = _enc_both(ctx4096, n_ct, seed=n_ct)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+
+@pytest.mark.parametrize("n_ct", [55, 18, 2])
+def test_fused_decrypt_parity_production(ctx4096, n_ct):
+    ctx = ctx4096
+    c0 = _rand_res(ctx, (n_ct,), 100 + n_ct)
+    c1 = _rand_res(ctx, (n_ct,), 200 + n_ct)
+    s = _rand_res(ctx, (), 300 + n_ct)
+    from hefl_tpu.ckks.keys import SecretKey
+
+    want = ops.decrypt(
+        ctx, SecretKey(s_mont=s),
+        ops.Ciphertext(c0=c0, c1=c1, scale=ctx.scale),
+    )
+    got = pallas_ntt.decrypt_fused_pallas(ctx.ntt, c0, c1, s, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_encrypt_core_backend_dispatch(ctx1024):
+    # The ops-level dispatch: backend="pallas" (interpreted on CPU) must be
+    # bitwise-identical to backend="xla" through the REAL pk and the real
+    # sampling streams.
+    ctx, sk, pk = ctx1024
+    m = _rand_res(ctx, (3,), seed=9)
+    u, e0, e1 = ops.encrypt_samples(ctx, jax.random.key(11), (3,))
+    ct_x = ops.encrypt_core(ctx, pk, m, u, e0, e1, backend="xla")
+    ct_p = ops.encrypt_core(ctx, pk, m, u, e0, e1, backend="pallas")
+    np.testing.assert_array_equal(np.asarray(ct_p.c0), np.asarray(ct_x.c0))
+    np.testing.assert_array_equal(np.asarray(ct_p.c1), np.asarray(ct_x.c1))
+    # ...and the fused decrypt inverts the fused encrypt exactly like the
+    # XLA pair does.
+    want = ops.decrypt(ctx, sk, ct_x)
+    got = pallas_ntt.decrypt_fused_pallas(
+        ctx.ntt, ct_p.c0, ct_p.c1, sk.s_mont, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_backend_resolution_rules(ctx1024, monkeypatch):
+    ctx, _, _ = ctx1024
+    # Off-TPU auto resolves to xla without probing.
+    assert he_backend.resolve_he_backend(ctx) == "xla"
+    # Small rings force xla whatever the pin (kernels cannot tile them).
+    small = CkksContext.create(n=256)
+    assert he_backend.resolve_he_backend(small, "pallas") == "xla"
+    # Explicit pin wins on tileable rings.
+    assert he_backend.resolve_he_backend(ctx, "pallas") == "pallas"
+    with pytest.raises(ValueError):
+        he_backend.resolve_he_backend(ctx, "nope")
+    rep = he_backend.he_backend_report()
+    assert rep["backend"] in ("xla", "pallas")
+    assert rep["requested"] in ("auto", "xla", "pallas")

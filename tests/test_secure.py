@@ -222,6 +222,86 @@ def test_round_program_compiles_once_across_rounds():
     )
 
 
+def test_sharded_he_bitwise_matches_replicated(ctx_keys):
+    # ISSUE 4: the ciphertext batch sharded over the virtual 8-device "ct"
+    # mesh must produce BITWISE the same ciphertexts and decrypt residues
+    # as the replicated path — sharding is throughput only, the per-row
+    # math and the sampling key derivation are identical.
+    ctx, sk, pk = ctx_keys
+    from hefl_tpu.ckks import ops as ckks_ops
+    from hefl_tpu.fl.secure import decrypt_sharded, encrypt_params_sharded
+    from hefl_tpu.parallel import make_ct_mesh
+
+    params = _rand_pytree(jax.random.key(31))
+    spec = PackSpec.for_params(params, ctx.n)
+    key = jax.random.key(32)
+    mesh = make_ct_mesh()
+    assert mesh.devices.size == 8  # the conftest virtual topology
+
+    ct_rep = encrypt_params(ctx, pk, params, key)
+    ct_sh = encrypt_params_sharded(ctx, pk, params, key, mesh)
+    np.testing.assert_array_equal(np.asarray(ct_sh.c0), np.asarray(ct_rep.c0))
+    np.testing.assert_array_equal(np.asarray(ct_sh.c1), np.asarray(ct_rep.c1))
+
+    res_rep = ckks_ops.decrypt(ctx, sk, ct_rep)
+    res_sh = decrypt_sharded(ctx, sk, ct_rep, mesh)
+    np.testing.assert_array_equal(np.asarray(res_sh), np.asarray(res_rep))
+
+    # decrypt_average(mesh=...) — the owner-side entry point — end to end.
+    avg_rep = decrypt_average(ctx, sk, ct_rep, 1, spec)
+    avg_sh = decrypt_average(ctx, sk, ct_rep, 1, spec, mesh=mesh)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(avg_sh), jax.tree_util.tree_leaves(avg_rep)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_masked_round_compiles_once_under_pallas_interpret_backend():
+    # No-new-compile guard for the masked secure round under the new
+    # backend selection (ISSUE 4): per-round participation masks are traced
+    # values, so 3 masked rounds with three DIFFERENT masks must share one
+    # executable — with the NTT selector pinned to the new
+    # "pallas-interpret" mode (kernels where tileable, silent XLA fallback
+    # on this small test ring) so the dispatch layer itself is on the path.
+    from hefl_tpu.ckks import ntt as ntt_mod
+    from hefl_tpu.fl.secure import _build_secure_round_fn
+
+    _build_secure_round_fn.cache_clear()
+    num_clients = 2
+    (x, y), _, _ = make_dataset("mnist", seed=6, n_train=num_clients * 8, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    cfg = TrainConfig(epochs=1, batch_size=4, num_classes=10, augment=False,
+                      val_fraction=0.25)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(1))
+    xs_d, ys_d = jnp.asarray(xs), jnp.asarray(ys)
+
+    prev = ntt_mod._BACKEND
+    ntt_mod._BACKEND = "pallas-interpret"
+    try:
+        masks = ([1, 1], [1, 0], [0, 1])
+        for r, m in enumerate(masks):
+            ct, _, _, meta = secure_fedavg_round(
+                model, cfg, mesh, ctx, pk, params, xs_d, ys_d,
+                jax.random.fold_in(jax.random.key(3), r),
+                participation=jnp.asarray(m, jnp.int32),
+            )
+            assert meta.surviving == sum(m)
+        fn = _build_secure_round_fn(
+            model, cfg, mesh, ctx, False, None, num_clients, masked=True
+        )
+        assert fn._cache_size() == 1, (
+            f"masked secure round compiled {fn._cache_size()} times for 3 "
+            "different participation masks under the new backend; masks "
+            "must stay traced values"
+        )
+    finally:
+        ntt_mod._BACKEND = prev
+
+
 def test_train_clients_weights_agree_with_both_aggregators(ctx_keys):
     # The bench cell-6 artifact path: train_clients' stacked weight trees
     # pushed through (a) the plain mean and (b) vmapped encrypt -> lazy

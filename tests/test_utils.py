@@ -160,3 +160,34 @@ def test_checkpoint_extensionless_path_roundtrips(tmp_path):
     save_checkpoint(path, params, 1, jax.random.key(0))
     p2, rnd, _, _ = load_checkpoint(path, params)
     assert rnd == 1
+
+
+def test_he_roofline_rows_are_non_null():
+    # ISSUE 4: the HE int-op/bandwidth roofline must produce fully-populated
+    # rows (no null int_ops / rates) whenever seconds are supplied — the
+    # schema run_perf_smoke.sh gates on every artifact.
+    from hefl_tpu.utils import roofline
+
+    rows = roofline.he_roofline(
+        {"encrypt": 0.05, "aggregate": 0.001, "decrypt": 0.02},
+        n=4096, num_limbs=3, n_ct=55, num_clients=2, encrypt_clients=1,
+        device="cpu",
+    )
+    for phase in ("encrypt", "aggregate", "decrypt"):
+        row = rows[phase]
+        for field in ("seconds", "int_ops", "bytes", "int_ops_per_s", "bytes_per_s"):
+            assert row[field] is not None, (phase, field)
+        assert row["int_ops"] > 0 and row["bytes"] > 0
+        # CPU peaks are placeholders/estimates and must say so.
+        assert row.get("peak_is_estimate") is True
+    # Encrypt dominates decrypt at the same geometry (4 NTTs vs 1).
+    assert rows["encrypt"]["int_ops"] > rows["decrypt"]["int_ops"]
+    geo = rows["geometry"]
+    assert geo == {"n": 4096, "num_limbs": 3, "n_ct": 55,
+                   "num_clients": 2, "encrypt_clients": 1}
+    # Missing seconds keep analytic counts but null the rates.
+    rows2 = roofline.he_roofline(
+        {}, n=4096, num_limbs=3, n_ct=55, num_clients=2, device="cpu"
+    )
+    assert rows2["encrypt"]["int_ops"] > 0
+    assert rows2["encrypt"]["int_ops_per_s"] is None
