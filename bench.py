@@ -133,6 +133,12 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     cache_warm = os.path.isdir(".jax_cache") and len(os.listdir(".jax_cache")) > 0
 
+    # Observability (obs.metrics): count new XLA executables + memory peaks
+    # for the whole run; the snapshot ships in the JSON artifact.
+    from hefl_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.install_jax_listeners()
+
     from hefl_tpu.ckks.keys import keygen
     from hefl_tpu.ckks.packing import PackSpec
     from hefl_tpu.data import iid_contiguous, stack_federated
@@ -413,6 +419,9 @@ def main() -> None:
         f"backend {he_backend_report()['backend']}"
     )
 
+    obs_metrics.record_device_memory(dev)
+    obs_snapshot = obs_metrics.snapshot()
+
     cold = round_stats[0]
     warm = round_stats[1:]
     warm_round_s = float(np.mean([s["total"] for s in warm])) if warm else None
@@ -555,6 +564,9 @@ def main() -> None:
                     4,
                 ),
                 "ciphertext_expansion": round(expansion, 2),
+                # Process-wide observability counters (obs.metrics): new
+                # XLA executables, autoselect outcomes, memory high-water.
+                "obs_metrics": obs_snapshot,
             }
         )
     )

@@ -55,8 +55,8 @@ def _autoselect(ctx) -> str:
         return _AUTO_CHOICE[kind]
     from hefl_tpu.utils.autoselect import load_winner, store_winner
 
-    hit = load_winner("he_backend", kind)
-    if hit is not None and hit["winner"] in HE_BACKENDS:
+    hit = load_winner("he_backend", kind, allowed=HE_BACKENDS)
+    if hit is not None:
         _AUTO_CHOICE[kind] = hit["winner"]
         _AUTO_TIMINGS_MS = hit.get("timings_ms")
         _AUTO_PERSISTED = True

@@ -123,15 +123,22 @@ def encrypt_core(
     Selection: `backend` override > HEFL_HE env > auto (ckks.backend).
     """
     from hefl_tpu.ckks.backend import resolve_he_backend
+    from hefl_tpu.obs import scopes as obs_scopes
 
-    if resolve_he_backend(ctx, backend) == "pallas":
-        from hefl_tpu.ckks import pallas_ntt
+    # Phase scope (obs): both backends' encrypt ops (the 4 NTTs + pointwise
+    # key combination, or the one fused Pallas dispatch) trace as
+    # hefl.encrypt.
+    with jax.named_scope(obs_scopes.ENCRYPT):
+        if resolve_he_backend(ctx, backend) == "pallas":
+            from hefl_tpu.ckks import pallas_ntt
 
-        c0, c1 = pallas_ntt.encrypt_fused_pallas(
-            ctx.ntt, m_res, u, e0, e1, pk.b_mont, pk.a_mont
-        )
-    else:
-        c0, c1 = _encrypt_core_xla(ctx, m_res, u, e0, e1, pk.b_mont, pk.a_mont)
+            c0, c1 = pallas_ntt.encrypt_fused_pallas(
+                ctx.ntt, m_res, u, e0, e1, pk.b_mont, pk.a_mont
+            )
+        else:
+            c0, c1 = _encrypt_core_xla(
+                ctx, m_res, u, e0, e1, pk.b_mont, pk.a_mont
+            )
     return Ciphertext(c0=c0, c1=c1, scale=ctx.scale)
 
 
@@ -157,18 +164,22 @@ def decrypt(ctx: CkksContext, sk: SecretKey, ct: Ciphertext) -> jax.Array:
     c0 + c1*s and the inverse NTT as one dispatch; XLA is the reference.
     """
     from hefl_tpu.ckks.backend import resolve_he_backend
+    from hefl_tpu.obs import scopes as obs_scopes
 
-    if resolve_he_backend(ctx) == "pallas":
-        from hefl_tpu.ckks import pallas_ntt
+    with jax.named_scope(obs_scopes.DECRYPT):
+        if resolve_he_backend(ctx) == "pallas":
+            from hefl_tpu.ckks import pallas_ntt
 
-        return pallas_ntt.decrypt_fused_pallas(ctx.ntt, ct.c0, ct.c1, sk.s_mont)
-    p = jnp.asarray(ctx.ntt.p)
-    d_eval = modular.add_mod(
-        ct.c0,
-        modular.mont_mul(ct.c1, sk.s_mont, p, jnp.asarray(ctx.ntt.pinv_neg)),
-        p,
-    )
-    return ntt_inverse(ctx.ntt, d_eval)
+            return pallas_ntt.decrypt_fused_pallas(
+                ctx.ntt, ct.c0, ct.c1, sk.s_mont
+            )
+        p = jnp.asarray(ctx.ntt.p)
+        d_eval = modular.add_mod(
+            ct.c0,
+            modular.mont_mul(ct.c1, sk.s_mont, p, jnp.asarray(ctx.ntt.pinv_neg)),
+            p,
+        )
+        return ntt_inverse(ctx.ntt, d_eval)
 
 
 def ct_add(ctx: CkksContext, a: Ciphertext, b: Ciphertext) -> Ciphertext:

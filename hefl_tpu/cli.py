@@ -74,7 +74,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="centralized (non-federated) baseline: train one "
                         "model on the whole dataset (train_server analog)")
     p.add_argument("--profile", default=None, metavar="DIR",
-                   help="write a jax.profiler trace of the first round to DIR")
+                   help="write a jax.profiler trace of the first round to DIR "
+                        "(obs.trace / profile_round.py --profile parse it "
+                        "into per-phase device-time attribution)")
+    p.add_argument("--events", default=None, metavar="PATH", dest="events",
+                   help="structured run-event JSONL (obs.events). Default: "
+                        "events.jsonl next to --checkpoint (else ./); "
+                        "--no-events or HEFL_EVENTS=0 disables")
+    p.add_argument("--no-events", action="store_const", const="",
+                   dest="events")
     p.add_argument("--json", action="store_true", help="emit history as JSON lines")
     p.add_argument("--dp-noise", type=float, default=0.0, metavar="SIGMA",
                    help="DP-FedAvg central noise multiplier (0 = off): clip "
@@ -194,6 +202,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         faults=faults,
         max_round_retries=args.max_round_retries,
         retry_backoff_s=args.retry_backoff,
+        events_path=args.events,
     )
 
 

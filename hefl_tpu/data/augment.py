@@ -260,8 +260,8 @@ def _autoselect_backend() -> str:
     from hefl_tpu.utils.autoselect import load_winner, store_winner
 
     kind = str(getattr(jax.devices()[0], "device_kind", "unknown"))
-    hit = load_winner("augment_shift", kind)
-    if hit is not None and hit["winner"] in SHIFT_BACKENDS:
+    hit = load_winner("augment_shift", kind, allowed=SHIFT_BACKENDS)
+    if hit is not None:
         _AUTO_CHOICE = hit["winner"]
         _AUTO_TIMINGS_MS = hit.get("timings_ms")
         _AUTO_PERSISTED = True
@@ -364,29 +364,40 @@ def apply_affine(
     to a float batch [b, H, W, C]. Per-image math only — no cross-image
     coupling — so callers may fold any outer axis (e.g. clients) into the
     batch before calling; the per-image results are unchanged."""
+    from hefl_tpu.obs import scopes as obs_scopes
+
     h, w = images.shape[1], images.shape[2]
-    if backend == "gather":
-        # The fused two-pass bilinear warp: no one-hot matmuls, no
-        # spectral shift — the whole affine is two axis gathers.
-        return _affine_gather(images, s, zx, zy, f)
-    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
-    yv = jnp.arange(h, dtype=jnp.float32)
-    xv = jnp.arange(w, dtype=jnp.float32)
-    # 1) vertical zoom: src_y = (y-cy)/zy + cy
-    src_y = jnp.clip((yv[None, :] - cy) / zy[:, None] + cy, 0, h - 1)
-    wy = _lin_weights(src_y, h)
-    t1 = jnp.einsum("byv,bvwc->bywc", wy, images, preferred_element_type=jnp.float32)
-    # 2) shear: x-shift by delta(y) = tan(s)/zx * (y-cy). The sinc kernel
-    # overshoots at edges (Gibbs), so clamp back to the image's own range —
-    # stages 1 and 3 are convex (bilinear) and cannot overshoot.
-    delta = (jnp.tan(s) / zx)[:, None] * (yv[None, :] - cy)
-    lo = jnp.min(t1, axis=(1, 2), keepdims=True)
-    hi = jnp.max(t1, axis=(1, 2), keepdims=True)
-    t2 = jnp.clip(_shift_rows(t1, delta, backend), lo, hi)
-    # 3) horizontal zoom + flip: src_x = f/zx*(x-cx) + cx
-    src_x = jnp.clip((f / zx)[:, None] * (xv[None, :] - cx) + cx, 0, w - 1)
-    wx = _lin_weights(src_x, w)
-    return jnp.einsum("bxu,byuc->byxc", wx, t2, preferred_element_type=jnp.float32)
+    # Phase scope (obs): every warp op carries the hefl.augment scope in
+    # its HLO metadata, so profiler-trace attribution can bucket augment
+    # device time even when the warp is fused inside the train step.
+    with jax.named_scope(obs_scopes.AUGMENT):
+        if backend == "gather":
+            # The fused two-pass bilinear warp: no one-hot matmuls, no
+            # spectral shift — the whole affine is two axis gathers.
+            return _affine_gather(images, s, zx, zy, f)
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yv = jnp.arange(h, dtype=jnp.float32)
+        xv = jnp.arange(w, dtype=jnp.float32)
+        # 1) vertical zoom: src_y = (y-cy)/zy + cy
+        src_y = jnp.clip((yv[None, :] - cy) / zy[:, None] + cy, 0, h - 1)
+        wy = _lin_weights(src_y, h)
+        t1 = jnp.einsum(
+            "byv,bvwc->bywc", wy, images, preferred_element_type=jnp.float32
+        )
+        # 2) shear: x-shift by delta(y) = tan(s)/zx * (y-cy). The sinc
+        # kernel overshoots at edges (Gibbs), so clamp back to the image's
+        # own range — stages 1 and 3 are convex (bilinear) and cannot
+        # overshoot.
+        delta = (jnp.tan(s) / zx)[:, None] * (yv[None, :] - cy)
+        lo = jnp.min(t1, axis=(1, 2), keepdims=True)
+        hi = jnp.max(t1, axis=(1, 2), keepdims=True)
+        t2 = jnp.clip(_shift_rows(t1, delta, backend), lo, hi)
+        # 3) horizontal zoom + flip: src_x = f/zx*(x-cx) + cx
+        src_x = jnp.clip((f / zx)[:, None] * (xv[None, :] - cx) + cx, 0, w - 1)
+        wx = _lin_weights(src_x, w)
+        return jnp.einsum(
+            "bxu,byuc->byxc", wx, t2, preferred_element_type=jnp.float32
+        )
 
 
 @partial(jax.jit, static_argnames=("shear", "zoom", "flip", "backend"))
@@ -398,8 +409,11 @@ def _random_augment(
     flip: bool,
     backend: str,
 ) -> jnp.ndarray:
+    from hefl_tpu.obs import scopes as obs_scopes
+
     b = images.shape[0]
-    s, zx, zy, f = draw_affine_params(key, b, shear, zoom, flip)
+    with jax.named_scope(obs_scopes.AUGMENT):
+        s, zx, zy, f = draw_affine_params(key, b, shear, zoom, flip)
     return apply_affine(images, s, zx, zy, f, backend)
 
 

@@ -44,9 +44,11 @@ from hefl_tpu.fl import (
     secure_fedavg_round,
     train_centralized,
 )
-from hefl_tpu.fl.faults import POISON_HUGE, POISON_NAN
+from hefl_tpu.fl.faults import POISON_HUGE, POISON_NAN, record_round_meta
 from hefl_tpu.fl.fedavg import masked_mode, pad_federated
 from hefl_tpu.models import count_params, create_model
+from hefl_tpu.obs import events as obs_events
+from hefl_tpu.obs import metrics as obs_metrics
 from hefl_tpu.parallel import client_mesh_size, make_mesh
 from hefl_tpu.utils import PhaseTimer, load_checkpoint, save_checkpoint, save_params
 from hefl_tpu.utils import roofline
@@ -117,6 +119,13 @@ class ExperimentConfig:
     # matching the current round exists. 0 = fail fast (historical).
     max_round_retries: int = 0
     retry_backoff_s: float = 0.5
+    # Structured run-event log (obs.events): one JSONL line per noteworthy
+    # runtime occurrence (phase seconds, exclusions, retries, resumes,
+    # autoselect outcomes, compiles). None = the default location
+    # (events.jsonl next to the checkpoint, else the working directory);
+    # "" = disabled for this run. HEFL_EVENTS=0 disables globally without
+    # code changes (the test suite sets it).
+    events_path: str | None = None
 
 
 def _train_roofline_inputs(module, params, train_cfg: TrainConfig,
@@ -146,6 +155,30 @@ def _train_roofline_inputs(module, params, train_cfg: TrainConfig,
         fwd, steps, train_cfg.epochs, num_clients
     )
     return flops, num_clients * train_cfg.epochs * steps * grp
+
+
+def _record_round_obs(r: int, phases: dict, dev) -> None:
+    """Per-round observability, shared by the centralized and federated
+    paths: phase gauges + round_phase events, the rounds.completed
+    counter, and the device-memory high-water mark."""
+    for ph, sec in phases.items():
+        if ph == "total":
+            continue
+        obs_metrics.gauge(f"phase_seconds.{ph}").set(sec)
+        obs_events.emit("round_phase", round=r, phase=ph, seconds=sec)
+    obs_metrics.counter("rounds.completed").inc()
+    obs_metrics.record_device_memory(dev)
+
+
+def _finish_run_obs(metrics_base: dict, rounds: int) -> dict:
+    """End-of-run observability: the experiment_end event and THIS RUN's
+    metrics (counters as deltas against the run-start baseline — the
+    registry is process-global, and a second experiment in one process
+    must not inherit the first one's counts). Returns the 'obs' record
+    run_experiment embeds in its result."""
+    run_metrics = obs_metrics.snapshot_delta(metrics_base)
+    obs_events.emit("experiment_end", rounds=rounds, metrics=run_metrics)
+    return {"events_path": obs_events.current_path(), "metrics": run_metrics}
 
 
 def _partition(cfg: ExperimentConfig, y: np.ndarray) -> list[np.ndarray]:
@@ -193,6 +226,26 @@ def run_experiment(
             "clients would take their noise shares with them and the "
             "release would be less private than epsilon_spent reports"
         )
+    # Observability (obs): route this run's structured events to one JSONL
+    # file (events.jsonl next to the checkpoint by default; events_path=""
+    # or HEFL_EVENTS=0 disables) and start counting new XLA executables /
+    # device-memory peaks process-wide.
+    obs_metrics.install_jax_listeners()
+    # Per-run counter baseline: the registry is process-global, so this
+    # run's snapshots report deltas against it (a second experiment in the
+    # same process must not inherit the first one's counts).
+    metrics_base = obs_metrics.snapshot()
+    ev_path = cfg.events_path
+    if ev_path is None:
+        ev_path = obs_events.default_events_path(cfg.checkpoint_path)
+    obs_events.configure(ev_path or None)
+    obs_events.emit(
+        "experiment_start",
+        model=cfg.model, dataset=cfg.dataset, num_clients=cfg.num_clients,
+        rounds=cfg.rounds, encrypted=cfg.encrypted,
+        centralized=cfg.centralized, faults=cfg.faults is not None,
+        dp=cfg.dp is not None, seed=cfg.seed,
+    )
     train_cfg = cfg.train
     if cfg.data_dir is not None:
         # The reference's primary workflow: point the tool at a folder of
@@ -264,7 +317,13 @@ def run_experiment(
         if cfg.save_model_path:
             save_params(cfg.save_model_path, params)
             say(f"saved model to {cfg.save_model_path}")
-        return {"history": [record], "final_metrics": record, "params": params}
+        _record_round_obs(0, phases, dev)
+        return {
+            "history": [record],
+            "final_metrics": record,
+            "params": params,
+            "obs": _finish_run_obs(metrics_base, rounds=1),
+        }
 
     xs, ys = stack_federated(x, y, _partition(cfg, y))
     mesh = make_mesh(cfg.num_clients)
@@ -297,6 +356,10 @@ def run_experiment(
             raise ValueError("resume=True requires checkpoint_path")
         params, start_round, key, _ = load_checkpoint(cfg.checkpoint_path, params)
         say(f"resumed from {cfg.checkpoint_path} at round {start_round}")
+        obs_metrics.counter("checkpoint.resumes").inc()
+        obs_events.emit(
+            "checkpoint_resume", round=start_round, path=cfg.checkpoint_path
+        )
 
     dev = jax.devices()[0]
     # Train-phase roofline inputs (geometry is per-configuration, so one
@@ -423,9 +486,18 @@ def run_experiment(
                 break
             except RuntimeError as e:
                 if attempt >= cfg.max_round_retries:
+                    obs_events.emit(
+                        "round_failed", round=r, error=type(e).__name__,
+                        attempts=attempt + 1,
+                    )
                     raise
                 backoff = cfg.retry_backoff_s * (2**attempt)
                 attempt += 1
+                obs_metrics.counter("round.retries").inc()
+                obs_events.emit(
+                    "round_retry", round=r, attempt=attempt,
+                    error=type(e).__name__, backoff_s=round(backoff, 3),
+                )
                 say(
                     f"round {r} failed ({type(e).__name__}: {e}); "
                     f"retry {attempt}/{cfg.max_round_retries} "
@@ -446,12 +518,18 @@ def run_experiment(
                         # restore both so the retried round is identical.
                         params = ck_params
                         key, k_round = jax.random.split(ck_key)
+                        obs_metrics.counter("checkpoint.resumes").inc()
+                        obs_events.emit("checkpoint_resume", round=r, path=ck)
                         say(f"auto-resumed round-{r} state from {ck}")
         with timer.phase("evaluate"):
             results = evaluate(module, params, xt_d, yt)
         if profiling:
             jax.profiler.stop_trace()
             say(f"profiler trace written to {cfg.profile_dir}")
+            # The trace-viewer dump is obs.trace food: profile_round.py's
+            # --profile mode parses the same format into per-phase
+            # device-time rows (trace_attribution).
+            obs_events.emit("profiler_trace", round=r, dir=cfg.profile_dir)
         phases = timer.summary()
         record = {
             "round": r,
@@ -517,6 +595,9 @@ def run_experiment(
             # Per-round robustness record: the participation mask the
             # program applied, surviving count (the decode denominator),
             # per-cause exclusion counts, retries, and the injected faults.
+            # record_round_meta also publishes it to obs (exclusion
+            # counters by cause + one round_robust event line).
+            record_round_meta(meta, r)
             rob: dict[str, Any] = {**meta.record(), "round_retries": attempt}
             if sched is not None:
                 rob["faults"] = {
@@ -532,6 +613,17 @@ def run_experiment(
                 }
             record["robust"] = rob
         history.append(record)
+        _record_round_obs(r, phases, dev)
+        obs_events.emit(
+            "round_end", round=r,
+            accuracy=round(record["accuracy"], 6),
+            f1=round(record["f1"], 6),
+            **(
+                {"surviving": meta.surviving}
+                if robust and meta is not None
+                else {}
+            ),
+        )
         say(
             f"round {r}: acc {record['accuracy']:.4f} f1 {record['f1']:.4f} "
             + (
@@ -552,6 +644,9 @@ def run_experiment(
                 meta={"model": cfg.model, "dataset": cfg.dataset,
                       "num_clients": cfg.num_clients},
             )
+            obs_events.emit(
+                "checkpoint_save", round=r, path=cfg.checkpoint_path
+            )
 
     if cfg.save_model_path:
         # The aggregated-model artifact the reference always writes
@@ -563,6 +658,7 @@ def run_experiment(
     from hefl_tpu.data.augment import backend_report
     from hefl_tpu.fl.fusion import fusion_report
 
+    obs_record = _finish_run_obs(metrics_base, rounds=len(history))
     return {
         "history": history,
         "final_metrics": history[-1] if history else None,
@@ -576,4 +672,9 @@ def run_experiment(
         # Which HE backend (fused Pallas kernels vs the XLA reference) the
         # encrypt/decrypt programs traced with (HEFL_HE; ckks.backend).
         "he_backend": he_backend_report(),
+        # Observability record: where this run's events.jsonl went (None =
+        # disabled) + THIS RUN's metrics (counters as deltas against the
+        # run-start baseline; exclusions by cause, retries, resumes,
+        # compile count, memory high-water).
+        "obs": obs_record,
     }

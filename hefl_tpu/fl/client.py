@@ -45,6 +45,7 @@ import jax.numpy as jnp
 
 from hefl_tpu.data.augment import random_augment, rescale
 from hefl_tpu.fl.config import TrainConfig
+from hefl_tpu.obs import scopes as obs_scopes
 from hefl_tpu.fl.loss import accuracy, cross_entropy, loss_fn
 from hefl_tpu.fl.optimizer import AdamState, adam_init, adam_update
 
@@ -65,8 +66,11 @@ class ClientState:
 
 
 def _eval_metrics(module, params, x_u8, y_onehot):
-    logits = module.apply({"params": params}, rescale(x_u8))
-    return cross_entropy(logits, y_onehot), accuracy(logits, y_onehot)
+    # Phase scope (obs): the per-epoch validation forward is its own trace
+    # bucket, distinct from the surrounding SGD steps.
+    with jax.named_scope(obs_scopes.VAL):
+        logits = module.apply({"params": params}, rescale(x_u8))
+        return cross_entropy(logits, y_onehot), accuracy(logits, y_onehot)
 
 
 def init_client_state(global_params) -> ClientState:
@@ -220,24 +224,31 @@ def _make_train_step(module, cfg: TrainConfig, global_params, sp: _TrainSplit):
     precomputed indices, augment, grad, Adam. `oh_tr` (the training
     labels' one-hot, materialized once outside the scan) is closed over so
     the step body gathers rows instead of re-encoding labels per step."""
-    oh_tr = jax.nn.one_hot(sp.y_tr, cfg.num_classes, dtype=jnp.float32)
+    with jax.named_scope(obs_scopes.SGD_CORE):
+        oh_tr = jax.nn.one_hot(sp.y_tr, cfg.num_classes, dtype=jnp.float32)
 
     def train_step(params, opt, lr_scale, idx, k_aug):
-        xb = rescale(sp.x_tr[idx])
-        if cfg.augment:
-            xb = random_augment(
-                k_aug, xb, shear=cfg.aug_shear, zoom=cfg.aug_zoom,
-                flip=cfg.aug_flip, backend=cfg.aug_backend,
+        # Phase scopes (obs): the SGD core is one trace bucket; the augment
+        # warp nests its own deeper hefl.augment scope inside it and wins
+        # attribution for its ops. Scopes wrap only this leaf step body —
+        # the scan/while op at the call site stays scope-less on purpose
+        # (obs.scopes docstring).
+        with jax.named_scope(obs_scopes.SGD_CORE):
+            xb = rescale(sp.x_tr[idx])
+            if cfg.augment:
+                xb = random_augment(
+                    k_aug, xb, shear=cfg.aug_shear, zoom=cfg.aug_zoom,
+                    flip=cfg.aug_flip, backend=cfg.aug_backend,
+                )
+            oh = oh_tr[idx]
+            grads, (ce, acc) = jax.grad(
+                lambda p: loss_fn(module, p, xb, oh, global_params, cfg.prox_mu),
+                has_aux=True,
+            )(params)
+            params, opt = adam_update(
+                grads, opt, params, cfg.lr, cfg.lr_decay, lr_scale,
+                warmup_steps=cfg.warmup_steps,
             )
-        oh = oh_tr[idx]
-        grads, (ce, acc) = jax.grad(
-            lambda p: loss_fn(module, p, xb, oh, global_params, cfg.prox_mu),
-            has_aux=True,
-        )(params)
-        params, opt = adam_update(
-            grads, opt, params, cfg.lr, cfg.lr_decay, lr_scale,
-            warmup_steps=cfg.warmup_steps,
-        )
         return params, opt, (ce, acc)
 
     return train_step
@@ -270,10 +281,12 @@ def _local_train_epochs_flat(
     cross-client vmap)."""
     sp = _train_split(cfg, x, y)
     e = int(epoch_keys.shape[0])
-    perms, aug_keys = _epoch_streams(epoch_keys, sp)
-    flat_perm = perms.reshape(e * sp.steps, sp.grp)
-    flat_aug = aug_keys.reshape(e * sp.steps)
-    is_end = (jnp.arange(e * sp.steps) % sp.steps) == sp.steps - 1
+    with jax.named_scope(obs_scopes.SGD_CORE):
+        # Shuffle/key prologue is SGD machinery: attribute it there.
+        perms, aug_keys = _epoch_streams(epoch_keys, sp)
+        flat_perm = perms.reshape(e * sp.steps, sp.grp)
+        flat_aug = aug_keys.reshape(e * sp.steps)
+        is_end = (jnp.arange(e * sp.steps) % sp.steps) == sp.steps - 1
     train_step = _make_train_step(module, cfg, global_params, sp)
 
     def flat_step(carry, inp):
@@ -306,9 +319,14 @@ def _local_train_epochs_flat(
         def interior(p, o, s0):
             return p, o, s0, jnp.zeros((4,), jnp.float32)
 
-        params_run, opt_run, st, mets = jax.lax.cond(
-            end, boundary, interior, params_run, opt_run, st
-        )
+        # The cond IS the validation phase: its per-iteration trace event
+        # covers only the executed branch (boundary = the val eval +
+        # callback transition; interior = a tuple passthrough), so scoping
+        # the cond attributes val cost without swallowing interior steps.
+        with jax.named_scope(obs_scopes.VAL):
+            params_run, opt_run, st, mets = jax.lax.cond(
+                end, boundary, interior, params_run, opt_run, st
+            )
         return (params_run, opt_run, st), mets
 
     (_, _, final), mets = jax.lax.scan(
@@ -334,21 +352,25 @@ def _local_train_epochs_nested(
         return (params, opt, lr_scale), (ce, acc)
 
     def epoch_step(st: ClientState, k_epoch):
-        k_perm, k_aug = jax.random.split(k_epoch)
-        perm = jax.random.permutation(k_perm, sp.n_tr)[
-            : sp.steps * sp.grp
-        ].reshape(sp.steps, sp.grp)
-        aug_keys = jax.random.split(k_aug, sp.steps)
+        with jax.named_scope(obs_scopes.SGD_CORE):
+            k_perm, k_aug = jax.random.split(k_epoch)
+            perm = jax.random.permutation(k_perm, sp.n_tr)[
+                : sp.steps * sp.grp
+            ].reshape(sp.steps, sp.grp)
+            aug_keys = jax.random.split(k_aug, sp.steps)
         (params, opt, _), _ = jax.lax.scan(
             scan_step, (st.params, st.opt, st.lr_scale), (perm, aug_keys)
         )
-        frozen = st.stopped
-        eval_params = jax.tree_util.tree_map(
-            lambda new, old: jnp.where(frozen, old, new), params, st.params
-        )
-        val_loss, val_acc = _eval_metrics(module, eval_params, sp.x_va, sp.onehot_va)
-        return _epoch_update(cfg, st, params, opt, val_loss, val_acc,
-                             track_best_acc)
+        with jax.named_scope(obs_scopes.VAL):
+            frozen = st.stopped
+            eval_params = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(frozen, old, new), params, st.params
+            )
+            val_loss, val_acc = _eval_metrics(
+                module, eval_params, sp.x_va, sp.onehot_va
+            )
+            return _epoch_update(cfg, st, params, opt, val_loss, val_acc,
+                                 track_best_acc)
 
     return jax.lax.scan(epoch_step, state, epoch_keys)
 

@@ -263,3 +263,26 @@ class RoundMeta:
             "excluded": dict(self.excluded),
             "sanitized": self.sanitized,
         }
+
+
+def record_round_meta(meta: RoundMeta, round_index: int | None = None) -> RoundMeta:
+    """Publish one masked round's outcome to the observability layer
+    (obs.events / obs.metrics): per-cause exclusion counters and one
+    `round_robust` event line. The driver calls this once per masked round;
+    the chaos gate then asserts the events.jsonl counters match the fault
+    schedule exactly. Returns `meta` so call sites can thread it through.
+    """
+    from hefl_tpu.obs import events, metrics
+
+    for cause, n in meta.excluded.items():
+        if n:
+            metrics.counter(f"exclusions.{cause}").inc(n)
+    metrics.counter("rounds.masked").inc()
+    if meta.surviving < meta.num_clients:
+        metrics.counter("clients.excluded").inc(meta.num_clients - meta.surviving)
+    events.emit(
+        "round_robust",
+        **({"round": round_index} if round_index is not None else {}),
+        **meta.record(),
+    )
+    return meta

@@ -27,6 +27,7 @@ from hefl_tpu.data.augment import rescale
 from hefl_tpu.fl.client import local_train
 from hefl_tpu.fl.config import TrainConfig
 from hefl_tpu.fl.faults import RoundMeta, exclusion_bits, poison_tree
+from hefl_tpu.obs import scopes as obs_scopes
 from hefl_tpu.parallel import (
     client_axes,
     client_mesh_size,
@@ -144,15 +145,19 @@ def _build_round_fn(
         if stacked:
             return p_out, mets
         if not masked:
-            local_mean = jax.tree_util.tree_map(
-                lambda t: jnp.mean(t, axis=0), p_out
+            # Phase scope (obs): the FedAvg mean + collective.
+            with jax.named_scope(obs_scopes.AGGREGATE):
+                local_mean = jax.tree_util.tree_map(
+                    lambda t: jnp.mean(t, axis=0), p_out
+                )
+                return pmean_tree(local_mean, axes), mets
+        with jax.named_scope(obs_scopes.SANITIZE):
+            p_out = jax.vmap(poison_tree)(p_out, po_blk)
+            bits = exclusion_bits(cfg, gp, p_out, m_blk)
+        with jax.named_scope(obs_scopes.AGGREGATE):
+            new_gp, _ = masked_mean_tree(
+                gp, p_out, bits == 0, axes, total * int(x_blk.shape[0])
             )
-            return pmean_tree(local_mean, axes), mets
-        p_out = jax.vmap(poison_tree)(p_out, po_blk)
-        bits = exclusion_bits(cfg, gp, p_out, m_blk)
-        new_gp, _ = masked_mean_tree(
-            gp, p_out, bits == 0, axes, total * int(x_blk.shape[0])
-        )
         return new_gp, mets, bits
 
     in_specs = (P(), P(axes), P(axes), P(axes))
@@ -388,7 +393,11 @@ def _predict_all(module, params, x_u8, batch_size: int):
     xb = x_u8.reshape(nb, batch_size, *x_u8.shape[1:])
 
     def step(_, xc):
-        return None, jax.nn.softmax(module.apply({"params": params}, rescale(xc)))
+        # Phase scope (obs): test-set inference is the hefl.evaluate bucket.
+        with jax.named_scope(obs_scopes.EVALUATE):
+            return None, jax.nn.softmax(
+                module.apply({"params": params}, rescale(xc))
+            )
 
     _, probs = jax.lax.scan(step, None, xb)
     return probs.reshape(nb * batch_size, probs.shape[-1])

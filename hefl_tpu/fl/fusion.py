@@ -68,6 +68,7 @@ from hefl_tpu.fl.client import (
 from hefl_tpu.fl.config import TrainConfig
 from hefl_tpu.fl.optimizer import adam_update
 from hefl_tpu.models.folded import fold_clients, stack_params, unfold_clients
+from hefl_tpu.obs import scopes as obs_scopes
 
 FUSION_BACKENDS = ("fused", "vmap")
 
@@ -128,23 +129,26 @@ def fused_train(
         x_va, y_va = x_blk[:, :n_val], y_blk[:, :n_val]
     else:  # degenerate config: validate on the train slice
         x_va, y_va = x_tr, y_tr
-    oh_tr = jax.nn.one_hot(y_tr, cfg.num_classes, dtype=jnp.float32)
-    oh_va = jax.nn.one_hot(y_va, cfg.num_classes, dtype=jnp.float32)
-    xva_folded = fold_clients(rescale(x_va))
+    with jax.named_scope(obs_scopes.SGD_CORE):
+        oh_tr = jax.nn.one_hot(y_tr, cfg.num_classes, dtype=jnp.float32)
+    with jax.named_scope(obs_scopes.VAL):
+        oh_va = jax.nn.one_hot(y_va, cfg.num_classes, dtype=jnp.float32)
+        xva_folded = fold_clients(rescale(x_va))
     bk = resolve_shift_backend(cfg.aug_backend) if cfg.augment else None
 
     e = int(cfg.epochs)
-    epoch_keys = jax.vmap(lambda k: jax.random.split(k, e))(k_blk)  # [cpd, E]
-    # Per-client shuffles + augment keys from the SAME derivation as the
-    # vmap path (client._epoch_streams), vmapped over the block — same
-    # keys => same index/augment streams by construction. The split's
-    # static geometry is shared across clients, so client 0's split
-    # describes the whole block (the throwaway one-hot it builds is DCE'd).
-    sp0 = _train_split(cfg, x_blk[0], y_blk[0])
-    perms, aug_keys = jax.vmap(lambda ek: _epoch_streams(ek, sp0))(epoch_keys)
-    flat_perm = perms.reshape(cpd, e * steps, grp).swapaxes(0, 1)  # [T,cpd,grp]
-    flat_aug = aug_keys.reshape(cpd, e * steps).swapaxes(0, 1)     # [T,cpd]
-    is_end = (jnp.arange(e * steps) % steps) == steps - 1
+    with jax.named_scope(obs_scopes.SGD_CORE):
+        epoch_keys = jax.vmap(lambda k: jax.random.split(k, e))(k_blk)  # [cpd, E]
+        # Per-client shuffles + augment keys from the SAME derivation as the
+        # vmap path (client._epoch_streams), vmapped over the block — same
+        # keys => same index/augment streams by construction. The split's
+        # static geometry is shared across clients, so client 0's split
+        # describes the whole block (the throwaway one-hot it builds is DCE'd).
+        sp0 = _train_split(cfg, x_blk[0], y_blk[0])
+        perms, aug_keys = jax.vmap(lambda ek: _epoch_streams(ek, sp0))(epoch_keys)
+        flat_perm = perms.reshape(cpd, e * steps, grp).swapaxes(0, 1)  # [T,cpd,grp]
+        flat_aug = aug_keys.reshape(cpd, e * steps).swapaxes(0, 1)     # [T,cpd]
+        is_end = (jnp.arange(e * steps) % steps) == steps - 1
 
     params0 = stack_params(global_params, cpd)
     st0 = jax.vmap(init_client_state)(params0)
@@ -173,16 +177,22 @@ def fused_train(
     def flat_step(carry, inp):
         params_run, opt_run, st = carry
         idx, k_aug, end = inp  # [cpd, grp], [cpd], scalar bool
-        xb = jnp.take_along_axis(
-            x_tr, idx[:, :, None, None, None], axis=1
-        )                                      # [cpd, grp, H, W, ch]
-        xb = fold_clients(rescale(xb))         # [cpd*grp, H, W, ch]
+        # Phase scopes (obs): the fused step carries the same hefl.sgd_core
+        # / hefl.augment / hefl.val buckets as the vmap reference, so trace
+        # attribution is backend-independent. Leaf regions only — the scan
+        # at the bottom of fused_train stays scope-less.
+        with jax.named_scope(obs_scopes.SGD_CORE):
+            xb = jnp.take_along_axis(
+                x_tr, idx[:, :, None, None, None], axis=1
+            )                                      # [cpd, grp, H, W, ch]
+            xb = fold_clients(rescale(xb))         # [cpd*grp, H, W, ch]
         if cfg.augment:
-            s, zx, zy, f = jax.vmap(
-                lambda k: draw_affine_params(
-                    k, grp, cfg.aug_shear, cfg.aug_zoom, cfg.aug_flip
-                )
-            )(k_aug)                           # each [cpd, grp]
+            with jax.named_scope(obs_scopes.AUGMENT):
+                s, zx, zy, f = jax.vmap(
+                    lambda k: draw_affine_params(
+                        k, grp, cfg.aug_shear, cfg.aug_zoom, cfg.aug_flip
+                    )
+                )(k_aug)                           # each [cpd, grp]
             xb = apply_affine(
                 xb, s.reshape(-1), zx.reshape(-1), zy.reshape(-1),
                 f.reshape(-1), bk,
@@ -205,19 +215,20 @@ def fused_train(
                 )
             return loss
 
-        grads = jax.grad(block_loss)(params_run)
-        new_params, new_opt = jax.vmap(
-            lambda g, o, p, ls: adam_update(
-                g, o, p, cfg.lr, cfg.lr_decay, ls,
-                warmup_steps=cfg.warmup_steps,
-            )
-        )(grads, opt_run, params_run, st.lr_scale)
-        if keep is not None:
-            # Scheduled-out clients flow through the GEMM but update
-            # nothing — the multiplicative update mask of the fused step.
-            new_params = _mask_select(keep, new_params, params_run)
-            new_opt = _mask_select(keep, new_opt, opt_run)
-        params_run, opt_run = new_params, new_opt
+        with jax.named_scope(obs_scopes.SGD_CORE):
+            grads = jax.grad(block_loss)(params_run)
+            new_params, new_opt = jax.vmap(
+                lambda g, o, p, ls: adam_update(
+                    g, o, p, cfg.lr, cfg.lr_decay, ls,
+                    warmup_steps=cfg.warmup_steps,
+                )
+            )(grads, opt_run, params_run, st.lr_scale)
+            if keep is not None:
+                # Scheduled-out clients flow through the GEMM but update
+                # nothing — the multiplicative update mask of the fused step.
+                new_params = _mask_select(keep, new_params, params_run)
+                new_opt = _mask_select(keep, new_opt, opt_run)
+            params_run, opt_run = new_params, new_opt
 
         def boundary(p, o, s0):
             frozen = s0.stopped
@@ -229,9 +240,12 @@ def fused_train(
         def interior(p, o, s0):
             return p, o, s0, jnp.zeros((cpd, 4), jnp.float32)
 
-        params_run, opt_run, st, mets = jax.lax.cond(
-            end, boundary, interior, params_run, opt_run, st
-        )
+        # Scoping the cond attributes the executed branch (the val eval on
+        # boundary steps) to hefl.val — see fl.client's flat layout.
+        with jax.named_scope(obs_scopes.VAL):
+            params_run, opt_run, st, mets = jax.lax.cond(
+                end, boundary, interior, params_run, opt_run, st
+            )
         return (params_run, opt_run, st), mets
 
     (_, _, final), mets = jax.lax.scan(
@@ -277,8 +291,8 @@ def _autoselect_backend() -> str:
         return _AUTO_CHOICE[kind]
     from hefl_tpu.utils.autoselect import load_winner, store_winner
 
-    hit = load_winner("client_fusion", kind)
-    if hit is not None and hit["winner"] in FUSION_BACKENDS:
+    hit = load_winner("client_fusion", kind, allowed=FUSION_BACKENDS)
+    if hit is not None:
         _AUTO_CHOICE[kind] = hit["winner"]
         _AUTO_TIMINGS_MS = hit.get("timings_ms")
         _AUTO_PERSISTED = True

@@ -48,6 +48,7 @@ from hefl_tpu.fl.fedavg import (
 )
 from hefl_tpu.ckks.modular import add_mod as modular_add_mod
 from hefl_tpu.ckks.modular import barrett_mod, barrett_mu
+from hefl_tpu.obs import scopes as obs_scopes
 from hefl_tpu.parallel import (
     client_axes,
     client_mesh_size,
@@ -66,9 +67,10 @@ def encrypt_params(
     The analog of `encrypt_export_weights` (FLPyfhelin.py:200-228), minus the
     export: 55 batched ciphertexts instead of 222,722 scalar Pyfhel calls.
     """
-    blocks = pack_pytree(params, ctx.n)
-    m_res = encoding.encode(ctx.ntt, blocks, ctx.scale)
-    return ops.encrypt(ctx, pk, m_res, key)
+    with jax.named_scope(obs_scopes.ENCRYPT):
+        blocks = pack_pytree(params, ctx.n)
+        m_res = encoding.encode(ctx.ntt, blocks, ctx.scale)
+        return ops.encrypt(ctx, pk, m_res, key)
 
 
 def _lazy_sum_mod(x: jax.Array, p: jax.Array) -> jax.Array:
@@ -297,18 +299,21 @@ def decrypt_average(
         )
     else:
         surviving = int(num_clients)
-    if mesh is not None:
-        res = decrypt_sharded(ctx, sk, ct_sum, mesh)
-    else:
-        res = ops.decrypt(ctx, sk, ct_sum)
-    denom = ct_sum.scale * surviving
-    if exact:
-        blocks = jnp.asarray(
-            encoding.decode_exact(ctx.ntt, np.asarray(res), denom).astype(np.float32)
-        )
-    else:
-        blocks = encoding.decode(ctx.ntt, res, denom)
-    return unpack_blocks(blocks, spec)
+    with jax.named_scope(obs_scopes.DECRYPT):
+        if mesh is not None:
+            res = decrypt_sharded(ctx, sk, ct_sum, mesh)
+        else:
+            res = ops.decrypt(ctx, sk, ct_sum)
+        denom = ct_sum.scale * surviving
+        if exact:
+            blocks = jnp.asarray(
+                encoding.decode_exact(
+                    ctx.ntt, np.asarray(res), denom
+                ).astype(np.float32)
+            )
+        else:
+            blocks = encoding.decode(ctx.ntt, res, denom)
+        return unpack_blocks(blocks, spec)
 
 
 def secure_fedavg_round(
@@ -501,59 +506,68 @@ def _build_secure_round_fn(
         if dp is not None:
             from hefl_tpu.fl.dp import dp_sanitize
 
-            p_out, _ = jax.vmap(
-                lambda k, t: dp_sanitize(k, gp, t, dp, num_clients)
-            )(kd_blk, p_out)
+            with jax.named_scope(obs_scopes.SANITIZE):
+                p_out, _ = jax.vmap(
+                    lambda k, t: dp_sanitize(k, gp, t, dp, num_clients)
+                )(kd_blk, p_out)
         if masked:
             # Fault injection corrupts the UPLOAD (after training and after
             # any DP sanitize — a poisoned client does not run its own
             # defenses); POISON_NONE is a pure where-select no-op.
-            p_out = jax.vmap(poison_tree)(p_out, po_blk)
-        # Saturation diagnostic on exactly what gets encoded (the packed
-        # blocks); XLA CSEs the duplicate pack with encrypt_params' own.
-        ov_one = lambda prm: encoding.encode_overflow_count(  # noqa: E731
-            pack_pytree(prm, ctx.n), ctx.scale
-        )
-        overflow = jax.vmap(ov_one)(p_out)             # [cpd] int32
-        cts = encrypt_stack(ctx, pk, p_out, ke_blk)    # [cpd, n_ct, L, N]
-        if masked:
-            bits = exclusion_bits(cfg, gp, p_out, m_blk, overflow)
-            keep = bits == 0
-            sel = keep.reshape((-1, 1, 1, 1))
-            cts = Ciphertext(
-                c0=jnp.where(sel, cts.c0, jnp.uint32(0)),
-                c1=jnp.where(sel, cts.c1, jnp.uint32(0)),
-                scale=cts.scale,
+            with jax.named_scope(obs_scopes.SANITIZE):
+                p_out = jax.vmap(poison_tree)(p_out, po_blk)
+        # Phase scope (obs): pack/encode/overflow-count + the encrypt core
+        # are one hefl.encrypt trace bucket.
+        with jax.named_scope(obs_scopes.ENCRYPT):
+            # Saturation diagnostic on exactly what gets encoded (the packed
+            # blocks); XLA CSEs the duplicate pack with encrypt_params' own.
+            ov_one = lambda prm: encoding.encode_overflow_count(  # noqa: E731
+                pack_pytree(prm, ctx.n), ctx.scale
             )
-        local = aggregate_encrypted(ctx, cts)          # this device's clients
-        p = jnp.asarray(ctx.ntt.p)
-        # Per-device partials are canonical (< p < 2**27), so each stage of
-        # the hierarchical reduce starts canonical: the fused XLA
-        # all-reduce's lazy reduction is sound up to MAX_PSUM_CLIENTS
-        # devices per axis (the ppermute ring lifts an axis past that), and
-        # on a ("hosts", "clients") mesh the client axis reduces over ICI
-        # before one cross-host (DCN) fold — see hierarchical_psum_mod.
-        outs = (
-            Ciphertext(
-                c0=hierarchical_psum_mod(local.c0, p, axes),
-                c1=hierarchical_psum_mod(local.c1, p, axes),
-                scale=local.scale,
-            ),
-            mets,
-            overflow,
-        )
+            overflow = jax.vmap(ov_one)(p_out)             # [cpd] int32
+            cts = encrypt_stack(ctx, pk, p_out, ke_blk)    # [cpd, n_ct, L, N]
+        with jax.named_scope(obs_scopes.PSUM_AGGREGATE):
+            if masked:
+                with jax.named_scope(obs_scopes.SANITIZE):
+                    bits = exclusion_bits(cfg, gp, p_out, m_blk, overflow)
+                keep = bits == 0
+                sel = keep.reshape((-1, 1, 1, 1))
+                cts = Ciphertext(
+                    c0=jnp.where(sel, cts.c0, jnp.uint32(0)),
+                    c1=jnp.where(sel, cts.c1, jnp.uint32(0)),
+                    scale=cts.scale,
+                )
+            local = aggregate_encrypted(ctx, cts)      # this device's clients
+            p = jnp.asarray(ctx.ntt.p)
+            # Per-device partials are canonical (< p < 2**27), so each stage
+            # of the hierarchical reduce starts canonical: the fused XLA
+            # all-reduce's lazy reduction is sound up to MAX_PSUM_CLIENTS
+            # devices per axis (the ppermute ring lifts an axis past that),
+            # and on a ("hosts", "clients") mesh the client axis reduces
+            # over ICI before one cross-host (DCN) fold — see
+            # hierarchical_psum_mod.
+            outs = (
+                Ciphertext(
+                    c0=hierarchical_psum_mod(local.c0, p, axes),
+                    c1=hierarchical_psum_mod(local.c1, p, axes),
+                    scale=local.scale,
+                ),
+                mets,
+                overflow,
+            )
         if masked:
             outs = outs + (bits,)
         if with_plain_reference:
-            if masked:
-                ref, _ = masked_mean_tree(
-                    gp, p_out, keep, axes, n_dev * int(x_blk.shape[0])
-                )
-            else:
-                local_mean = jax.tree_util.tree_map(
-                    lambda t: jnp.mean(t, axis=0), p_out
-                )
-                ref = pmean_tree(local_mean, axes)
+            with jax.named_scope(obs_scopes.AGGREGATE):
+                if masked:
+                    ref, _ = masked_mean_tree(
+                        gp, p_out, keep, axes, n_dev * int(x_blk.shape[0])
+                    )
+                else:
+                    local_mean = jax.tree_util.tree_map(
+                        lambda t: jnp.mean(t, axis=0), p_out
+                    )
+                    ref = pmean_tree(local_mean, axes)
             outs = outs + (ref,)
         return outs
 

@@ -1,0 +1,179 @@
+"""Structured run events: one JSONL file per experiment run.
+
+PRs 1-4 grew real operational machinery — fault exclusion, retry/backoff,
+checkpoint auto-resume, backend auto-selection, the no-new-compile guard —
+but its evidence flowed only through `say()` prints and scattered artifact
+keys. This module is the one sink: every noteworthy runtime occurrence is
+one JSON line in `events.jsonl` (written next to the checkpoint by
+default), so a CI gate or a post-mortem can query "how many clients were
+excluded, and why" instead of grepping stdout.
+
+One event = one line:
+
+    {"ts": <unix seconds>, "event": "<kind>", ...fields}
+
+Event kinds emitted by the current producers (fields beyond ts/event):
+
+    experiment_start   model, dataset, num_clients, rounds, encrypted, faults
+    round_phase        round, phase, seconds            (one per timed phase)
+    round_end          round, accuracy, f1, surviving
+    round_robust       round, participation, surviving, excluded{cause: n},
+                       sanitized                        (masked rounds only)
+    round_retry        round, attempt, error, backoff_s
+    checkpoint_resume  round, path
+    checkpoint_save    round, path
+    autoselect         decision, device_kind, winner, source(probe|cache),
+                       timings_ms
+    compile            seconds                          (one per NEW executable
+                       XLA built — the no-new-compile guard, queryable)
+    profiler_trace     dir                              (a --profile trace was
+                       written; feed it to obs.trace)
+    experiment_end     rounds, device_peak_bytes, metrics{...snapshot}
+
+The writer is process-global (`configure` + module-level `emit`) so deep
+producers (fl.faults, utils.autoselect, the compile listener) need no
+plumbing; `HEFL_EVENTS=0` disables every write without code changes (the
+test suite and short CLI runs set it). Appending is line-buffered append
+— a crashed run keeps every line emitted before the crash.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, IO
+
+SCHEMA_VERSION = 1
+
+# Fields every line carries; gates can demand them without knowing kinds.
+REQUIRED_FIELDS = ("ts", "event")
+
+
+def enabled() -> bool:
+    """The HEFL_EVENTS=0 kill switch (checked per emit, so a test can flip
+    it with monkeypatch.setenv and never touch producer code)."""
+    return os.environ.get("HEFL_EVENTS", "1") != "0"
+
+
+def _jsonable(obj: Any):
+    """numpy scalars/arrays -> python; anything else stringified (an event
+    writer must never raise into the training loop)."""
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    if hasattr(obj, "item"):
+        return obj.item()
+    return str(obj)
+
+
+class EventLog:
+    """Append-only JSONL writer. Opens lazily on first emit; one instance
+    per run file (use `configure` for the process-global log)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f: IO[str] | None = None
+
+    def emit(self, event: str, **fields: Any) -> dict:
+        rec = {"ts": round(time.time(), 6), "event": event, **fields}
+        if self._f is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a", buffering=1)
+            if os.path.getsize(self.path) == 0:
+                self._f.write(
+                    json.dumps(
+                        {
+                            "ts": rec["ts"],
+                            "event": "log_open",
+                            "schema_version": SCHEMA_VERSION,
+                            "pid": os.getpid(),
+                        }
+                    )
+                    + "\n"
+                )
+        self._f.write(json.dumps(rec, default=_jsonable) + "\n")
+        return rec
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+# --------------------------------------------------------------------------
+# Process-global log: deep producers emit without plumbing a handle.
+# --------------------------------------------------------------------------
+
+_LOG: EventLog | None = None
+
+
+def configure(path: str | None) -> EventLog | None:
+    """Point the process-global log at `path` (None/"" disables). Returns
+    the new log. The previous log, if any, is closed."""
+    global _LOG
+    if _LOG is not None:
+        _LOG.close()
+    _LOG = EventLog(path) if path else None
+    return _LOG
+
+
+def current_path() -> str | None:
+    return _LOG.path if _LOG is not None else None
+
+
+def emit(event: str, **fields: Any) -> dict | None:
+    """Emit to the process-global log; silently a no-op when no log is
+    configured or HEFL_EVENTS=0. Never raises into the caller."""
+    if _LOG is None or not enabled():
+        return None
+    try:
+        return _LOG.emit(event, **fields)
+    except OSError:
+        return None
+
+
+def default_events_path(checkpoint_path: str | None) -> str:
+    """Where events.jsonl lives by default: next to the checkpoint when the
+    run has one (the 'durable artifacts of this run' directory), else the
+    working directory."""
+    if checkpoint_path:
+        return os.path.join(os.path.dirname(checkpoint_path) or ".", "events.jsonl")
+    return "events.jsonl"
+
+
+def read_events(path: str, strict: bool = True) -> list[dict]:
+    """Parse an events.jsonl back into records (the gate/test-side half).
+
+    strict=True raises ValueError on any malformed line or any line missing
+    the required fields — a truncated or hand-edited log must fail the CI
+    gate loudly, not quietly shrink its counters.
+    """
+    out: list[dict] = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                if strict:
+                    raise ValueError(f"{path}:{i}: malformed event line: {e}") from e
+                continue
+            if not isinstance(rec, dict):
+                # Valid JSON but not an event object (e.g. a bare number
+                # from a torn write): same failure class as malformed.
+                if strict:
+                    raise ValueError(
+                        f"{path}:{i}: event line is not an object: {rec!r}"
+                    )
+                continue
+            if strict and not all(k in rec for k in REQUIRED_FIELDS):
+                raise ValueError(
+                    f"{path}:{i}: event line missing required fields "
+                    f"{REQUIRED_FIELDS}: {rec}"
+                )
+            out.append(rec)
+    return out

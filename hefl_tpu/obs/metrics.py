@@ -1,0 +1,162 @@
+"""Process-wide counter/gauge registry.
+
+The numeric companion to `obs.events`: events answer "what happened,
+when"; this registry answers "how many, how much, right now" — per-round
+phase seconds, client exclusions by cause, retry attempts, checkpoint
+resumes, autoselect probe outcomes, XLA compile count, device-memory
+high-water marks. Every measurement driver (bench.py, profile_round.py,
+experiment.py, the chaos gate) embeds `snapshot()` in its artifact so the
+counters are queryable evidence, not process-local trivia.
+
+Names are dotted strings ("exclusions.nonfinite", "jax.new_executables").
+The registry is deliberately flat and dependency-free — no labels, no
+exposition format — because the consumers are JSON artifacts and tests,
+not a Prometheus scraper.
+
+`install_jax_listeners()` hooks `jax.monitoring`: every
+`/jax/core/compile/backend_compile_duration` event is a NEW executable the
+backend built, so `jax.new_executables` surfaces the no-new-compile guard
+(tests assert a masked round's executable count stays flat across rounds)
+as a queryable metric instead of a test-only lru_cache inspection.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+
+class Counter:
+    """Monotonic count. inc() only; value survives snapshot()."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value: float = 0
+
+    def inc(self, n: float = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-written value, with a high-water helper for peaks."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value: float | None = None
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def max(self, v: float) -> None:
+        self.value = v if self.value is None else max(self.value, v)
+
+
+class MetricsRegistry:
+    """Thread-safe name -> metric map. Metrics are created on first use so
+    producers never need registration order."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Counter | Gauge] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Counter()
+            elif not isinstance(m, Counter):
+                raise TypeError(f"metric {name!r} already registered as gauge")
+            return m
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Gauge()
+            elif not isinstance(m, Gauge):
+                raise TypeError(f"metric {name!r} already registered as counter")
+            return m
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-ready {name: value}; the record artifacts embed."""
+        with self._lock:
+            return {k: m.value for k, m in sorted(self._metrics.items())}
+
+    def snapshot_delta(self, baseline: dict[str, Any]) -> dict[str, Any]:
+        """Per-run view of a process-global registry: counters report the
+        increase since `baseline` (a snapshot() taken at run start), gauges
+        report their current value. Without this, the second experiment in
+        one process (e.g. the chaos gate's clean twin + faulted run) would
+        fold every earlier run into its own 'per-run' counters."""
+        with self._lock:
+            return {
+                k: (
+                    m.value - (baseline.get(k) or 0)
+                    if isinstance(m, Counter)
+                    else m.value
+                )
+                for k, m in sorted(self._metrics.items())
+            }
+
+    def reset(self) -> None:
+        """Drop every metric (tests only — production never resets)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+REGISTRY = MetricsRegistry()
+
+# Module-level conveniences: the spelling every producer uses.
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+snapshot = REGISTRY.snapshot
+snapshot_delta = REGISTRY.snapshot_delta
+reset = REGISTRY.reset
+
+
+# --------------------------------------------------------------------------
+# JAX compile accounting: one monitoring listener, installed once.
+# --------------------------------------------------------------------------
+
+_LISTENERS_INSTALLED = False
+
+
+def _on_event_duration(name: str, duration: float, **_kw: Any) -> None:
+    if name == "/jax/core/compile/backend_compile_duration":
+        counter("jax.new_executables").inc()
+        counter("jax.compile_seconds").inc(round(duration, 4))
+        from hefl_tpu.obs import events
+
+        events.emit("compile", seconds=round(duration, 4))
+
+
+def install_jax_listeners() -> None:
+    """Register the compile-count listener (idempotent). Call early in any
+    driver that wants `jax.new_executables` to cover its whole run."""
+    global _LISTENERS_INSTALLED
+    if _LISTENERS_INSTALLED:
+        return
+    from jax._src import monitoring
+
+    monitoring.register_event_duration_secs_listener(_on_event_duration)
+    _LISTENERS_INSTALLED = True
+
+
+def record_device_memory(device: Any = None) -> int | None:
+    """Fold the device's current peak allocation into the
+    `device.peak_bytes_in_use` high-water gauge. Returns the peak, or None
+    where the backend exposes no memory stats (CPU) — the gauge then stays
+    unset rather than lying with a 0."""
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if not stats:
+        return None
+    peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+    if peak is None:
+        return None
+    gauge("device.peak_bytes_in_use").max(int(peak))
+    return int(peak)

@@ -1,0 +1,75 @@
+"""Canonical phase-scope names for trace-native attribution.
+
+The round program's phases are annotated IN the program with
+`jax.named_scope(<one of these>)`. A named scope rides the JAX name stack
+into every lowered op's HLO metadata (`op_name="jit(f)/.../hefl.augment/
+dot_general"`), which means two independent consumers see the same names:
+
+  * HLO text — the scopes survive jit/compile, so a test can assert the
+    annotation didn't get lost in a refactor (tests/test_obs.py);
+  * profiler traces — device-op trace events carry the HLO instruction
+    name, and `obs.trace` joins them back to these scopes through the
+    compiled program's own metadata, giving per-phase device time from ONE
+    program instead of subtraction across separately-compiled ablations.
+
+Annotation rule (load-bearing): wrap only LEAF compute regions — never a
+region that CALLS `lax.scan` / `lax.while_loop`, because the loop op
+itself would then inherit the scope and its one trace event (spanning
+every iteration, including other phases' work) would swallow the
+attribution. A loop op deliberately left scope-less shows up as a
+container whose children are attributed individually; `obs.trace` counts
+only the time no attributed child covers. Wrapping a `lax.cond` call IS
+intended (e.g. the per-epoch validation cond): its per-iteration event is
+the executed branch only.
+"""
+
+from __future__ import annotations
+
+# One component of the op_name path; must not contain "/" (the path
+# separator) so a scope is always exactly one component.
+PREFIX = "hefl."
+
+AUGMENT = "hefl.augment"              # affine-warp data augmentation
+SGD_CORE = "hefl.sgd_core"            # fwd/bwd/Adam + batch gather/shuffles
+VAL = "hefl.val"                      # per-epoch validation + callbacks
+SANITIZE = "hefl.sanitize"            # poison injection + exclusion predicates
+ENCRYPT = "hefl.encrypt"              # pack/encode + CKKS encrypt core
+PSUM_AGGREGATE = "hefl.psum_aggregate"  # ciphertext masking + lazy sum + psum
+AGGREGATE = "hefl.aggregate"          # plaintext (masked) FedAvg mean + pmean
+DECRYPT = "hefl.decrypt"              # c0 + c1*s, iNTT, decode, unpack
+EVALUATE = "hefl.evaluate"            # test-set forward + softmax
+
+# Canonical ordering for tables; the trace parser buckets ANY "hefl.*"
+# component it finds, so adding a scope never requires touching the parser.
+PHASES = (
+    AUGMENT,
+    SGD_CORE,
+    VAL,
+    SANITIZE,
+    ENCRYPT,
+    PSUM_AGGREGATE,
+    AGGREGATE,
+    DECRYPT,
+    EVALUATE,
+)
+
+
+import re
+
+# A scope may appear decorated by transformation context in the op_name
+# path ("vmap(hefl.sgd_core)", "transpose(jvp(...))/hefl.val"), so scopes
+# are extracted by substring, not by exact path-component match.
+_SCOPE_RE = re.compile(r"hefl\.[A-Za-z0-9_]+")
+
+
+def is_phase_scope(component: str) -> bool:
+    """Is this op_name path component one of ours?"""
+    return component.startswith(PREFIX)
+
+
+def scope_of(op_name: str) -> str | None:
+    """Deepest hefl.* scope in an HLO `op_name` path (scopes nest — e.g.
+    augment inside sgd_core — and the innermost is the attribution). Path
+    components run outer -> inner, so the last match wins."""
+    hits = _SCOPE_RE.findall(op_name)
+    return hits[-1] if hits else None

@@ -49,15 +49,48 @@ def _read_all(path: str) -> dict:
         return {}
 
 
-def load_winner(decision: str, device_kind: str) -> dict | None:
-    """-> {"winner": str, "timings_ms": {...}} or None on any miss."""
+def load_winner(
+    decision: str, device_kind: str, allowed=None
+) -> dict | None:
+    """-> {"winner": str, "timings_ms": {...}} or None on any miss.
+
+    `allowed` (a container of valid winner names) rejects stale entries —
+    e.g. a renamed backend — HERE, before the cache hit is published to
+    obs: a rejected entry must not log a 'cache' outcome the caller then
+    overrides with a fresh probe."""
     path = _cache_file()
     if path is None:
         return None
     rec = _read_all(path).get(decision, {}).get(device_kind)
-    if isinstance(rec, dict) and isinstance(rec.get("winner"), str):
+    if (
+        isinstance(rec, dict)
+        and isinstance(rec.get("winner"), str)
+        and (allowed is None or rec["winner"] in allowed)
+    ):
+        _record_outcome(decision, device_kind, rec["winner"], "cache",
+                        rec.get("timings_ms"))
         return rec
     return None
+
+
+def _record_outcome(
+    decision: str, device_kind: str, winner: str, source: str,
+    timings_ms: dict | None,
+) -> None:
+    """Publish one auto-selection outcome (probe run or persisted-cache
+    hit) to obs.events / obs.metrics — every backend decision a run makes
+    is queryable instead of buried in a report dict."""
+    from hefl_tpu.obs import events, metrics
+
+    metrics.counter(f"autoselect.{source}").inc()
+    events.emit(
+        "autoselect",
+        decision=decision,
+        device_kind=device_kind,
+        winner=winner,
+        source=source,
+        timings_ms=timings_ms,
+    )
 
 
 def store_winner(
@@ -66,6 +99,9 @@ def store_winner(
 ) -> None:
     """Best-effort atomic upsert; failures are silent (persistence is an
     optimization — the in-process cache already holds the choice)."""
+    # The probe RAN whether or not its winner can be persisted: record the
+    # outcome before the cache-dir early-out.
+    _record_outcome(decision, device_kind, winner, "probe", timings_ms)
     path = _cache_file()
     if path is None:
         return

@@ -92,6 +92,26 @@ def mfu(flops: float | None, seconds: float | None, device: Any) -> float | None
     return flops / seconds / peak
 
 
+def clamp_utilization(rec: dict[str, Any], field: str) -> dict[str, Any]:
+    """Utilization > 1.0 is physically impossible: the row is clamped to
+    1.0, keeps the raw value under `<field>_raw`, and carries
+    `timing_floor_suspect: true` — no artifact ships an impossible
+    utilization unflagged (run_perf_smoke.sh gates this).
+
+    The flag is the generic impossible-row marker, not a diagnosis: the
+    cause is EITHER a sub-`TIMING_FLOOR_S` phase the host clock could not
+    resolve (fixed by `steady_seconds`' repetition chain) OR an understated
+    peak model — `peak_is_placeholder` / `peak_is_estimate` on the same row
+    says which. A long phase flagged here with a placeholder peak is a
+    peak-table problem, not a timing one."""
+    v = rec.get(field)
+    if v is not None and v > 1.0:
+        rec[f"{field}_raw"] = v
+        rec[field] = 1.0
+        rec["timing_floor_suspect"] = True
+    return rec
+
+
 def phase_stats(
     seconds: float | None,
     flops: float | None = None,
@@ -103,7 +123,9 @@ def phase_stats(
     downstream checkers can demand the schema without demanding hardware."""
     peak, placeholder = peak_flops(device) if device is not None else (None, False)
     rec: dict[str, Any] = {
-        "seconds": round(seconds, 4) if seconds is not None else None,
+        # 6 decimals: a 0.3 ms phase must round to 0.0003, never to a bare
+        # 0.0 that reads as "did not run".
+        "seconds": round(seconds, 6) if seconds is not None else None,
         "flops": flops,
         "mfu": (
             round(flops / seconds / peak, 5)
@@ -116,7 +138,7 @@ def phase_stats(
     }
     if placeholder and rec["mfu"] is not None:
         rec["peak_is_placeholder"] = True
-    return rec
+    return clamp_utilization(rec, "mfu")
 
 
 def train_flops_per_round(
@@ -160,6 +182,15 @@ def backend_compare(
     return rows
 
 
+# Below this, one dispatch's wall clock is dominated by timer/dispatch
+# noise, not the phase: a 0.3 ms aggregate timed as a single call produced
+# the impossible util_vs_peak_int_ops 6.19 row (>1) in PROFILE.md. Phases
+# under the floor are re-timed over a back-to-back repetition chain.
+TIMING_FLOOR_S = 2e-3
+_TIMING_TARGET_S = 2e-2   # total measured span a repetition chain aims for
+_MAX_TIMING_REPS = 1000
+
+
 def steady_seconds(fn, *args, reps: int = 3, warmup: int = 1) -> float:
     """Warm-then-min-over-reps wall-clock of `fn(*args)` (blocking).
 
@@ -168,6 +199,13 @@ def steady_seconds(fn, *args, reps: int = 3, warmup: int = 1) -> float:
     drift between artifacts. `bench_ntt.py` deliberately uses a device-side
     `fori_loop` rep chain instead — per-dispatch amortization, see its
     docstring — and is the one intentional exception.
+
+    Sub-millisecond phases (below TIMING_FLOOR_S) are automatically
+    re-timed as a chain of N back-to-back calls with one trailing block —
+    the per-call average of a span long enough for the host timer to
+    resolve — so no artifact ever publishes a single-dispatch timing of a
+    phase the clock cannot see (the source of PROFILE.md's impossible
+    `util_vs_peak_int_ops: 6.19` aggregate row).
     """
     import time
 
@@ -180,7 +218,18 @@ def steady_seconds(fn, *args, reps: int = 3, warmup: int = 1) -> float:
         t0 = time.perf_counter()
         jax.block_until_ready(fn(*args))
         best = min(best, time.perf_counter() - t0)
-    return best
+    if best >= TIMING_FLOOR_S or best <= 0.0:
+        return best
+    inner = min(max(int(_TIMING_TARGET_S / best), 2), _MAX_TIMING_REPS)
+    best_avg = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(inner):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        best_avg = min(best_avg, (time.perf_counter() - t0) / inner)
+    return best_avg
 
 
 # ---------------------------------------------------------------------------
@@ -266,7 +315,7 @@ def he_phase_stats(
     int_ops = counts["int_ops"]
     byts = counts["bytes"]
     rec: dict[str, Any] = {
-        "seconds": round(seconds, 4) if seconds is not None else None,
+        "seconds": round(seconds, 6) if seconds is not None else None,
         "int_ops": int_ops,
         "bytes": byts,
         "int_ops_per_s": round(int_ops / seconds, 1) if seconds else None,
@@ -277,7 +326,7 @@ def he_phase_stats(
     }
     if estimate and rec["util_vs_peak_int_ops"] is not None:
         rec["peak_is_estimate"] = True
-    return rec
+    return clamp_utilization(rec, "util_vs_peak_int_ops")
 
 
 def he_roofline(

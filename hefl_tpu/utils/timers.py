@@ -28,9 +28,19 @@ class PhaseTimer:
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        # Host-side span (obs): the driver phase also shows up as a
+        # TraceAnnotation in profiler traces, so a --profile trace carries
+        # the wall-clock phase brackets alongside the device-op events.
+        try:
+            import jax.profiler
+
+            span = jax.profiler.TraceAnnotation(f"hefl.phase.{name}")
+        except ImportError:  # timers stay usable without jax
+            span = contextlib.nullcontext()
         start = time.perf_counter()
         try:
-            yield
+            with span:
+                yield
         finally:
             dt = time.perf_counter() - start
             if name not in self._elapsed:

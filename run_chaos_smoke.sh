@@ -11,8 +11,14 @@
 #       the final aggregated params must be finite;
 #   (c) the faulted run's final accuracy is within tolerance of the clean
 #       run's (a NaN client that leaks into the aggregate fails this hard);
-#   (d) the simulated device-loss round really exercised the retry path.
-# Artifact: CHAOS_SMOKE.json (both accuracy curves + per-round exclusions).
+#   (d) the simulated device-loss round really exercised the retry path;
+#   (e) the structured run-event log (ISSUE 5): the faulted run writes
+#       events.jsonl, whose per-round round_robust exclusion records and
+#       round_retry events must match the deterministic fault schedule
+#       EXACTLY, and whose experiment_end metrics counters must equal the
+#       schedule's totals.
+# Artifact: CHAOS_SMOKE.json (both accuracy curves + per-round exclusions
+# + the events.jsonl cross-check).
 # Wired into run_tpu_suite.sh as stage 0b (CPU-only, no TPU probe needed).
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -24,23 +30,34 @@ if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
   export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 fi
 
+# The faulted run's structured events land here; the clean twin runs with
+# the writer disabled so the log is exactly one run's evidence.
+workdir=$(mktemp -d)
+trap 'rm -rf "$workdir"' EXIT
+export HEFL_EVENTS=1
+export CHAOS_EVENTS_PATH="$workdir/events.jsonl"
+
 python - <<'PY'
 import dataclasses
 import json
 import math
+import os
 import sys
 
 import numpy as np
 
 from hefl_tpu.experiment import run_experiment
 from hefl_tpu.fl import schedule_for_round
+from hefl_tpu.obs import events as obs_events
 from hefl_tpu.presets import PRESETS
 
 ACC_TOL = 0.20   # tiny-run noise floor; a leaked NaN fails by orders more
 
-cfg = PRESETS["chaos-smoke"]
+events_path = os.environ["CHAOS_EVENTS_PATH"]
+cfg = dataclasses.replace(PRESETS["chaos-smoke"], events_path=events_path)
 clean_cfg = dataclasses.replace(
-    cfg, faults=None, train=dataclasses.replace(cfg.train, on_overflow="warn")
+    cfg, faults=None, events_path="",
+    train=dataclasses.replace(cfg.train, on_overflow="warn"),
 )
 
 print("chaos smoke: clean twin ...", flush=True)
@@ -97,12 +114,94 @@ if abs(acc_clean - acc_chaos) > ACC_TOL:
         f"{acc_chaos:.4f} (tol {ACC_TOL})"
     )
 
+# (e) events.jsonl cross-check: the structured log must tell the SAME
+# story as the fault schedule — per-round exclusions, retries, and the
+# experiment_end counters, all exactly.
+events_summary = {}
+try:
+    evs = obs_events.read_events(events_path)  # strict parse
+except (OSError, ValueError) as e:
+    evs = []
+    fail.append(f"events.jsonl unusable: {e}")
+if evs:
+    robust_by_round = {
+        e["round"]: e for e in evs if e["event"] == "round_robust"
+    }
+    retries_by_round = {}
+    for e in evs:
+        if e["event"] == "round_retry":
+            retries_by_round[e["round"]] = retries_by_round.get(e["round"], 0) + 1
+    sched_drop = sched_nan = 0
+    for r in range(cfg.rounds):
+        sched = schedule_for_round(cfg.faults, r, cfg.num_clients)
+        n_drop = int(np.count_nonzero(sched.dropped))
+        n_nan = int(np.count_nonzero(sched.poison))
+        sched_drop += n_drop
+        sched_nan += n_nan
+        rob = robust_by_round.get(r)
+        if rob is None:
+            fail.append(f"events.jsonl: no round_robust event for round {r}")
+            continue
+        if rob["excluded"].get("scheduled", 0) != n_drop:
+            fail.append(
+                f"events.jsonl round {r}: scheduled exclusions "
+                f"{rob['excluded'].get('scheduled')} != schedule {n_drop}"
+            )
+        if rob["excluded"].get("nonfinite", 0) != n_nan:
+            fail.append(
+                f"events.jsonl round {r}: nonfinite exclusions "
+                f"{rob['excluded'].get('nonfinite')} != schedule {n_nan}"
+            )
+        expect_excl = set(np.flatnonzero(sched.dropped).tolist()) | set(
+            np.flatnonzero(sched.poison).tolist()
+        )
+        got_excl = {
+            i for i, p in enumerate(rob["participation"]) if not p
+        }
+        if got_excl != expect_excl:
+            fail.append(
+                f"events.jsonl round {r}: excluded {sorted(got_excl)} != "
+                f"schedule {sorted(expect_excl)}"
+            )
+    for r in cfg.faults.fail_rounds:
+        if retries_by_round.get(r, 0) < 1:
+            fail.append(
+                f"events.jsonl: device-loss round {r} logged no round_retry"
+            )
+    end = [e for e in evs if e["event"] == "experiment_end"]
+    counters = (end[-1].get("metrics") or {}) if end else {}
+    if counters.get("exclusions.scheduled", 0) != sched_drop:
+        fail.append(
+            f"events.jsonl counters: exclusions.scheduled "
+            f"{counters.get('exclusions.scheduled')} != schedule {sched_drop}"
+        )
+    if counters.get("exclusions.nonfinite", 0) != sched_nan:
+        fail.append(
+            f"events.jsonl counters: exclusions.nonfinite "
+            f"{counters.get('exclusions.nonfinite')} != schedule {sched_nan}"
+        )
+    if counters.get("round.retries", 0) != sum(retries_by_round.values()):
+        fail.append(
+            "events.jsonl counters: round.retries "
+            f"{counters.get('round.retries')} != logged retry events "
+            f"{sum(retries_by_round.values())}"
+        )
+    events_summary = {
+        "events": len(evs),
+        "retries": sum(retries_by_round.values()),
+        "exclusions_scheduled": sched_drop,
+        "exclusions_nonfinite": sched_nan,
+        "counters": counters,
+    }
+
 artifact = {
     "preset": "chaos-smoke",
     "acc_clean_by_round": [h["accuracy"] for h in clean["history"]],
     "acc_chaos_by_round": [h["accuracy"] for h in chaos["history"]],
     "rounds": rounds,
     "acc_tolerance": ACC_TOL,
+    # The structured-event cross-check (events.jsonl vs fault schedule).
+    "events_check": events_summary,
     "passed": not fail,
     "failures": fail,
 }
@@ -117,6 +216,7 @@ if fail:
 print(
     f"chaos smoke OK: clean {acc_clean:.4f} vs chaos {acc_chaos:.4f}, "
     "exclusions match the schedule exactly, no unflagged NaNs, "
-    "device-loss retry exercised"
+    "device-loss retry exercised, events.jsonl counters match the "
+    "fault schedule"
 )
 PY

@@ -15,7 +15,19 @@
 #   (e) the he_backend record and the he_roofline rows (ISSUE 4): every HE
 #       phase (encrypt/aggregate/decrypt) must carry non-null int_ops /
 #       int_ops_per_s / bytes / bytes_per_s, and the decrypt/evaluate
-#       phase_roofline rows must no longer ship flops/mfu nulls.
+#       phase_roofline rows must no longer ship flops/mfu nulls;
+#   (f) trace-native attribution (ISSUE 5): profile_round runs with
+#       --profile, and the resulting trace_attribution record must carry
+#       attribution_source: "trace", per-phase device-time rows from ONE
+#       program's profiler trace, and a round-program sum-vs-wall
+#       agreement within 15%;
+#   (g) no utilization row anywhere in the artifact exceeds 1.0 without a
+#       timing_floor_suspect flag (the impossible 6.19x aggregate row
+#       class of bug);
+#   (h) structured run events (ISSUE 5): a tiny CLI experiment writes
+#       events.jsonl, which must parse strictly (obs.events.read_events)
+#       and carry the experiment_start/round_phase/round_end/
+#       experiment_end schema.
 # Wired into run_tpu_suite.sh as stage 0 (cheap pre-stage, no backend
 # probe needed — both harnesses pin themselves to CPU in smoke mode).
 set -euo pipefail
@@ -36,13 +48,22 @@ if [ -f "$workdir/mfu_probe.json.orig" ]; then
   mv "$workdir/mfu_probe.json.orig" mfu_probe.json
 fi
 
-PROFILE_SMOKE=1 python profile_round.py > "$workdir/profile_smoke.out"
+PROFILE_SMOKE=1 python profile_round.py --profile "$workdir/trace" \
+  > "$workdir/profile_smoke.out"
 
-python - "$workdir/mfu_probe.json" "$workdir/profile_smoke.out" <<'PY'
+# (h) events.jsonl end-to-end: one tiny CPU experiment through the CLI
+# with the event writer pointed into the workdir.
+JAX_PLATFORMS=cpu HEFL_EVENTS=1 python -m hefl_tpu.cli \
+  --dataset mnist --model smallcnn --num-clients 2 --rounds 1 --epochs 1 \
+  --batch-size 8 --n-train 64 --n-test 32 --he-n 256 --no-save-model \
+  --events "$workdir/events.jsonl" --json > "$workdir/events_run.out"
+
+python - "$workdir/mfu_probe.json" "$workdir/profile_smoke.out" \
+  "$workdir/events.jsonl" <<'PY'
 import json
 import sys
 
-mfu_path, prof_path = sys.argv[1:3]
+mfu_path, prof_path, events_path = sys.argv[1:4]
 fail = []
 
 probe = json.load(open(mfu_path))
@@ -155,6 +176,77 @@ else:
                     f"profile: phase_roofline[{phase!r}].{field} is still "
                     "null — the HE roofline must fill it"
                 )
+    # (f) trace-native attribution: per-phase device time from ONE
+    # program's trace, agreeing with the traced wall clock.
+    if rec.get("attribution_source") != "trace":
+        fail.append(
+            "profile: attribution_source is "
+            f"{rec.get('attribution_source')!r}, expected 'trace' "
+            "(--profile ran)"
+        )
+    ta = rec.get("trace_attribution")
+    if not isinstance(ta, dict) or not ta.get("rows"):
+        fail.append("profile: missing trace_attribution rows")
+    else:
+        for ph in ("hefl.sgd_core", "hefl.encrypt", "hefl.psum_aggregate",
+                   "hefl.decrypt", "hefl.evaluate"):
+            row = ta["rows"].get(ph)
+            if not isinstance(row, dict) or not row.get("device_seconds"):
+                fail.append(
+                    f"profile: trace_attribution missing/empty row {ph!r}"
+                )
+        agree = ta.get("round_wall_agreement")
+        if not isinstance(agree, (int, float)) or not 0.85 <= agree <= 1.15:
+            fail.append(
+                "profile: trace rows do not sum to within 15% of the "
+                f"traced round's wall clock (agreement {agree})"
+            )
+        if ta.get("suspected_truncated"):
+            fail.append(
+                "profile: trace hit the event-converter cap — attribution "
+                "undercounts; shrink the traced geometry"
+            )
+
+    # (g) no unflagged utilization > 1.0 anywhere in the artifact.
+    def scan_utils(node, path="rec"):
+        if isinstance(node, dict):
+            for field in ("mfu", "util_vs_peak_int_ops"):
+                v = node.get(field)
+                if isinstance(v, (int, float)) and v > 1.0:
+                    fail.append(
+                        f"{path}.{field} = {v} > 1.0 shipped without "
+                        "clamping (timing_floor_suspect)"
+                    )
+            for k, v in node.items():
+                scan_utils(v, f"{path}.{k}")
+
+    scan_utils(rec)
+    scan_utils(probe, "mfu_probe")
+
+# (h) events.jsonl schema gate: strict parse + required event kinds.
+sys.path.insert(0, ".")
+from hefl_tpu.obs import events as obs_events  # noqa: E402
+
+try:
+    evs = obs_events.read_events(events_path)  # strict: malformed line fails
+except (OSError, ValueError) as e:
+    evs = []
+    fail.append(f"events.jsonl unusable: {e}")
+if evs:
+    kinds = {e["event"] for e in evs}
+    for needed in ("experiment_start", "round_phase", "round_end",
+                   "experiment_end"):
+        if needed not in kinds:
+            fail.append(f"events.jsonl: missing {needed!r} event")
+    phases_seen = {e["phase"] for e in evs if e["event"] == "round_phase"}
+    if "train+encrypt+aggregate" not in phases_seen:
+        fail.append(
+            "events.jsonl: no round_phase for the fused train phase "
+            f"(saw {sorted(phases_seen)})"
+        )
+    end = [e for e in evs if e["event"] == "experiment_end"]
+    if end and not isinstance(end[-1].get("metrics"), dict):
+        fail.append("events.jsonl: experiment_end carries no metrics snapshot")
 
 if fail:
     print("PERF SMOKE FAILED:")
@@ -163,6 +255,8 @@ if fail:
     sys.exit(1)
 print(
     "perf smoke OK: MFU + roofline schema present on both artifacts, "
-    "he_roofline rows non-null, no unflagged negative attribution rows"
+    "he_roofline rows non-null, no unflagged negative attribution rows, "
+    "trace_attribution from one program agrees with the traced wall "
+    "clock, no unflagged utilization > 1, events.jsonl schema valid"
 )
 PY

@@ -23,6 +23,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 # micro-timing path instead of a previous run's cached choice.
 os.environ.setdefault("HEFL_CLIENT_FUSION", "vmap")
 os.environ.setdefault("HEFL_AUTOSELECT_CACHE", "0")
+# Suite default: no events.jsonl writers (obs.events). Tests that exercise
+# the event log flip this per-test with monkeypatch.setenv and point the
+# writer at a tmp path explicitly.
+os.environ.setdefault("HEFL_EVENTS", "0")
 
 import jax  # noqa: E402
 

@@ -1,0 +1,324 @@
+"""Observability subsystem (hefl_tpu.obs): trace parser on the committed
+golden fixture, named-scope survival through jit for both client-fusion
+backends, the events JSONL log, the metrics registry, and the roofline
+timing-floor guards."""
+
+import dataclasses
+import gzip
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hefl_tpu.obs import events as obs_events
+from hefl_tpu.obs import metrics as obs_metrics
+from hefl_tpu.obs import scopes as obs_scopes
+from hefl_tpu.obs import trace as obs_trace
+from hefl_tpu.utils import roofline
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+GOLDEN_TRACE = os.path.join(FIXTURES, "golden.trace.json.gz")
+GOLDEN_HLO = os.path.join(FIXTURES, "golden_hlo.txt")
+
+
+# ---------------------------------------------------------------- scopes
+
+
+def test_scope_of_takes_deepest_and_handles_decoration():
+    assert obs_scopes.scope_of(
+        "jit(f)/jit(main)/hefl.sgd_core/jit(aug)/hefl.augment/gather"
+    ) == "hefl.augment"
+    # Transformations decorate the component: still found.
+    assert obs_scopes.scope_of(
+        "jit(f)/vmap(hefl.sgd_core)/vmap(jit(_shuffle))/while"
+    ) == "hefl.sgd_core"
+    assert obs_scopes.scope_of("jit(f)/jit(main)/reduce_sum") is None
+
+
+# ----------------------------------------------------- golden-trace parse
+
+
+def _golden_hlo() -> str:
+    with open(GOLDEN_HLO) as f:
+        return f.read()
+
+
+def test_hlo_scope_map_covers_instructions_and_call_aliases():
+    sm = obs_trace.hlo_scope_map(_golden_hlo())
+    assert sm["dot.1"] == "hefl.sgd_core"       # vmap(hefl.sgd_core) decoration
+    assert sm["fusion.2"] == "hefl.encrypt"
+    assert sm["tanh.4.clone"] == "hefl.val"
+    # call.N carries no metadata; resolved through to_apply=%parallel_X.
+    assert sm["call.3"] == "hefl.val"
+    assert "mystery.9" not in sm
+    assert "while.5" not in sm
+
+
+def test_golden_trace_bucketing():
+    rec = obs_trace.trace_attribution(GOLDEN_TRACE, [_golden_hlo()])
+    rows = rec["rows"]
+    # Same op on two overlapping worker threads: union, not sum.
+    assert rows["hefl.sgd_core"] == {"device_seconds": 150e-6, "op_events": 2}
+    assert rows["hefl.encrypt"]["device_seconds"] == pytest.approx(50e-6)
+    # The call wrapper and the inner op it spans merge into one val union.
+    assert rows["hefl.val"] == {"device_seconds": 40e-6, "op_events": 2}
+    # The scope-less mystery op and the scope-less container's uncovered
+    # remainder land in unattributed; attributed time is never re-counted.
+    assert rec["unattributed_s"] == pytest.approx(260e-6)
+    assert rec["device_total_s"] == pytest.approx(500e-6)
+    # Events of modules without supplied HLO are excluded entirely.
+    assert set(rec["modules"]) == {"jit_golden"}
+    assert rec["op_events"] == 7
+    assert obs_trace.attributed_sum_s(rec) == pytest.approx(500e-6)
+
+
+def test_trace_rows_order_follows_canonical_phases():
+    rec = obs_trace.trace_attribution(GOLDEN_TRACE, [_golden_hlo()])
+    found = list(rec["rows"])
+    canon = [p for p in obs_scopes.PHASES if p in rec["rows"]]
+    assert found == canon
+
+
+def test_corrupt_and_truncated_traces_fail_loud(tmp_path):
+    # Truncated gzip.
+    blob = open(GOLDEN_TRACE, "rb").read()
+    bad = tmp_path / "truncated.trace.json.gz"
+    bad.write_bytes(blob[: len(blob) // 2])
+    with pytest.raises(obs_trace.TraceParseError):
+        obs_trace.trace_attribution(str(bad), [_golden_hlo()])
+    # Valid gzip, malformed JSON.
+    bad2 = tmp_path / "garbage.trace.json.gz"
+    bad2.write_bytes(gzip.compress(b"{not json"))
+    with pytest.raises(obs_trace.TraceParseError):
+        obs_trace.trace_attribution(str(bad2), [_golden_hlo()])
+    # Valid JSON, no traceEvents.
+    bad3 = tmp_path / "empty.trace.json.gz"
+    bad3.write_bytes(gzip.compress(json.dumps({"traceEvents": []}).encode()))
+    with pytest.raises(obs_trace.TraceParseError):
+        obs_trace.trace_attribution(str(bad3), [_golden_hlo()])
+    # A logdir with no trace at all.
+    with pytest.raises(obs_trace.TraceParseError):
+        obs_trace.trace_attribution(str(tmp_path / "nothing"), [_golden_hlo()])
+    # Events present but none for the supplied modules.
+    with pytest.raises(obs_trace.TraceParseError):
+        obs_trace.trace_attribution(
+            GOLDEN_TRACE, ["HloModule jit_absent\nENTRY %m { ROOT %r = () tuple() }"]
+        )
+    # No HLO at all.
+    with pytest.raises(obs_trace.TraceParseError):
+        obs_trace.trace_attribution(GOLDEN_TRACE, [])
+
+
+# --------------------------------------- scopes survive jit, both backends
+
+
+@pytest.mark.parametrize("backend", ["vmap", "fused"])
+def test_named_scopes_survive_jit(backend):
+    """The phase annotations must reach the compiled HLO for BOTH
+    cross-client training backends — lose them and trace attribution
+    silently degrades to one 'unattributed' bucket."""
+    from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+    from hefl_tpu.fl import TrainConfig
+    from hefl_tpu.fl.fedavg import _build_round_fn, replicate_on
+    from hefl_tpu.models import create_model
+    from hefl_tpu.parallel import make_mesh
+
+    (x, y), _, _ = make_dataset("mnist", seed=0, n_train=16, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(len(x), 2))
+    module, params = create_model("smallcnn", rng=jax.random.key(0))
+    cfg = TrainConfig(
+        epochs=1, batch_size=4, num_classes=10, val_fraction=0.25,
+        client_fusion=backend,
+    )
+    mesh = make_mesh(2)
+    gp = replicate_on(mesh, params)
+    keys = jax.random.split(jax.random.key(1), 2)
+    fn = _build_round_fn(module, cfg, mesh)
+    # metadata_preserving_compile: a persistent-cache-deserialized
+    # executable answers as_text() without op_name metadata, which would
+    # make this test flaky across warm suite reruns.
+    with obs_trace.metadata_preserving_compile():
+        txt = fn.lower(gp, jnp.asarray(xs), jnp.asarray(ys), keys).compile().as_text()
+    for scope in (obs_scopes.SGD_CORE, obs_scopes.AUGMENT, obs_scopes.VAL,
+                  obs_scopes.AGGREGATE):
+        assert scope in txt, f"{scope} lost in jit under {backend} backend"
+    sm = obs_trace.hlo_scope_map(txt)
+    assert set(sm.values()) >= {
+        obs_scopes.SGD_CORE, obs_scopes.AUGMENT, obs_scopes.VAL,
+        obs_scopes.AGGREGATE,
+    }
+
+
+# ----------------------------------------------------------------- events
+
+
+def test_event_log_roundtrip(tmp_path):
+    path = tmp_path / "sub" / "events.jsonl"
+    log = obs_events.EventLog(str(path))
+    log.emit("round_phase", round=0, phase="train", seconds=1.5)
+    log.emit("round_robust", round=0, excluded={"scheduled": 2},
+             participation=np.asarray([1, 0], np.int32))
+    log.close()
+    evs = obs_events.read_events(str(path))
+    assert [e["event"] for e in evs] == ["log_open", "round_phase", "round_robust"]
+    assert evs[0]["schema_version"] == obs_events.SCHEMA_VERSION
+    assert evs[1]["seconds"] == 1.5
+    # numpy payloads are converted, not crashed on.
+    assert evs[2]["participation"] == [1, 0]
+    assert all("ts" in e for e in evs)
+
+
+def test_global_emit_honors_opt_out(tmp_path, monkeypatch):
+    path = tmp_path / "events.jsonl"
+    obs_events.configure(str(path))
+    try:
+        monkeypatch.setenv("HEFL_EVENTS", "0")
+        assert obs_events.emit("compile", seconds=1.0) is None
+        monkeypatch.setenv("HEFL_EVENTS", "1")
+        assert obs_events.emit("compile", seconds=1.0) is not None
+    finally:
+        obs_events.configure(None)
+    evs = obs_events.read_events(str(path))
+    assert [e["event"] for e in evs] == ["log_open", "compile"]
+    # Unconfigured global log: emit is a no-op, never an error.
+    assert obs_events.emit("compile", seconds=2.0) is None
+    assert obs_events.current_path() is None
+
+
+def test_read_events_strict_fails_on_malformed(tmp_path):
+    path = tmp_path / "events.jsonl"
+    path.write_text('{"ts": 1, "event": "ok"}\nnot json\n')
+    with pytest.raises(ValueError):
+        obs_events.read_events(str(path))
+    path.write_text('{"ts": 1}\n')  # missing required "event"
+    with pytest.raises(ValueError):
+        obs_events.read_events(str(path))
+    assert obs_events.read_events(str(path), strict=False) == [{"ts": 1}]
+    # Valid JSON that is not an object (torn write): same failure class.
+    path.write_text('42\n')
+    with pytest.raises(ValueError):
+        obs_events.read_events(str(path))
+    assert obs_events.read_events(str(path), strict=False) == []
+
+
+def test_default_events_path():
+    assert obs_events.default_events_path(None) == "events.jsonl"
+    assert obs_events.default_events_path("/runs/x/ck.npz") == "/runs/x/events.jsonl"
+    assert obs_events.default_events_path("ck.npz") == os.path.join(".", "events.jsonl")
+
+
+def test_record_round_meta_publishes_counters_and_event(tmp_path, monkeypatch):
+    from hefl_tpu.fl.faults import RoundMeta, record_round_meta
+
+    monkeypatch.setenv("HEFL_EVENTS", "1")
+    path = tmp_path / "events.jsonl"
+    obs_events.configure(str(path))
+    before = obs_metrics.snapshot()
+    try:
+        meta = RoundMeta.from_bits(np.asarray([0, 1, 2, 0]))
+        record_round_meta(meta, round_index=3)
+    finally:
+        obs_events.configure(None)
+    after = obs_metrics.snapshot()
+    assert after.get("exclusions.scheduled", 0) - before.get("exclusions.scheduled", 0) == 1
+    assert after.get("exclusions.nonfinite", 0) - before.get("exclusions.nonfinite", 0) == 1
+    assert after.get("clients.excluded", 0) - before.get("clients.excluded", 0) == 2
+    evs = obs_events.read_events(str(path))
+    rob = [e for e in evs if e["event"] == "round_robust"]
+    assert len(rob) == 1 and rob[0]["round"] == 3 and rob[0]["surviving"] == 2
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_metrics_registry_basics():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("a").inc()
+    reg.counter("a").inc(2)
+    reg.gauge("b").set(5)
+    reg.gauge("peak").max(10)
+    reg.gauge("peak").max(7)
+    assert reg.snapshot() == {"a": 3, "b": 5, "peak": 10}
+    # Per-run view: counters delta against a baseline, gauges current.
+    base = reg.snapshot()
+    reg.counter("a").inc(4)
+    reg.counter("new").inc()
+    reg.gauge("b").set(9)
+    assert reg.snapshot_delta(base) == {"a": 4, "b": 9, "new": 1, "peak": 10}
+    with pytest.raises(TypeError):
+        reg.gauge("a")
+    with pytest.raises(TypeError):
+        reg.counter("b")
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+def test_compile_listener_counts_new_executables():
+    obs_metrics.install_jax_listeners()
+    obs_metrics.install_jax_listeners()  # idempotent
+    before = obs_metrics.snapshot().get("jax.new_executables", 0)
+
+    @jax.jit
+    def _fresh(x):
+        return x * 3.5 + 17.25
+
+    _fresh(jnp.ones(3)).block_until_ready()
+    mid = obs_metrics.snapshot().get("jax.new_executables", 0)
+    assert mid > before
+    _fresh(jnp.ones(3)).block_until_ready()  # cached: no new executable
+    assert obs_metrics.snapshot().get("jax.new_executables", 0) == mid
+
+
+# ----------------------------------------------- roofline timing floor
+
+
+def test_utilization_clamped_and_flagged():
+    counts = {"int_ops": 1e12, "bytes": 1e6}
+    rec = roofline.he_phase_stats(1e-4, counts, device="cpu")  # util >> 1
+    assert rec["util_vs_peak_int_ops"] == 1.0
+    assert rec["timing_floor_suspect"] is True
+    assert rec["util_vs_peak_int_ops_raw"] > 1.0
+    ok = roofline.he_phase_stats(100.0, counts, device="cpu")
+    assert ok["util_vs_peak_int_ops"] < 1.0
+    assert "timing_floor_suspect" not in ok
+    # phase_stats mfu gets the same guard.
+    ps = roofline.phase_stats(1e-9, flops=1e12, device="cpu")
+    assert ps["mfu"] == 1.0 and ps["timing_floor_suspect"] is True
+
+
+def test_phase_seconds_never_round_to_zero():
+    rec = roofline.phase_stats(3.2e-4)
+    assert rec["seconds"] == 0.00032
+    he = roofline.he_phase_stats(3.2e-4, {"int_ops": 1.0, "bytes": 1.0})
+    assert he["seconds"] == 0.00032
+
+
+def test_steady_seconds_repetition_times_sub_floor_phases():
+    calls = []
+
+    def tiny():
+        calls.append(1)
+        return jnp.zeros(())
+
+    t = roofline.steady_seconds(tiny, reps=2, warmup=1)
+    assert 0.0 < t < roofline.TIMING_FLOOR_S
+    # Sub-floor measurement must have fallen back to a repetition chain:
+    # far more calls than the warmup + 2 single-dispatch reps.
+    assert len(calls) > 10
+
+
+def test_steady_seconds_leaves_long_phases_alone():
+    import time as _time
+
+    calls = []
+
+    def slow():
+        calls.append(1)
+        _time.sleep(roofline.TIMING_FLOOR_S * 2)
+        return jnp.zeros(())
+
+    roofline.steady_seconds(slow, reps=2, warmup=1)
+    assert len(calls) == 3  # warmup + 2 reps, no repetition chain

@@ -363,7 +363,14 @@ def test_train_round_flops_within_analytic_envelope():
 def test_roofline_schema_and_clamp():
     rec = roofline.phase_stats(2.0, flops=4e11, device="cpu", images=100)
     assert set(rec) >= {"seconds", "flops", "mfu", "images_per_s"}
-    assert rec["mfu"] == pytest.approx(4e11 / 2.0 / roofline.CPU_PLACEHOLDER_FLOPS)
+    # 4e11/2.0 over the placeholder peak is an impossible 2.0 utilization:
+    # clamped to 1.0 with the raw value kept and the timing-floor flag set
+    # (ISSUE 5 — no artifact ships utilization > 1 unflagged).
+    assert rec["mfu"] == 1.0
+    assert rec["mfu_raw"] == pytest.approx(
+        4e11 / 2.0 / roofline.CPU_PLACEHOLDER_FLOPS
+    )
+    assert rec["timing_floor_suspect"] is True
     assert rec["peak_is_placeholder"] is True
     assert rec["images_per_s"] == 50.0
     # null-safe: fields PRESENT but null when not computable
