@@ -419,6 +419,93 @@ def main() -> None:
         f"backend {he_backend_report()['backend']}"
     )
 
+    # --- packed quantized aggregation rows (ISSUE 6) --------------------
+    # Standalone packed encrypt / decrypt-core at the flagship geometry
+    # (single-program timings, robust), uplink bytes-on-wire, and — unless
+    # the diagnostic tail is skipped — one packed with_plain_reference
+    # round whose decrypt is checked against the in-program plain mean of
+    # its OWN trained weights (the same methodology as the cell-6 artifact,
+    # so the diff is pure quantization + HE error).
+    from hefl_tpu.ckks.packing import PackedSpec
+    from hefl_tpu.fl import PackingConfig
+    from hefl_tpu.fl.secure import encrypt_params_packed
+
+    pack_cfg = PackingConfig(bits=8, interleave=4, clip=0.5)
+    pspec = PackedSpec.for_params(params, ctx, pack_cfg, num_clients)
+    ct_pk = encrypt_params_packed(
+        ctx, pk, cur, cur, flagship_keygen_key(), pspec
+    )
+    t_he_encrypt_packed = roofline.steady_seconds(
+        lambda: encrypt_params_packed(
+            ctx, pk, cur, cur, flagship_keygen_key(), pspec
+        ).c0
+    )
+    dec_core_p = jax.jit(lambda c0, c1: ckks_ops.decrypt(
+        ctx, sk, type(ct_pk)(c0=c0, c1=c1, scale=ct_pk.scale)))
+    t_he_decrypt_packed = roofline.steady_seconds(
+        dec_core_p, ct_pk.c0, ct_pk.c1
+    )
+    from hefl_tpu.ckks.packing import bytes_on_wire_record
+
+    bytes_on_wire = bytes_on_wire_record(pspec, ctx.num_primes)
+    uplink_unpacked = bytes_on_wire["ciphertext_unpacked"]
+    uplink_packed = bytes_on_wire["ciphertext_packed"]
+    packed_max_diff = packed_saturation = None
+    if not skip_cell6:
+        ct_pd, _, sat_pd, plain_ref_pd = secure_fedavg_round(
+            module, cfg, mesh, ctx, pk, last_start, xs_d, ys_d, last_key,
+            with_plain_reference=True, packing=pspec,
+        )
+        packed_saturation = int(np.sum(np.asarray(sat_pd)))
+        packed_avg = decrypt_average(
+            ctx, sk, ct_pd, num_clients, packing=pspec,
+            base_params=last_start,
+        )
+        packed_max_diff = max(
+            float(jnp.max(jnp.abs(a - b)))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(packed_avg),
+                jax.tree_util.tree_leaves(plain_ref_pd),
+            )
+        )
+    packing_rec = {
+        **pspec.geometry_record(),
+        "standalone_encrypt_packed_s": round(t_he_encrypt_packed, 6),
+        "encrypt_speedup": round(t_he_encrypt / t_he_encrypt_packed, 3),
+        "decrypt_core_packed_s": round(t_he_decrypt_packed, 6),
+        "decrypt_speedup": round(t_he_decrypt / t_he_decrypt_packed, 3),
+        # Packed-round fidelity vs its own in-program plain reference
+        # (null when the cell-6 tail is skipped — "not measured", never
+        # "failed"): must sit within error_budget — quantization, not HE
+        # noise, is the budget.
+        "packed_round_max_abs_diff": packed_max_diff,
+        "packed_round_within_budget": (
+            None
+            if packed_max_diff is None
+            else packed_max_diff <= pspec.error_budget
+        ),
+        "packed_saturation_count": packed_saturation,
+        "he_roofline_packed": roofline.he_roofline(
+            {"encrypt": t_he_encrypt_packed, "aggregate": None,
+             "decrypt": t_he_decrypt_packed},
+            n=ctx.n, num_limbs=ctx.num_primes, n_ct=pspec.n_ct,
+            num_clients=num_clients, encrypt_clients=1, device=dev,
+        ),
+    }
+    log(
+        f"packing (b={pspec.bits} k={pspec.k}): n_ct {pack.n_ct} -> "
+        f"{pspec.n_ct} | encrypt {t_he_encrypt_packed:.3f}s "
+        f"({packing_rec['encrypt_speedup']}x) | decrypt-core "
+        f"{t_he_decrypt_packed:.3f}s ({packing_rec['decrypt_speedup']}x) | "
+        f"uplink {uplink_unpacked / 1e6:.1f} -> {uplink_packed / 1e6:.1f} MB"
+        + (
+            f" | packed fidelity {packed_max_diff:.2e} "
+            f"(budget {pspec.error_budget:.2e})"
+            if packed_max_diff is not None
+            else ""
+        )
+    )
+
     obs_metrics.record_device_memory(dev)
     obs_snapshot = obs_metrics.snapshot()
 
@@ -525,6 +612,11 @@ def main() -> None:
                 # bandwidth roofline rows for every HE phase (ISSUE 4).
                 "he_backend": he_backend_report(),
                 "he_roofline": he_rows,
+                # Quantized bit-interleaved packing rows (ISSUE 6): the
+                # packed-vs-unpacked HE timings, fidelity-vs-budget, and
+                # per-client uplink bytes.
+                "packing": packing_rec,
+                "bytes_on_wire": bytes_on_wire,
                 "device": getattr(dev, "device_kind", str(dev)),
                 "seed": seed,
                 # `accuracy` pairs with `value`: both are the round-0

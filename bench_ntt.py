@@ -96,7 +96,12 @@ def main() -> None:
 
     prev = ntt_mod._BACKEND
     rows = []
-    shapes = [(55, 3, 4096), (18, 3, 4096), (2, 3, 4096)]
+    # [14, 3, 4096] is the PACKED flagship-bench batch (ISSUE 6): the
+    # 2-client flagship's 55 ciphertexts bit-interleaved 4-to-a-slot ->
+    # ceil(55/4) = 14 rows. (k is client-count-dependent: the 8-client
+    # presets' carry-free headroom resolves to k=3 -> 19 rows; 14 is the
+    # bench.py configuration's shape.)
+    shapes = [(55, 3, 4096), (18, 3, 4096), (14, 3, 4096), (2, 3, 4096)]
     if os.environ.get("NTT_SMOKE") == "1":   # harness shakeout on CPU
         shapes = [(2, 3, 4096)]
     rng = np.random.default_rng(0)
@@ -174,6 +179,69 @@ def main() -> None:
                  t_ex * 1e3, t_ep * 1e3, t_ex / t_ep,
                  t_dx * 1e3, t_dp * 1e3, t_dx / t_dp)
             )
+        # Packed-quantized parity stage (ISSUE 6, exit-42 contract): the
+        # bit-interleaved payload must survive the EXACT integer encode ->
+        # (both NTT backends') encrypt/decrypt cores -> exact integer
+        # decode bit-for-bit. Random 62-bit (hi, lo) pairs at the packed
+        # flagship shape; any field corruption is a deterministic kernel/
+        # encode failure, not a tunnel blip.
+        from hefl_tpu.ckks import encoding, quantize
+        from hefl_tpu.ckks.keys import keygen
+
+        n_rows = 2 if os.environ.get("NTT_SMOKE") == "1" else 14
+        pshape = (n_rows, ctx.num_primes, ctx.n)
+        hi = jnp.asarray(
+            rng.integers(0, 1 << 31, size=(n_rows, ctx.n), dtype=np.int64)
+            .astype(np.uint32)
+        )
+        lo = jnp.asarray(
+            rng.integers(0, 1 << 31, size=(n_rows, ctx.n), dtype=np.int64)
+            .astype(np.uint32)
+        )
+        m_pk = encoding.encode_packed(nttc, hi, lo)
+        v_ref = quantize.packed_value_int64(np.asarray(hi), np.asarray(lo))
+        sk_p, pk_p = keygen(ctx, jax.random.key(0))
+        u_p, e0_p, e1_p = ops_mod.encrypt_samples(
+            ctx, jax.random.key(1), (n_rows,)
+        )
+        try:
+            # (a) exact integer encode/decode round-trip (no HE).
+            np.testing.assert_array_equal(
+                np.asarray(encoding.decode_int_center(nttc, m_pk)), v_ref
+            )
+            # (b) the full cipher loop under EACH NTT backend (fresh jit
+            # per backend — the module selector is read at trace time):
+            # values up to 2**62 must decrypt to within the noise guard of
+            # the payload (|error| < 2**15 here, far below the default
+            # 2**17 guard).
+            for backend in (["xla", "pallas-interpret"] if not on_tpu
+                            else ["xla", "pallas"]):
+                ntt_mod._BACKEND = backend
+
+                def _loop(m):
+                    ct = ops_mod.encrypt_core(
+                        ctx, pk_p, m, u_p, e0_p, e1_p
+                    )
+                    return dec_ref(ct.c0, ct.c1, sk_p.s_mont)
+
+                res_p = jax.jit(_loop)(m_pk)
+                v_out = np.asarray(encoding.decode_int_center(nttc, res_p))
+                err = np.abs(v_out - v_ref).max()
+                if err >= (1 << 15):
+                    raise AssertionError(
+                        f"packed payload noise {err} under backend "
+                        f"{backend} exceeds the guard budget"
+                    )
+            ntt_mod._BACKEND = prev
+            print(
+                f"packed parity: encode_packed/decode_int_center exact at "
+                f"{list(pshape)}; cipher round-trip noise < 2**15 on every "
+                "backend",
+                file=sys.stderr,
+            )
+        except AssertionError as e:
+            print(f"PACKED PARITY FAILURE at {pshape}: {e}", file=sys.stderr)
+            sys.exit(42)
     finally:
         ntt_mod._BACKEND = prev
 

@@ -88,6 +88,57 @@ def encode(ctx: NTTContext, values: jnp.ndarray, scale: float) -> jnp.ndarray:
     return modular.add_mod(hi_shift, lo_res, p)
 
 
+def encode_packed(ctx: NTTContext, hi: jnp.ndarray, lo: jnp.ndarray) -> jnp.ndarray:
+    """Exact integer encode of v = hi * 2**31 + lo (hi, lo uint32 < 2**31)
+    -> canonical residues uint32[..., L, N].
+
+    The packed-quantized path (ckks.quantize) carries up-to-62-bit bit-field
+    integers; routing them through the float `encode` would shear off
+    everything past the 24-bit float32 mantissa, so this encode never touches
+    floats: residues are (hi mod p) * (2**31 mod p) + (lo mod p), all
+    division-free modular integer ops — bit-exact for the full range.
+    """
+    p = jnp.asarray(ctx.p)                    # uint32[L, 1]
+    mu = modular.barrett_mu(p)
+    hi_res = modular.barrett_mod(hi[..., None, :], p, mu)
+    lo_res = modular.barrett_mod(lo[..., None, :], p, mu)
+    shift_mont = jnp.asarray(
+        [
+            [host_to_mont((1 << 31) % int(pi), int(pi))]
+            for pi in np.asarray(ctx.p)[:, 0]
+        ],
+        dtype=jnp.uint32,
+    )
+    hi_shift = modular.mont_mul(hi_res, shift_mont, p, jnp.asarray(ctx.pinv_neg))
+    return modular.add_mod(hi_shift, lo_res, p)
+
+
+def decode_int_center(ctx: NTTContext, residues) -> np.ndarray:
+    """Residues uint32[..., L, N] -> the centered CRT value as EXACT int64.
+
+    The packed-quantized decode needs the integer bit-for-bit (its payload
+    is bit fields), which rules out both the float32 jittable `decode` and
+    `decode_exact`'s float64 output (exact only to 2**53). Digits come from
+    the same exact `_mixed_radix_digits` extraction; the recombination runs
+    host-side in uint64 two's-complement — multiplication/addition wrap mod
+    2**64, and since the true centered value of any packed payload satisfies
+    |v| < 2**62 (quantize.MAX_PACKED_BITS), the wrapped result IS the value.
+    Values outside +/-2**63 would alias silently, so callers must respect
+    the MAX_PACKED_BITS ceiling (`interleave_fields` enforces it on the
+    encode side).
+    """
+    digits = _mixed_radix_digits(ctx, jnp.asarray(residues))
+    p = np.asarray(ctx.p)[:, 0]
+    acc = None
+    prefix = 1
+    for i, d in enumerate(digits):
+        c = np.uint64(prefix & 0xFFFFFFFFFFFFFFFF)
+        term = np.asarray(d).astype(np.int64).astype(np.uint64) * c
+        acc = term if acc is None else acc + term
+        prefix *= int(p[i])
+    return acc.astype(np.int64)
+
+
 def encode_overflow_count(values: jnp.ndarray, scale: float) -> jnp.ndarray:
     """How many of `values` would saturate in `encode` at this scale
     (jittable diagnostic; 0 on a healthy pipeline)."""

@@ -57,3 +57,199 @@ def pack_pytree(params: Any, n: int) -> jax.Array:
 def unpack_blocks(blocks: jax.Array, spec: PackSpec) -> Any:
     """float[n_ct, n] -> parameter pytree (drops the zero padding)."""
     return spec.unravel(blocks.reshape(-1)[: spec.total])
+
+
+# ---------------------------------------------------------------------------
+# Quantized bit-interleaved packing (FedBit-style; ckks.quantize holds the
+# HE-free quantizer/interleaver). One packed ciphertext row carries k
+# blocks' worth of b-bit quantized UPDATE coefficients, so the whole HE
+# pipeline — encrypt NTTs, masked psum, decrypt iNTT, bytes on the wire —
+# sees [n_ct/k, L, N] instead of [n_ct, L, N].
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedSpec:
+    """Static packed geometry for one model template + ring + PackingConfig.
+
+    Frozen and hashable (scalars + the PackSpec, whose `unravel` closure
+    hashes by identity) so it can ride as an lru_cache key into the
+    compile-once secure-round factory. Build it ONCE per experiment
+    (`PackedSpec.for_params`) and reuse — two builds from identical inputs
+    compare unequal and would compile a second program.
+    """
+
+    base: PackSpec            # the unpacked geometry (n, total, n_ct, unravel)
+    bits: int                 # quantizer width b
+    k: int                    # interleave factor (blocks per packed row)
+    field_bits: int           # b + ceil(log2(clients)): carry-free field width
+    guard: int                # noise guard bits below the payload
+    step: float               # quantization step (scalar; clip / qmax)
+    clip: float               # symmetric clip bound on updates
+    clients: int              # max clients a field sum must hold carry-free
+    n_ct: int                 # PACKED ciphertext rows = ceil(base.n_ct / k)
+    error_budget: float       # declared |packed - unpacked| per-coeff budget
+
+    @classmethod
+    def for_params(
+        cls, template_params: Any, ctx, cfg, num_clients: int
+    ) -> "PackedSpec":
+        """Geometry for `template_params` under `ctx` (a CkksContext) and a
+        `quantize.PackingConfig`; `num_clients` sizes the carry-free-sum
+        headroom (and must be >= any round's client count)."""
+        from hefl_tpu.ckks import quantize
+
+        if not cfg.enabled:
+            raise ValueError("PackedSpec.for_params: PackingConfig is disabled")
+        base = PackSpec.for_params(template_params, ctx.n)
+        fb = quantize.field_bits(cfg.bits, num_clients)
+        k = cfg.interleave or quantize.max_interleave(
+            ctx.modulus, cfg.bits, num_clients, cfg.guard_bits
+        )
+        guard = cfg.guard_bits + max(int(num_clients) - 1, 0).bit_length()
+        if guard + k * fb > min(
+            ctx.modulus.bit_length() - 2, quantize.MAX_PACKED_BITS
+        ):
+            raise ValueError(
+                f"PackedSpec: k={k} at bits={cfg.bits}, clients={num_clients} "
+                f"needs {guard + k * fb} bits but the ring allows "
+                f"{min(ctx.modulus.bit_length() - 2, quantize.MAX_PACKED_BITS)}"
+                " — lower interleave/bits/guard or add RNS primes"
+            )
+        return cls(
+            base=base,
+            bits=cfg.bits,
+            k=k,
+            field_bits=fb,
+            guard=guard,
+            step=cfg.step,
+            clip=float(cfg.clip),
+            clients=int(num_clients),
+            n_ct=-(-base.n_ct // k),
+            error_budget=quantize.quant_error_budget(cfg),
+        )
+
+    @property
+    def n(self) -> int:
+        return self.base.n
+
+    @property
+    def total(self) -> int:
+        return self.base.total
+
+    @property
+    def offset(self) -> int:
+        """The non-negativity offset added to every code on the wire."""
+        from hefl_tpu.ckks import quantize
+
+        return quantize.qmax(self.bits)
+
+    @property
+    def guard_scale(self) -> float:
+        """The ciphertext `scale` metadata of a packed encryption: the
+        payload sits 2**guard above the noise floor, exactly like a CKKS
+        scale factor."""
+        return float(1 << self.guard)
+
+    def bytes_on_wire(self, num_limbs: int) -> int:
+        """Per-client uplink bytes of one packed encryption (c0 + c1)."""
+        return ciphertext_bytes(self.n_ct, num_limbs, self.n)
+
+    def geometry_record(self) -> dict:
+        """The packing-geometry fields every artifact embeds (single source
+        for bench.py / profile_round.py / experiment.py, so the three
+        records cannot drift)."""
+        return {
+            "bits": self.bits,
+            "interleave": self.k,
+            "field_bits": self.field_bits,
+            "guard_bits": self.guard,
+            "clip": self.clip,
+            "n_ct": self.n_ct,
+            "n_ct_unpacked": self.base.n_ct,
+            "error_budget": self.error_budget,
+        }
+
+
+def ciphertext_bytes(n_ct: int, num_limbs: int, n: int) -> int:
+    """Wire bytes of one [n_ct, L, N] ciphertext batch: the (c0, c1) pair
+    of uint32 residue tensors — THE uplink-size formula (single source)."""
+    return 2 * n_ct * num_limbs * n * 4
+
+
+def bytes_on_wire_record(spec: PackedSpec, num_limbs: int) -> dict:
+    """The `bytes_on_wire` artifact record: per-client uplink bytes of the
+    float32 update, the unpacked ciphertext pair, and the packed pair."""
+    unpacked = ciphertext_bytes(spec.base.n_ct, num_limbs, spec.n)
+    packed = spec.bytes_on_wire(num_limbs)
+    plain = spec.total * 4
+    return {
+        "plain_update": plain,
+        "ciphertext_unpacked": unpacked,
+        "ciphertext_packed": packed,
+        "packed_reduction": round(unpacked / packed, 2),
+        "expansion_unpacked": round(unpacked / plain, 2),
+        "expansion_packed": round(packed / plain, 2),
+    }
+
+
+def pack_quantized_flat(
+    flat: jax.Array, spec: PackedSpec
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """float[total] update vector -> ((hi, lo) uint32[n_ct, n], saturation).
+
+    Jit-safe. Quantize -> offset to non-negative codes -> pad to k*n_ct
+    blocks (padding carries code 0, dropped again by `unpack_quantized`) ->
+    bit-interleave k consecutive blocks per packed row. `saturation` is the
+    scalar int32 count of coefficients that clipped (or were non-finite) —
+    the packed analog of `encode_overflow_count`, reported per client
+    through the same `encode_overflow` output slot.
+    """
+    from hefl_tpu.ckks import quantize
+
+    flat = flat.astype(jnp.float32)
+    sat = quantize.saturation_count(flat, spec.step, spec.bits)
+    u = (quantize.quantize(flat, spec.step, spec.bits) + spec.offset).astype(
+        jnp.uint32
+    )
+    pad = spec.n_ct * spec.k * spec.n - spec.total
+    if pad:
+        u = jnp.concatenate([u, jnp.zeros((pad,), jnp.uint32)])
+    u = u.reshape(spec.n_ct, spec.k, spec.n)
+    hi, lo = quantize.interleave_fields(u, spec.k, spec.field_bits, spec.guard)
+    return hi, lo, sat
+
+
+def pack_quantized_delta(
+    params: Any, base_params: Any, spec: PackedSpec
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Quantize-and-pack one client's UPDATE (params - base_params)."""
+    flat, _ = ravel_pytree(params)
+    base_flat, _ = ravel_pytree(base_params)
+    return pack_quantized_flat(
+        flat.astype(jnp.float32) - base_flat.astype(jnp.float32), spec
+    )
+
+
+def unpack_quantized(
+    v: "jax.Array | Any", spec: PackedSpec, surviving: int
+) -> Any:
+    """Packed-sum integers int64[n_ct, n] -> the dequantized AVERAGE update
+    as float32[total] (host-side numpy; exact field recovery, then one
+    float multiply per coefficient).
+
+    `v` is `encoding.decode_int_center` of the decrypted aggregate;
+    `surviving` is the round's surviving-client count (RoundMeta) — it is
+    both the offset multiplier and the averaging denominator.
+    """
+    import numpy as np
+
+    from hefl_tpu.ckks import quantize
+
+    fields = quantize.deinterleave_fields(
+        np.asarray(v), spec.k, spec.field_bits, spec.guard
+    )                                               # [n_ct, k, n]
+    avg = quantize.decode_field_sums(
+        fields, spec.step, spec.offset, surviving
+    )
+    return avg.reshape(-1)[: spec.total]

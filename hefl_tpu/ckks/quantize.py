@@ -1,0 +1,323 @@
+"""FedBit-style quantization + bit-interleaving for CKKS slot packing.
+
+The coefficient-packed pipeline (ckks/packing.py) spends one float32 weight
+per ring coefficient, so every HE phase and every byte on the wire scales
+with ``n_ct = ceil(total / N)``. Client *updates* (trained weights minus the
+round's global weights) carry far less information than a float32: FedBit's
+cross-layer co-design (PAPERS.md) quantizes them to ``b`` bits and
+bit-interleaves ``k`` quantized coefficients into each slot, cutting
+``n_ct`` — and with it encrypt/psum/decrypt work and uplink bytes — by the
+packing factor ``k``.
+
+This module holds the two HE-free halves of that co-design:
+
+  * **Symmetric quantization** — ``q = clip(round(x / step), ±qmax)`` with
+    ``qmax = 2**(b-1) - 1`` and ``step = clip / qmax``. ``step`` may be a
+    scalar or any broadcastable array (per-tensor steps: broadcast each
+    tensor's step over its span of the raveled flat vector), so per-tensor
+    clips are first-class. Values beyond the clip SATURATE (exactly like the
+    CKKS encoder envelope, encoding.ENCODE_BOUND) and `saturation_count`
+    reports how many did — the packed analog of `encode_overflow_count`.
+
+  * **Bit-interleaving with carry-free-addition headroom** — ``k`` shifted
+    quantized values per slot::
+
+        field_bits = b + ceil(log2(C))          # C = max summed clients
+        v = sum_j u_j << (guard + j*field_bits) # u_j = q_j + qmax  (>= 0)
+
+    Each field is ``ceil(log2(C))`` bits wider than a single value, so the
+    homomorphic sum of up to C clients' slots never carries across fields,
+    and the bottom ``guard`` bits absorb the CKKS decrypt noise (the sum is
+    recovered by one rounding shift, bit-exact while |noise| < 2**(guard-1)).
+    The packed integer must stay below BOTH q/2 (centered mod-q decode) and
+    2**62 (the exact hi/lo integer encode + int64 digit recombination), so
+
+        k_max = floor(log2(q_headroom) / field_bits),
+        log2(q_headroom) = min(floor(log2 q) - 1, 62) - guard_eff
+
+    with ``guard_eff = guard_bits + ceil(log2(C))`` (noise also sums over
+    clients). `max_interleave` computes it; `PackingConfig.interleave = 0`
+    means "use k_max".
+
+Offsets compose with partial participation: a masked-out client's zeroed
+ciphertext limbs contribute 0 (not ``qmax``), so the unpack subtracts
+``surviving * qmax`` per field using the round's `RoundMeta.surviving` —
+the same public count `decrypt_average` already uses as its denominator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+# Exactness ceiling of the packed integer, independent of the ring:
+#  * the hi/lo split encode (encoding.encode_packed) carries v = hi*2**31+lo
+#    with hi < 2**31  ->  v < 2**62;
+#  * the int64 mixed-radix recombination (encoding.decode_int_center) is
+#    exact two's-complement for |v| < 2**63.
+MAX_PACKED_BITS = 62
+
+
+def qmax(bits: int) -> int:
+    """Largest quantized magnitude at b bits (symmetric, zero-centered)."""
+    return (1 << (bits - 1)) - 1
+
+
+def symmetric_step(clip, bits: int):
+    """Quantization step for a symmetric b-bit grid covering [-clip, clip]."""
+    return clip / qmax(bits)
+
+
+@dataclasses.dataclass(frozen=True)
+class PackingConfig:
+    """Quantized-packing knobs (frozen/hashable: rides in ExperimentConfig
+    and in the lru_cached round-program factory key).
+
+    bits:         quantization width b (0 disables packing entirely — the
+                  historical one-float-per-coefficient path, bit-for-bit).
+    interleave:   coefficients per slot k (0 = auto: the headroom-formula
+                  maximum for the ring / client count — `max_interleave`).
+    clip:         symmetric clip bound on a client's UPDATE (trained minus
+                  global weights); |update| > clip saturates and is counted
+                  (the packed analog of encode_overflow). Updates, not
+                  weights: deltas are small and near-zero-centered, so a
+                  b-bit grid spends its levels where the signal is.
+    guard_bits:   low bits reserved per slot for CKKS decrypt noise (the
+                  effective guard adds ceil(log2(C)) for the client sum).
+    error_budget: declared max |packed - unpacked| error per averaged
+                  coefficient. 0 = auto: step/2 + 1e-4 (each client's
+                  quantization error is <= step/2, averaging cannot exceed
+                  it; the margin covers the unpacked reference's own CKKS
+                  decode error). Tests and the chaos gate assert against
+                  whatever is declared here.
+    """
+
+    bits: int = 0
+    interleave: int = 0
+    clip: float = 0.5
+    guard_bits: int = 16
+    error_budget: float = 0.0
+
+    def __post_init__(self):
+        if self.bits and not 2 <= self.bits <= 16:
+            raise ValueError(
+                f"PackingConfig.bits={self.bits}: must be 0 (disabled) or "
+                "2..16 (one sign bit + at least one magnitude bit; beyond "
+                "16 the packing factor cannot beat the float path)"
+            )
+        if self.interleave < 0:
+            raise ValueError("PackingConfig.interleave must be >= 0 (0 = auto)")
+        if self.bits and self.clip <= 0:
+            raise ValueError("PackingConfig.clip must be > 0")
+        if self.bits and not 4 <= self.guard_bits <= 30:
+            raise ValueError(
+                f"PackingConfig.guard_bits={self.guard_bits}: need 4..30 "
+                "(too small loses low fields to decrypt noise; too large "
+                "starves the payload)"
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return self.bits > 0
+
+    @property
+    def step(self) -> float:
+        return float(symmetric_step(self.clip, self.bits))
+
+
+def field_bits(bits: int, clients: int) -> int:
+    """Width of one interleaved field: b payload bits plus ceil(log2(C))
+    carry-free-addition headroom so a sum over <= C clients never crosses
+    into the next field."""
+    return bits + max(int(clients) - 1, 0).bit_length()
+
+
+def payload_bits(modulus: int, guard: int) -> int:
+    """Usable packed-integer bits for a ring modulus q and a noise guard:
+    min(floor(log2 q) - 1, 62) - guard (centered-decode q/2 ceiling and the
+    int64-exactness ceiling, whichever binds)."""
+    return min(modulus.bit_length() - 2, MAX_PACKED_BITS) - guard
+
+
+def max_interleave(modulus: int, bits: int, clients: int, guard_bits: int) -> int:
+    """The headroom-formula packing factor:
+    k = floor(log2(q_headroom) / (b + ceil(log2 C)))."""
+    guard_eff = guard_bits + max(int(clients) - 1, 0).bit_length()
+    avail = payload_bits(modulus, guard_eff)
+    k = avail // field_bits(bits, clients)
+    if k < 1:
+        raise ValueError(
+            f"no packing headroom: {avail} payload bits cannot hold one "
+            f"{field_bits(bits, clients)}-bit field (bits={bits}, "
+            f"clients={clients}, guard={guard_bits}); lower bits/guard or "
+            "add RNS primes"
+        )
+    return k
+
+
+# ---------------------------------------------------------------------------
+# Quantizer (jittable; step may be scalar or broadcastable per-tensor array).
+# ---------------------------------------------------------------------------
+
+
+def quantize(x: jnp.ndarray, step, bits: int) -> jnp.ndarray:
+    """float -> int32 symmetric b-bit code, saturating at +/-qmax."""
+    qm = qmax(bits)
+    q = jnp.clip(jnp.round(x / step), -qm, qm)
+    return q.astype(jnp.int32)
+
+
+def dequantize(q: jnp.ndarray, step) -> jnp.ndarray:
+    """int code -> float32 value on the quantization grid."""
+    return q.astype(jnp.float32) * jnp.asarray(step, jnp.float32)
+
+
+def saturation_count(x: jnp.ndarray, step, bits: int) -> jnp.ndarray:
+    """How many of `x` saturate the b-bit grid at this step (jittable
+    diagnostic, the packed analog of `encoding.encode_overflow_count`).
+    Non-finite values count: they quantize to garbage and MUST be surfaced
+    (the masked engine's NaN filter excludes such clients anyway)."""
+    scaled = x / step
+    bad = ~jnp.isfinite(scaled) | (jnp.abs(scaled) > qmax(bits) + 0.5)
+    return jnp.sum(bad, dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Bit-interleave <-> deinterleave. The packed integer is carried as a
+# (hi, lo) uint32 pair with v = hi * 2**31 + lo (hi, lo < 2**31) — the same
+# two-part split the float encoder uses, but built with pure integer ops so
+# it is EXACT for the full 62-bit range (a float32 round-trip would destroy
+# bits past the 24-bit mantissa).
+# ---------------------------------------------------------------------------
+
+_LO_BITS = 31
+_LO_MASK = (1 << _LO_BITS) - 1
+
+
+def interleave_fields(
+    u: jnp.ndarray, k: int, fbits: int, guard: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """uint32 fields [..., k, n] -> (hi, lo) uint32 [..., n].
+
+    Field j (< 2**fbits) lands at bit offset guard + j*fbits. Fields are
+    masked to their width first (bit hygiene: a poisoned client's garbage
+    code must not bleed into neighbors before the masked engine zeroes its
+    ciphertext), and since offsets are disjoint the combine is pure OR —
+    no carries, jit-safe, unrolled over the static k.
+    """
+    total = guard + k * fbits
+    if total > MAX_PACKED_BITS:
+        raise ValueError(
+            f"interleave_fields: guard + k*field_bits = {total} exceeds the "
+            f"{MAX_PACKED_BITS}-bit exact-integer ceiling"
+        )
+    mask = jnp.uint32((1 << fbits) - 1)
+    shape = u.shape[:-2] + u.shape[-1:]
+    hi = jnp.zeros(shape, jnp.uint32)
+    lo = jnp.zeros(shape, jnp.uint32)
+    for j in range(k):
+        uj = u[..., j, :].astype(jnp.uint32) & mask
+        o = guard + j * fbits
+        if o >= _LO_BITS:
+            hi = hi | (uj << (o - _LO_BITS))
+        else:
+            lo = lo | ((uj << o) & jnp.uint32(_LO_MASK))
+            if o + fbits > _LO_BITS:
+                hi = hi | (uj >> (_LO_BITS - o))
+    return hi, lo
+
+
+def packed_value_int64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """(hi, lo) -> the packed integer as int64 (host-side; tests + the
+    decode path's reference)."""
+    return (np.asarray(hi).astype(np.int64) << _LO_BITS) | np.asarray(
+        lo
+    ).astype(np.int64)
+
+
+def deinterleave_fields(
+    v: np.ndarray, k: int, fbits: int, guard: int
+) -> np.ndarray:
+    """int64 packed sums [..., n] -> int64 field sums [..., k, n] (host).
+
+    One arithmetic rounding shift absorbs the guard band (exact while the
+    accumulated decrypt noise stays below 2**(guard-1) in magnitude), then
+    fields are plain masked shifts. The exact inverse of
+    `interleave_fields` + homomorphic addition.
+    """
+    v = np.asarray(v, dtype=np.int64)
+    w = (v + (1 << (guard - 1))) >> guard if guard else v
+    mask = np.int64((1 << fbits) - 1)
+    return np.stack(
+        [(w >> (j * fbits)) & mask for j in range(k)], axis=-2
+    )
+
+
+def decode_field_sums(
+    fields: np.ndarray, step: float, offset: int, surviving: int
+) -> np.ndarray:
+    """Field sums over S surviving clients -> the dequantized AVERAGE.
+
+    Each surviving client contributed u = q + offset (offset = qmax makes
+    codes non-negative on the wire); zeroed (excluded) clients contributed
+    nothing, so sum_fields = sum(q) + S*offset and the average update is
+    (sum_fields - S*offset) * step / S.
+    """
+    if surviving <= 0:
+        raise ValueError("decode_field_sums: surviving must be positive")
+    q_sum = fields.astype(np.int64) - np.int64(surviving) * np.int64(offset)
+    return (q_sum * (float(step) / surviving)).astype(np.float32)
+
+
+def quant_error_budget(cfg: PackingConfig) -> float:
+    """The declared per-coefficient |packed - unpacked| budget: the
+    configured override, else step/2 (the quantizer's worst case, which
+    averaging over clients cannot exceed) + 1e-4 slack for the unpacked
+    reference's own CKKS decode error."""
+    if cfg.error_budget:
+        return float(cfg.error_budget)
+    return 0.5 * cfg.step + 1e-4
+
+
+def describe(cfg: PackingConfig, modulus: int, clients: int) -> dict:
+    """Human/artifact-facing summary of a packing choice at one geometry."""
+    fb = field_bits(cfg.bits, clients)
+    guard_eff = cfg.guard_bits + max(int(clients) - 1, 0).bit_length()
+    k = cfg.interleave or max_interleave(
+        modulus, cfg.bits, clients, cfg.guard_bits
+    )
+    return {
+        "bits": cfg.bits,
+        "interleave": k,
+        "field_bits": fb,
+        "guard_bits": guard_eff,
+        "clip": cfg.clip,
+        "step": cfg.step,
+        "payload_bits": payload_bits(modulus, guard_eff),
+        "error_budget": quant_error_budget(cfg),
+        "clients": int(clients),
+        "headroom_ok": guard_eff + k * fb
+        <= min(modulus.bit_length() - 2, MAX_PACKED_BITS),
+    }
+
+
+__all__ = [
+    "MAX_PACKED_BITS",
+    "PackingConfig",
+    "qmax",
+    "symmetric_step",
+    "field_bits",
+    "payload_bits",
+    "max_interleave",
+    "quantize",
+    "dequantize",
+    "saturation_count",
+    "interleave_fields",
+    "packed_value_int64",
+    "deinterleave_fields",
+    "decode_field_sums",
+    "quant_error_budget",
+    "describe",
+]

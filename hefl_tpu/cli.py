@@ -13,7 +13,7 @@ import json
 import os
 
 from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
-from hefl_tpu.fl import DpConfig, FaultConfig, TrainConfig
+from hefl_tpu.fl import DpConfig, FaultConfig, PackingConfig, TrainConfig
 from hefl_tpu.models import MODEL_REGISTRY
 
 
@@ -58,6 +58,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "(winner persisted next to the XLA compile cache)")
     p.add_argument("--he-n", type=int, default=4096, help="CKKS ring degree")
     p.add_argument("--he-primes", type=int, default=3, help="RNS limb count")
+    # --- quantized bit-interleaved packing (ckks.quantize / README
+    # "Packing & precision") ---
+    p.add_argument("--pack-bits", type=int, default=0, metavar="B",
+                   help="quantize client updates to B bits and bit-"
+                        "interleave them k-to-a-CKKS-slot: every HE phase "
+                        "and the uplink shrink by the packing factor "
+                        "(0 = off, the bit-exact float path)")
+    p.add_argument("--pack-interleave", type=int, default=0, metavar="K",
+                   help="coefficients per slot (0 = auto: the carry-free "
+                        "headroom maximum for the ring and client count)")
+    p.add_argument("--pack-clip", type=float, default=None, metavar="C",
+                   help="symmetric clip bound on a client's update for the "
+                        "quantizer grid (default 0.5); |update| > C "
+                        "saturates (counted in encode_overflow, same "
+                        "on_overflow machinery)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--n-train", type=int, default=None)
     p.add_argument("--n-test", type=int, default=None)
@@ -131,6 +146,24 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _packing_from_args(args: argparse.Namespace) -> "PackingConfig | None":
+    """--pack-bits gates the whole feature; the sibling knobs without it
+    would be SILENTLY ignored (a run the user believes is packed but
+    isn't), so that combination fails loudly instead."""
+    if args.pack_bits <= 0:
+        if args.pack_interleave or args.pack_clip is not None:
+            raise SystemExit(
+                "--pack-interleave/--pack-clip have no effect without "
+                "--pack-bits; add --pack-bits B to enable packing"
+            )
+        return None
+    return PackingConfig(
+        bits=args.pack_bits,
+        interleave=args.pack_interleave,
+        clip=0.5 if args.pack_clip is None else args.pack_clip,
+    )
+
+
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
     num_classes = (
         args.num_classes
@@ -183,6 +216,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             max_update_norm=args.max_update_norm,
         ),
         he=HEConfig(n=args.he_n, num_primes=args.he_primes),
+        packing=_packing_from_args(args),
         seed=args.seed,
         n_train=args.n_train,
         n_test=args.n_test,

@@ -22,7 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from hefl_tpu.ckks.keys import CkksContext, keygen
-from hefl_tpu.ckks.packing import PackSpec
+from hefl_tpu.ckks.packing import PackedSpec, PackSpec
+from hefl_tpu.ckks.quantize import PackingConfig
 from hefl_tpu.data import (
     RoundPrefetcher,
     iid_contiguous,
@@ -49,6 +50,7 @@ from hefl_tpu.fl.fedavg import masked_mode, pad_federated
 from hefl_tpu.models import count_params, create_model
 from hefl_tpu.obs import events as obs_events
 from hefl_tpu.obs import metrics as obs_metrics
+from hefl_tpu.obs import scopes as obs_scopes
 from hefl_tpu.parallel import client_mesh_size, make_mesh
 from hefl_tpu.utils import PhaseTimer, load_checkpoint, save_checkpoint, save_params
 from hefl_tpu.utils import roofline
@@ -119,6 +121,12 @@ class ExperimentConfig:
     # matching the current round exists. 0 = fail fast (historical).
     max_round_retries: int = 0
     retry_backoff_s: float = 0.5
+    # Quantized bit-interleaved CKKS packing (ckks.quantize / ckks.packing):
+    # clients upload b-bit quantized updates interleaved k-to-a-slot, so
+    # every HE phase and the uplink shrink by the packing factor. None (or
+    # bits=0) keeps the historical one-float-per-coefficient path
+    # bit-for-bit. Encrypted runs only.
+    packing: "PackingConfig | None" = None
     # Structured run-event log (obs.events): one JSONL line per noteworthy
     # runtime occurrence (phase seconds, exclusions, retries, resumes,
     # autoselect outcomes, compiles). None = the default location
@@ -215,6 +223,17 @@ def run_experiment(
             "fault injection targets the federated round loop; remove "
             "--centralized or drop the faults config"
         )
+    if (
+        cfg.packing is not None
+        and cfg.packing.enabled
+        and (not cfg.encrypted or cfg.centralized)
+    ):
+        # Fail fast, before any event/log/dataset work: packing quantizes
+        # the CKKS upload, so a plaintext/centralized run cannot honor it.
+        raise ValueError(
+            "packing quantizes the CKKS upload; remove "
+            "--plaintext/--centralized or drop the packing config"
+        )
     if cfg.dp is not None and cfg.faults is not None:
         # fl.dp's distributed noise shares are calibrated for FULL
         # participation (sigma*C/sqrt(K) each); excluding any client also
@@ -245,6 +264,17 @@ def run_experiment(
         rounds=cfg.rounds, encrypted=cfg.encrypted,
         centralized=cfg.centralized, faults=cfg.faults is not None,
         dp=cfg.dp is not None, seed=cfg.seed,
+        # The event fires before the HE context exists, so it carries the
+        # CONFIGURED interleave (0 = auto) under an unambiguous name; the
+        # RESOLVED k lives in the result record's `packing.interleave`.
+        packing=(
+            {
+                "bits": cfg.packing.bits,
+                "interleave_configured": cfg.packing.interleave,
+            }
+            if cfg.packing is not None and cfg.packing.enabled
+            else None
+        ),
     )
     train_cfg = cfg.train
     if cfg.data_dir is not None:
@@ -339,7 +369,7 @@ def run_experiment(
     prefetcher = RoundPrefetcher()
     xs_d, ys_d = prefetcher.get(xs, ys)
 
-    ctx = sk = pk = spec = None
+    ctx = sk = pk = spec = pspec = None
     if cfg.encrypted:
         ctx = cfg.he.build()
         key, k_he = jax.random.split(key)
@@ -349,6 +379,17 @@ def run_experiment(
             f"CKKS context: N={ctx.n} L={ctx.num_primes} "
             f"-> {spec.n_ct} ciphertexts for {count_params(params):,} params"
         )
+        if cfg.packing is not None and cfg.packing.enabled:
+            pspec = PackedSpec.for_params(
+                params, ctx, cfg.packing, cfg.num_clients
+            )
+            say(
+                f"packing: b={pspec.bits} k={pspec.k} "
+                f"(guard {pspec.guard}, clip {pspec.clip}) -> "
+                f"{pspec.n_ct} packed ciphertexts "
+                f"({spec.n_ct / pspec.n_ct:.1f}x fewer), error budget "
+                f"{pspec.error_budget:.2e}"
+            )
 
     start_round = 0
     if resume:
@@ -424,13 +465,14 @@ def run_experiment(
                                     xs_d, ys_d, k_round, dp=cfg.dp,
                                     participation=part, poison=pois,
                                     num_real_clients=num_real,
+                                    packing=pspec,
                                 )
                             )
                         else:
                             ct_sum, metrics, overflow = secure_fedavg_round(
                                 module, train_cfg, mesh, ctx, pk, params,
                                 xs_d, ys_d, k_round, dp=cfg.dp,
-                                num_real_clients=num_real,
+                                num_real_clients=num_real, packing=pspec,
                             )
                         # Stage the next round's arrays while this round
                         # computes (no-op while the dataset stays
@@ -441,8 +483,14 @@ def run_experiment(
                             # The synchronous round waits for its slowest
                             # scheduled straggler (driver-level simulation;
                             # shows up in the phase wall-clock like a real
-                            # straggler would).
-                            time.sleep(straggler_s)
+                            # straggler would). The TraceAnnotation makes
+                            # the wait a first-class host span in profiler
+                            # traces (obs.trace `host_rows`) instead of an
+                            # unexplained wall-vs-device gap.
+                            with jax.profiler.TraceAnnotation(
+                                obs_scopes.STRAGGLER_WAIT
+                            ):
+                                time.sleep(straggler_s)
                     with timer.phase("decrypt"):
                         if meta is not None and meta.surviving == 0:
                             # Nobody made the round: the ciphertext is an
@@ -462,6 +510,7 @@ def run_experiment(
                             new_params = decrypt_average(
                                 ctx, sk, ct_sum, cfg.num_clients, spec,
                                 exact=exact, meta=meta,
+                                packing=pspec, base_params=params,
                             )
                             jax.block_until_ready(new_params)
                 else:
@@ -481,7 +530,10 @@ def run_experiment(
                         prefetcher.prefetch(xs, ys)
                         jax.block_until_ready((new_params, metrics))
                         if straggler_s > 0:
-                            time.sleep(straggler_s)
+                            with jax.profiler.TraceAnnotation(
+                                obs_scopes.STRAGGLER_WAIT
+                            ):
+                                time.sleep(straggler_s)
                 params = new_params
                 break
             except RuntimeError as e:
@@ -575,22 +627,30 @@ def run_experiment(
             record["encode_overflow"] = np.asarray(overflow).tolist()
             overflow_total = int(np.sum(overflow))
             if overflow_total > 0:
+                # Under packing the same slot counts QUANTIZER saturation
+                # (|update| > PackingConfig.clip) instead of encoder
+                # saturation — the remedy is the clip, not the scale.
+                envelope, remedy = (
+                    ("quantizer clip", "raise packing.clip")
+                    if pspec is not None
+                    else ("CKKS encode envelope", "lower he.scale")
+                )
                 excluded_for_overflow = (
                     meta is not None and meta.excluded.get("overflow", 0) > 0
                 )
                 if train_cfg.on_overflow == "raise":
                     raise RuntimeError(
                         f"round {r}: {overflow_total} weights saturated the "
-                        "CKKS encode envelope and on_overflow='raise' — "
-                        "lower he.scale or switch to on_overflow='exclude'"
+                        f"{envelope} and on_overflow='raise' — {remedy} or "
+                        "switch to on_overflow='exclude'"
                     )
                 if excluded_for_overflow:
                     say(f"round {r}: excluded "
                         f"{meta.excluded['overflow']} client(s) whose "
-                        "updates saturated the encoder envelope")
+                        f"updates saturated the {envelope}")
                 else:
                     say(f"WARNING: round {r} clipped {overflow_total} "
-                        "weights at the encoder envelope; lower he.scale")
+                        f"weights at the {envelope}; {remedy}")
         if robust and meta is not None:
             # Per-round robustness record: the participation mask the
             # program applied, surviving count (the decode denominator),
@@ -672,6 +732,10 @@ def run_experiment(
         # Which HE backend (fused Pallas kernels vs the XLA reference) the
         # encrypt/decrypt programs traced with (HEFL_HE; ckks.backend).
         "he_backend": he_backend_report(),
+        # Quantized bit-interleaved packing geometry (None = the historical
+        # float path): packed vs unpacked ciphertext counts and the
+        # declared quantization-error budget.
+        "packing": pspec.geometry_record() if pspec is not None else None,
         # Observability record: where this run's events.jsonl went (None =
         # disabled) + THIS RUN's metrics (counters as deltas against the
         # run-start baseline; exclusions by cause, retries, resumes,

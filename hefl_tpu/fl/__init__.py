@@ -13,7 +13,7 @@ local epochs run simultaneously (vmap within a device, shard_map across
 devices) and aggregation is a collective.
 """
 
-from hefl_tpu.fl.config import TrainConfig
+from hefl_tpu.fl.config import PackingConfig, TrainConfig
 from hefl_tpu.fl.client import local_train, train_centralized
 from hefl_tpu.fl.dp import DpConfig, clip_by_global_norm, dp_sanitize, epsilon_spent
 from hefl_tpu.fl.faults import (
@@ -29,11 +29,14 @@ from hefl_tpu.fl.secure import (
     aggregate_encrypted,
     decrypt_average,
     encrypt_params,
+    encrypt_params_packed,
     encrypt_stack,
+    encrypt_stack_packed,
     secure_fedavg_round,
 )
 
 __all__ = [
+    "PackingConfig",
     "TrainConfig",
     "DpConfig",
     "DeviceLost",
@@ -51,7 +54,9 @@ __all__ = [
     "evaluate",
     "classification_metrics",
     "encrypt_params",
+    "encrypt_params_packed",
     "encrypt_stack",
+    "encrypt_stack_packed",
     "aggregate_encrypted",
     "decrypt_average",
     "secure_fedavg_round",

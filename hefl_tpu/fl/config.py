@@ -12,6 +12,13 @@ from __future__ import annotations
 
 import dataclasses
 
+# Re-exported here because this module is the FL-layer's config surface:
+# PackingConfig (quantized bit-interleaved CKKS packing — bits, interleave
+# factor, clip, guard, error budget) is DEFINED next to the quantizer it
+# parameterizes (ckks.quantize) but threads through TrainConfig's siblings
+# into fl.secure's encrypt/psum/decrypt paths and ExperimentConfig.
+from hefl_tpu.ckks.quantize import PackingConfig  # noqa: F401
+
 
 @dataclasses.dataclass(frozen=True)
 class TrainConfig:

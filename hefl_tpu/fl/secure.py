@@ -26,6 +26,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
 from jax.sharding import PartitionSpec as P
 
 import numpy as np
@@ -33,7 +34,14 @@ import numpy as np
 from hefl_tpu.ckks import encoding, ops
 from hefl_tpu.ckks.keys import CkksContext, PublicKey, SecretKey
 from hefl_tpu.ckks.ops import Ciphertext
-from hefl_tpu.ckks.packing import PackSpec, pack_pytree, unpack_blocks
+from hefl_tpu.ckks.packing import (
+    PackedSpec,
+    PackSpec,
+    pack_pytree,
+    pack_quantized_delta,
+    unpack_blocks,
+    unpack_quantized,
+)
 from hefl_tpu.fl.config import TrainConfig
 from hefl_tpu.fl.faults import RoundMeta, exclusion_bits, poison_tree
 from hefl_tpu.fl.fedavg import (
@@ -71,6 +79,25 @@ def encrypt_params(
         blocks = pack_pytree(params, ctx.n)
         m_res = encoding.encode(ctx.ntt, blocks, ctx.scale)
         return ops.encrypt(ctx, pk, m_res, key)
+
+
+@partial(jax.jit, static_argnums=(0, 5))
+def encrypt_params_packed(
+    ctx: CkksContext,
+    pk: PublicKey,
+    params,
+    base_params,
+    key: jax.Array,
+    spec: PackedSpec,
+) -> Ciphertext:
+    """Encrypt one client's quantized bit-interleaved UPDATE (params minus
+    base_params) -> batched Ciphertext [spec.n_ct, L, N]: the packed twin of
+    `encrypt_params`, k-fold fewer rows through the same encrypt core."""
+    with jax.named_scope(obs_scopes.ENCRYPT):
+        hi, lo, _ = pack_quantized_delta(params, base_params, spec)
+        m_res = encoding.encode_packed(ctx.ntt, hi, lo)
+        ct = ops.encrypt(ctx, pk, m_res, key)
+        return Ciphertext(c0=ct.c0, c1=ct.c1, scale=spec.guard_scale)
 
 
 def _lazy_sum_mod(x: jax.Array, p: jax.Array) -> jax.Array:
@@ -119,6 +146,44 @@ def encrypt_stack(ctx: CkksContext, pk: PublicKey, p_out, enc_keys) -> Ciphertex
         lambda k: ops.encrypt_samples(ctx, k, (n_ct,))
     )(enc_keys)
     return ops.encrypt_core(ctx, pk, m_res, u, e0, e1)
+
+
+def encrypt_stack_packed(
+    ctx: CkksContext,
+    pk: PublicKey,
+    p_out,
+    base_params,
+    enc_keys,
+    spec: PackedSpec,
+) -> tuple[Ciphertext, jax.Array]:
+    """The packed-quantized twin of `encrypt_stack`: each client's UPDATE
+    (trained weights minus `base_params`, the round's global weights) is
+    quantized to `spec.bits` bits and bit-interleaved `spec.k`-to-a-slot
+    (ckks.packing), so the batched ciphertext is [C, n_ct/k, L, N] and
+    every downstream kernel — the fused Pallas/XLA encrypt core here, the
+    masked psum, the owner decrypt — sees k-fold fewer rows.
+
+    -> (Ciphertext [C, spec.n_ct, L, N], saturation int32[C]): `saturation`
+    counts each client's update coefficients that clipped at `spec.clip`
+    (the packed analog of `encode_overflow_count`; it reports through the
+    same `encode_overflow` output slot and drives the same
+    on_overflow="exclude" machinery).
+    """
+
+    def enc_one(prm):
+        hi, lo, sat = pack_quantized_delta(prm, base_params, spec)
+        return encoding.encode_packed(ctx.ntt, hi, lo), sat
+
+    m_res, sat = jax.vmap(enc_one)(p_out)
+    n_ct = int(m_res.shape[1])
+    u, e0, e1 = jax.vmap(
+        lambda k: ops.encrypt_samples(ctx, k, (n_ct,))
+    )(enc_keys)
+    ct = ops.encrypt_core(ctx, pk, m_res, u, e0, e1)
+    return (
+        Ciphertext(c0=ct.c0, c1=ct.c1, scale=spec.guard_scale),
+        sat,
+    )
 
 
 def _pad_rows(arr: jax.Array, mult: int) -> jax.Array:
@@ -255,6 +320,8 @@ def decrypt_average(
     exact: bool = False,
     meta: "RoundMeta | None" = None,
     mesh=None,
+    packing: PackedSpec | None = None,
+    base_params=None,
 ):
     """Owner-side decrypt of the aggregated sum -> averaged parameter pytree.
 
@@ -266,6 +333,16 @@ def decrypt_average(
     ciphertext axis — bitwise-equal residues, owner-side throughput scaling
     with devices (ISSUE 4).
 
+    `packing` (a `ckks.packing.PackedSpec`) switches to the packed-quantized
+    decode: the [n_ct/k, L, N] aggregate decrypts through the same core,
+    then the payload integers are recovered EXACTLY (`decode_int_center` +
+    one guard-rounding shift — decrypt noise cannot touch the bit fields
+    while it stays under 2**(guard-1)), deinterleaved, offset-corrected by
+    `surviving` (the same RoundMeta count the float path divides by), and
+    dequantized into the AVERAGE update, which is added onto `base_params`
+    (the round's global weights — required with `packing`). `exact` is
+    moot (the packed decode is already exact); `spec` is unused.
+
     Under partial participation the denominator MUST be the round's
     surviving-client count, not the static experiment-wide total — dividing
     a k-client sum by C silently shrinks the model toward zero. Pass the
@@ -276,8 +353,13 @@ def decrypt_average(
     `decrypt_average(ctx, sk, ct, num_clients, spec)` keeps working: no
     meta means full participation and `num_clients` is the denominator.
     """
-    if spec is None:
+    if packing is None and spec is None:
         raise TypeError("decrypt_average: spec (the PackSpec) is required")
+    if packing is not None and base_params is None:
+        raise TypeError(
+            "decrypt_average: the packed path decodes AVERAGE UPDATES — "
+            "pass base_params (the round's global weights) to add them to"
+        )
     if meta is not None:
         if num_clients is not None and int(num_clients) != int(meta.num_clients):
             raise ValueError(
@@ -304,6 +386,11 @@ def decrypt_average(
             res = decrypt_sharded(ctx, sk, ct_sum, mesh)
         else:
             res = ops.decrypt(ctx, sk, ct_sum)
+        if packing is not None:
+            v = encoding.decode_int_center(ctx.ntt, res)
+            delta = unpack_quantized(v, packing, surviving)
+            base_flat, unravel = ravel_pytree(base_params)
+            return unravel(base_flat + jnp.asarray(delta))
         denom = ct_sum.scale * surviving
         if exact:
             blocks = jnp.asarray(
@@ -331,6 +418,7 @@ def secure_fedavg_round(
     participation=None,
     poison=None,
     num_real_clients: int | None = None,
+    packing: PackedSpec | None = None,
 ) -> tuple:
     """One encrypted FedAvg round: local training + encrypt + psum, jitted.
 
@@ -374,7 +462,23 @@ def secure_fedavg_round(
     `num_real_clients` (with xs/ys pre-padded by `fedavg.pad_federated`)
     hoists the per-round padding gather out of the round — the same
     contract as `fedavg_round`.
+
+    `packing` (a `ckks.packing.PackedSpec`) routes the upload through the
+    quantized bit-interleaved encoder (`encrypt_stack_packed`): k-fold
+    fewer ciphertext rows through the identical encrypt/mask/psum program
+    structure, `encode_overflow` reporting quantizer saturation instead of
+    encoder saturation, and `decrypt_average(..., packing=, base_params=)`
+    on the owner side. packing=None is the historical float path,
+    bit-for-bit (same compiled programs).
     """
+    if packing is not None and packing.clients < (
+        num_real_clients or int(xs.shape[0])
+    ):
+        raise ValueError(
+            f"packing spec sized for {packing.clients} clients cannot hold "
+            f"a carry-free sum over {num_real_clients or int(xs.shape[0])} "
+            "— rebuild PackedSpec.for_params with the experiment's count"
+        )
     n_dev = client_mesh_size(mesh)
     num_clients, pad_idx, prepadded = _round_geometry(
         xs, n_dev, num_real_clients
@@ -400,6 +504,9 @@ def secure_fedavg_round(
     # now a decrypt_average output) reuses round 0's executable — see
     # fedavg.replicate_on.
     gp = replicate_on(mesh, global_params)
+    # Passing packing ONLY when enabled keeps the historical factory cache
+    # keys (and so the compiled-program reuse) bit-for-bit untouched.
+    pk_kw = {} if packing is None else {"packing": packing}
     if not masked or trivial:
         # Historical program (also the all-ones/no-poison masked call: the
         # mask cannot change the sum, so reuse the legacy executable and
@@ -408,12 +515,13 @@ def secure_fedavg_round(
             # Keep the historical 5-arg cache key: dp-off rounds of any
             # client count share one compiled program per configuration.
             fn = _build_secure_round_fn(
-                module, cfg, mesh, ctx, with_plain_reference
+                module, cfg, mesh, ctx, with_plain_reference, **pk_kw
             )
             outs = fn(gp, pk, xs, ys, train_keys, enc_keys)
         else:
             fn = _build_secure_round_fn(
-                module, cfg, mesh, ctx, with_plain_reference, dp, num_clients
+                module, cfg, mesh, ctx, with_plain_reference, dp, num_clients,
+                **pk_kw,
             )
             outs = fn(gp, pk, xs, ys, train_keys, enc_keys, dp_keys)
         if not masked:
@@ -429,7 +537,7 @@ def secure_fedavg_round(
             xs, ys = xs[pad_idx], ys[pad_idx]
     fn = _build_secure_round_fn(
         module, cfg, mesh, ctx, with_plain_reference, dp, num_clients,
-        masked=True,
+        masked=True, **pk_kw,
     )
     args = (gp, pk, xs, ys, train_keys, enc_keys)
     if dp is not None:
@@ -462,6 +570,7 @@ def _build_secure_round_fn(
     dp=None,
     num_clients: int = 0,
     masked: bool = False,
+    packing: PackedSpec | None = None,
 ):
     """Compile-once factory for the encrypted round program (same rationale
     as fedavg._build_round_fn: one trace/compile per configuration, reused
@@ -519,13 +628,22 @@ def _build_secure_round_fn(
         # Phase scope (obs): pack/encode/overflow-count + the encrypt core
         # are one hefl.encrypt trace bucket.
         with jax.named_scope(obs_scopes.ENCRYPT):
-            # Saturation diagnostic on exactly what gets encoded (the packed
-            # blocks); XLA CSEs the duplicate pack with encrypt_params' own.
-            ov_one = lambda prm: encoding.encode_overflow_count(  # noqa: E731
-                pack_pytree(prm, ctx.n), ctx.scale
-            )
-            overflow = jax.vmap(ov_one)(p_out)             # [cpd] int32
-            cts = encrypt_stack(ctx, pk, p_out, ke_blk)    # [cpd, n_ct, L, N]
+            if packing is not None:
+                # Quantized bit-interleaved upload: k-fold fewer ciphertext
+                # rows; `overflow` carries the quantizer saturation count
+                # (same slot, same on_overflow machinery).
+                cts, overflow = encrypt_stack_packed(
+                    ctx, pk, p_out, gp, ke_blk, packing
+                )                                          # [cpd, n_ct/k, ...]
+            else:
+                # Saturation diagnostic on exactly what gets encoded (the
+                # packed blocks); XLA CSEs the duplicate pack with
+                # encrypt_params' own.
+                ov_one = lambda prm: encoding.encode_overflow_count(  # noqa: E731
+                    pack_pytree(prm, ctx.n), ctx.scale
+                )
+                overflow = jax.vmap(ov_one)(p_out)         # [cpd] int32
+                cts = encrypt_stack(ctx, pk, p_out, ke_blk)  # [cpd, n_ct, L, N]
         with jax.named_scope(obs_scopes.PSUM_AGGREGATE):
             if masked:
                 with jax.named_scope(obs_scopes.SANITIZE):

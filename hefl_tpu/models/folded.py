@@ -42,10 +42,111 @@ Layout contract shared by every primitive:
 from __future__ import annotations
 
 import math
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _conv_taps(xg, kb, strides, out_hw):
+    """The kernel-offset GEMM core of `folded_conv` with an explicit VJP.
+
+    Forward is the tap loop unchanged (kh*kw client-batched dot_generals
+    on the compute-dtype operands, f32 partial-sum accumulation, ONE
+    rounding to the compute dtype).
+
+    The custom VJP exists for the PRECISION story, not the math: under
+    plain autodiff the `preferred_element_type=f32` sticks to every
+    transposed dot_general, so the backward pass materializes its
+    input-gradients — the tensors handed BETWEEN layers — in float32,
+    doubling backward activation-bandwidth over the bf16 forward. Here the
+    backward mirrors the forward's dtype discipline exactly: every dgrad/
+    wgrad GEMM runs on the bf16 residuals/cotangent with f32 ACCUMULATION
+    (preferred_element_type), cross-tap partials accumulate in f32, and
+    each result rounds ONCE to the operand's dtype — the input gradient to
+    the activation dtype (inter-layer tensors are bf16, same bytes as the
+    forward activations) and the weight gradient to the compute-dtype
+    kernel view, which the `kernel.astype` transpose outside then upcasts.
+    That one bf16 rounding on the wgrad is the HISTORICAL semantics: it is
+    what both plain autodiff of this einsum form and the vmapped
+    flax.linen.Conv(dtype=bf16) reference produce, and the fused-vs-vmap
+    parity tests pin it.
+
+    xg: [C, B, H, W, ch] compute-dtype activations; kb: [C, kh, kw, ch, f]
+    compute-dtype filters. -> [C, B, H', W', f] in xg.dtype.
+    """
+    return _conv_taps_impl(xg, kb, strides, out_hw)
+
+
+def _conv_taps_impl(xg, kb, strides, out_hw):
+    sh, sw = strides
+    ho, wo = out_hw
+    c, b = xg.shape[0], xg.shape[1]
+    ch = xg.shape[4]
+    kh, kw = kb.shape[1], kb.shape[2]
+
+    acc = None
+    for i in range(kh):
+        for j in range(kw):
+            xs = lax.slice(
+                xg,
+                (0, 0, i, j, 0),
+                (c, b, i + (ho - 1) * sh + 1, j + (wo - 1) * sw + 1, ch),
+                (1, 1, sh, sw, 1),
+            )
+            t = jnp.einsum(
+                "cbpqi,cio->cbpqo", xs, kb[:, i, j],
+                preferred_element_type=jnp.float32,
+            )
+            acc = t if acc is None else acc + t
+    return acc.astype(xg.dtype)
+
+
+def _conv_taps_fwd(xg, kb, strides, out_hw):
+    return _conv_taps_impl(xg, kb, strides, out_hw), (xg, kb)
+
+
+def _conv_taps_bwd(strides, out_hw, res, g):
+    # g arrives in the compute dtype (the forward output's aval): the
+    # incoming cotangent is already bf16-sized. Both gradients are the
+    # einsum transposes of the forward taps — still client-batched GEMMs,
+    # never a grouped conv — with f32 accumulation and one final rounding
+    # to the respective operand dtype (see _conv_taps' docstring for why
+    # the wgrad rounding is the historical/flax-parity semantics).
+    xg, kb = res
+    sh, sw = strides
+    ho, wo = out_hw
+    c, b = xg.shape[0], xg.shape[1]
+    ch = xg.shape[4]
+    kh, kw = kb.shape[1], kb.shape[2]
+
+    dxg = jnp.zeros(xg.shape, jnp.float32)
+    dk_taps = []
+    for i in range(kh):
+        for j in range(kw):
+            lo_h, hi_h = i, i + (ho - 1) * sh + 1
+            lo_w, hi_w = j, j + (wo - 1) * sw + 1
+            xs = lax.slice(
+                xg, (0, 0, i, j, 0), (c, b, hi_h, hi_w, ch),
+                (1, 1, sh, sw, 1),
+            )
+            dk_taps.append(jnp.einsum(
+                "cbpqi,cbpqo->cio", xs, g,
+                preferred_element_type=jnp.float32,
+            ))
+            dxs = jnp.einsum(
+                "cbpqo,cio->cbpqi", g, kb[:, i, j],
+                preferred_element_type=jnp.float32,
+            )
+            # Overlapping tap windows accumulate additively (in f32).
+            dxg = dxg.at[:, :, lo_h:hi_h:sh, lo_w:hi_w:sw, :].add(dxs)
+    dk = jnp.stack(dk_taps, axis=1).reshape(kb.shape).astype(kb.dtype)
+    return dxg.astype(xg.dtype), dk
+
+
+_conv_taps.defvjp(_conv_taps_fwd, _conv_taps_bwd)
 
 
 def fold_clients(x: jax.Array) -> jax.Array:
@@ -88,8 +189,12 @@ def folded_conv(
     accumulation dtype) and round ONCE to `dtype` — matching
     flax.linen.Conv(dtype=bf16, param_dtype=f32) numerics at equal
     inputs. Autodiff of this form stays in the same GEMM family: the
-    weight- and input-gradients are the einsum transposes, never a
-    grouped-conv slow path.
+    weight- and input-gradients are the einsum transposes (`_conv_taps`'
+    custom VJP), never a grouped-conv slow path — and the backward keeps
+    the forward's dtype discipline: inter-layer gradient tensors are
+    `dtype` (bf16), f32 only inside GEMM accumulation and the cross-tap
+    partial sums, halving backward activation bandwidth vs the plain-
+    autodiff f32 cotangents.
     """
     c = num_clients
     kh, kw, ch, f = kernel.shape[1:]
@@ -110,21 +215,7 @@ def folded_conv(
     ho = (h - kh) // sh + 1
     wo = (w - kw) // sw + 1
     xg = xb.reshape(c, b, h, w, ch)
-    acc = None
-    for i in range(kh):
-        for j in range(kw):
-            xs = lax.slice(
-                xg,
-                (0, 0, i, j, 0),
-                (c, b, i + (ho - 1) * sh + 1, j + (wo - 1) * sw + 1, ch),
-                (1, 1, sh, sw, 1),
-            )
-            t = jnp.einsum(
-                "cbpqi,cio->cbpqo", xs, k[:, i, j],
-                preferred_element_type=jnp.float32,
-            )
-            acc = t if acc is None else acc + t
-    out = acc.astype(dtype)
+    out = _conv_taps(xg, k, (sh, sw), (ho, wo))
     if bias is not None:
         out = out + bias.astype(dtype)[:, None, None, None, :]
     return out.reshape(cb, ho, wo, f)

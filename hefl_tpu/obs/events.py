@@ -35,6 +35,15 @@ producers (fl.faults, utils.autoselect, the compile listener) need no
 plumbing; `HEFL_EVENTS=0` disables every write without code changes (the
 test suite and short CLI runs set it). Appending is line-buffered append
 — a crashed run keeps every line emitted before the crash.
+
+The file is SIZE-CAPPED: when an emit would push it past
+`HEFL_EVENTS_MAX_BYTES` (default 64 MiB; 0 disables the cap) the current
+file rotates to `<path>.1` (replacing any previous rotation) and a fresh
+file starts with its own `log_open` header carrying `rotated_from` — so a
+multi-day aggregation-service run keeps a bounded recent window plus one
+generation of history instead of an unbounded append. Gates that read the
+CURRENT file see a parseable log either way (`read_events` never needs
+the rotated half).
 """
 
 from __future__ import annotations
@@ -56,6 +65,18 @@ def enabled() -> bool:
     return os.environ.get("HEFL_EVENTS", "1") != "0"
 
 
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+
+
+def max_bytes() -> int:
+    """Rotation threshold (HEFL_EVENTS_MAX_BYTES; 0 = never rotate).
+    Checked per emit, like `enabled`, so tests set tiny caps via env."""
+    try:
+        return int(os.environ.get("HEFL_EVENTS_MAX_BYTES", DEFAULT_MAX_BYTES))
+    except ValueError:
+        return DEFAULT_MAX_BYTES
+
+
 def _jsonable(obj: Any):
     """numpy scalars/arrays -> python; anything else stringified (an event
     writer must never raise into the training loop)."""
@@ -73,27 +94,50 @@ class EventLog:
     def __init__(self, path: str):
         self.path = path
         self._f: IO[str] | None = None
+        self._bytes = 0           # current file size (tracked, not stat'd)
+
+    def _open(self, rotated_from: str | None = None) -> None:
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(self.path, "a", buffering=1)
+        self._bytes = os.path.getsize(self.path)
+        if self._bytes == 0:
+            header = {
+                "ts": round(time.time(), 6),
+                "event": "log_open",
+                "schema_version": SCHEMA_VERSION,
+                "pid": os.getpid(),
+            }
+            if rotated_from:
+                header["rotated_from"] = rotated_from
+            line = json.dumps(header) + "\n"
+            self._f.write(line)
+            self._bytes += len(line)
+
+    def _rotate(self) -> None:
+        """Move the full file aside to `<path>.1` (one generation kept) and
+        start fresh — bounded disk for multi-day runs, see module doc."""
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        rotated = self.path + ".1"
+        try:
+            os.replace(self.path, rotated)
+        except OSError:
+            rotated = None
+        self._open(rotated_from=rotated)
 
     def emit(self, event: str, **fields: Any) -> dict:
         rec = {"ts": round(time.time(), 6), "event": event, **fields}
         if self._f is None:
-            d = os.path.dirname(self.path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            self._f = open(self.path, "a", buffering=1)
-            if os.path.getsize(self.path) == 0:
-                self._f.write(
-                    json.dumps(
-                        {
-                            "ts": rec["ts"],
-                            "event": "log_open",
-                            "schema_version": SCHEMA_VERSION,
-                            "pid": os.getpid(),
-                        }
-                    )
-                    + "\n"
-                )
-        self._f.write(json.dumps(rec, default=_jsonable) + "\n")
+            self._open()
+        line = json.dumps(rec, default=_jsonable) + "\n"
+        cap = max_bytes()
+        if cap and self._bytes and self._bytes + len(line) > cap:
+            self._rotate()
+        self._f.write(line)
+        self._bytes += len(line)
         return rec
 
     def close(self) -> None:

@@ -39,6 +39,12 @@ AGGREGATE = "hefl.aggregate"          # plaintext (masked) FedAvg mean + pmean
 DECRYPT = "hefl.decrypt"              # c0 + c1*s, iNTT, decode, unpack
 EVALUATE = "hefl.evaluate"            # test-set forward + softmax
 
+# HOST-side spans (jax.profiler.TraceAnnotation, not named_scope): driver
+# work that owns wall-clock but runs no device ops. The trace parser
+# reports them as `host_rows` so e.g. a straggler wait is a first-class
+# row instead of an unexplained wall-vs-device gap.
+STRAGGLER_WAIT = "hefl.straggler_wait"  # driver-side straggler sleep
+
 # Canonical ordering for tables; the trace parser buckets ANY "hefl.*"
 # component it finds, so adding a scope never requires touching the parser.
 PHASES = (

@@ -203,6 +203,11 @@ def trace_attribution(
       "unattributed_s":   device-busy time no scoped op covers,
       "device_total_s":   union of ALL device-op events,
       "modules": {module: device_seconds},                # per program
+      "host_rows": {span: {"seconds", "spans"}},          # hefl.* host
+                          TraceAnnotations (driver-side work that owns
+                          wall-clock but runs no device ops — straggler
+                          waits, PhaseTimer brackets); NOT part of the
+                          device rows or the wall-agreement gate,
       "op_events": total device-op events considered,
       "source": "trace",
     }
@@ -228,6 +233,7 @@ def trace_attribution(
     per_phase: dict[str, list[tuple[float, float]]] = {}
     per_phase_n: dict[str, int] = {}
     per_module: dict[str, list[tuple[float, float]]] = {}
+    host_iv: dict[str, list[tuple[float, float]]] = {}
     all_iv: list[tuple[float, float]] = []
     attributed_iv: list[tuple[float, float]] = []
     n_ops = 0
@@ -237,6 +243,14 @@ def trace_attribution(
         args = ev.get("args") or {}
         module = args.get("hlo_module")
         if module not in scope_maps:
+            # Host-side hefl.* TraceAnnotations (e.g. hefl.straggler_wait,
+            # the PhaseTimer hefl.phase.* brackets) carry no hlo_module:
+            # bucket them as first-class host rows so driver-side waits
+            # stop reading as an unexplained wall-vs-device gap.
+            name = str(ev.get("name") or "")
+            if name.startswith(scopes.PREFIX):
+                ts, dur = float(ev.get("ts", 0.0)), float(ev.get("dur", 0.0))
+                host_iv.setdefault(name, []).append((ts, ts + dur))
             continue
         op = args.get("hlo_op") or ev.get("name") or ""
         ts, dur = float(ev.get("ts", 0.0)), float(ev.get("dur", 0.0))
@@ -283,6 +297,13 @@ def trace_attribution(
         "modules": {
             m: round(_merged_length_us(iv) / 1e6, 6)
             for m, iv in sorted(per_module.items())
+        },
+        "host_rows": {
+            name: {
+                "seconds": round(_merged_length_us(iv) / 1e6, 6),
+                "spans": len(iv),
+            }
+            for name, iv in sorted(host_iv.items())
         },
         "op_events": n_ops,
         **({"suspected_truncated": True} if truncated else {}),

@@ -16,7 +16,12 @@
 #       events.jsonl, whose per-round round_robust exclusion records and
 #       round_retry events must match the deterministic fault schedule
 #       EXACTLY, and whose experiment_end metrics counters must equal the
-#       schedule's totals.
+#       schedule's totals;
+#   (f) packed quantized aggregation (ISSUE 6): the SAME faulted schedule
+#       re-run with the b=8/k=2 packed upload must exclude the identical
+#       clients, keep all params finite, and land within the accuracy
+#       tolerance of the unpacked faulted run — quantization at the
+#       declared budget must not change robustness behavior.
 # Artifact: CHAOS_SMOKE.json (both accuracy curves + per-round exclusions
 # + the events.jsonl cross-check).
 # Wired into run_tpu_suite.sh as stage 0b (CPU-only, no TPU probe needed).
@@ -64,6 +69,18 @@ print("chaos smoke: clean twin ...", flush=True)
 clean = run_experiment(clean_cfg, verbose=False)
 print("chaos smoke: faulted run ...", flush=True)
 chaos = run_experiment(cfg, verbose=False)
+
+# (f) packed twin of the faulted run (ISSUE 6): identical schedule, b=8
+# quantized k=2-interleaved upload. The event log belongs to the unpacked
+# run, so the packed twin runs with the writer off.
+from hefl_tpu.fl import PackingConfig
+
+packed_cfg = dataclasses.replace(
+    cfg, events_path="",
+    packing=PackingConfig(bits=8, interleave=2, clip=0.5),
+)
+print("chaos smoke: packed faulted twin (b=8 k=2) ...", flush=True)
+packed = run_experiment(packed_cfg, verbose=False)
 
 fail = []
 rounds = []
@@ -113,6 +130,37 @@ if abs(acc_clean - acc_chaos) > ACC_TOL:
         f"final accuracy diverged: clean {acc_clean:.4f} vs chaos "
         f"{acc_chaos:.4f} (tol {ACC_TOL})"
     )
+
+# (f) packed twin gates: same exclusions as the schedule, finite params,
+# accuracy within tolerance of the UNPACKED faulted run, and the packing
+# record present in the result.
+acc_packed = packed["history"][-1]["accuracy"]
+if abs(acc_packed - acc_chaos) > ACC_TOL:
+    fail.append(
+        f"packed faulted run diverged from unpacked: {acc_packed:.4f} vs "
+        f"{acc_chaos:.4f} (tol {ACC_TOL})"
+    )
+if not isinstance(packed.get("packing"), dict) or packed["packing"]["interleave"] != 2:
+    fail.append("packed run result carries no packing record")
+for r, rec in enumerate(packed["history"]):
+    rob = rec.get("robust")
+    if rob is None:
+        fail.append(f"packed round {r}: no robustness metadata")
+        continue
+    sched = schedule_for_round(cfg.faults, r, cfg.num_clients)
+    expect = set(np.flatnonzero(sched.dropped).tolist()) | set(
+        np.flatnonzero(sched.poison).tolist()
+    )
+    got = {i for i, p in enumerate(rob["participation"]) if not p}
+    if got != expect:
+        fail.append(
+            f"packed round {r}: excluded {sorted(got)} but schedule says "
+            f"{sorted(expect)}"
+        )
+for leaf in jax.tree_util.tree_leaves(packed["params"]):
+    if not np.all(np.isfinite(np.asarray(leaf))):
+        fail.append("packed run's final params contain non-finite values")
+        break
 
 # (e) events.jsonl cross-check: the structured log must tell the SAME
 # story as the fault schedule — per-round exclusions, retries, and the
@@ -198,6 +246,8 @@ artifact = {
     "preset": "chaos-smoke",
     "acc_clean_by_round": [h["accuracy"] for h in clean["history"]],
     "acc_chaos_by_round": [h["accuracy"] for h in chaos["history"]],
+    "acc_packed_by_round": [h["accuracy"] for h in packed["history"]],
+    "packing": packed.get("packing"),
     "rounds": rounds,
     "acc_tolerance": ACC_TOL,
     # The structured-event cross-check (events.jsonl vs fault schedule).
@@ -214,9 +264,9 @@ if fail:
         print(" -", f_)
     sys.exit(1)
 print(
-    f"chaos smoke OK: clean {acc_clean:.4f} vs chaos {acc_chaos:.4f}, "
-    "exclusions match the schedule exactly, no unflagged NaNs, "
-    "device-loss retry exercised, events.jsonl counters match the "
-    "fault schedule"
+    f"chaos smoke OK: clean {acc_clean:.4f} vs chaos {acc_chaos:.4f} vs "
+    f"packed {acc_packed:.4f}, exclusions match the schedule exactly "
+    "(packed twin included), no unflagged NaNs, device-loss retry "
+    "exercised, events.jsonl counters match the fault schedule"
 )
 PY

@@ -41,6 +41,14 @@ HEFL_NTT=pallas-interpret python -m pytest -q -m "not slow" \
   tests/test_modular.py tests/test_ntt.py tests/test_pallas_ntt.py \
   tests/test_pallas_he.py tests/test_ckks.py
 echo "== HEFL_NTT=pallas-interpret ckks shard: $((SECONDS - t0))s"
+# Packing shard (ISSUE 6): the quantized bit-interleaved pipeline —
+# quantizer/interleaver units, packed secure-round parity, the bf16
+# backward guarantee — re-run under the Pallas-interpret NTT selector so
+# the packed [n_ct/k] shapes also exercise the kernel dispatch family.
+t0=$SECONDS
+HEFL_NTT=pallas-interpret python -m pytest -q -m "not slow" \
+  tests/test_packing.py
+echo "== packing shard (pallas-interpret): $((SECONDS - t0))s"
 for k in $(seq 1 "$N"); do
   run "slow shard $k/$N" -m slow --shard "$k/$N"
 done

@@ -112,6 +112,33 @@ def test_corrupt_and_truncated_traces_fail_loud(tmp_path):
         obs_trace.trace_attribution(GOLDEN_TRACE, [])
 
 
+def test_host_trace_annotations_become_host_rows():
+    # Driver-side hefl.* TraceAnnotations (no hlo_module in args) must
+    # surface as first-class host_rows — e.g. the straggler wait — without
+    # perturbing the device rows or the wall-agreement quantity.
+    base = obs_trace.trace_attribution(GOLDEN_TRACE, [_golden_hlo()])
+    events = obs_trace.load_trace_events(GOLDEN_TRACE)
+    events = events + [
+        {"ph": "X", "name": "hefl.straggler_wait", "ts": 1000.0,
+         "dur": 250.0, "args": {}},
+        {"ph": "X", "name": "hefl.straggler_wait", "ts": 2000.0,
+         "dur": 150.0},
+        {"ph": "X", "name": "hefl.phase.decrypt", "ts": 0.0, "dur": 50.0},
+        # Non-hefl host events stay ignored.
+        {"ph": "X", "name": "SomeRuntimeThing", "ts": 0.0, "dur": 9999.0},
+    ]
+    rec = obs_trace.trace_attribution(events, [_golden_hlo()])
+    assert rec["host_rows"]["hefl.straggler_wait"] == {
+        "seconds": pytest.approx(400e-6), "spans": 2,
+    }
+    assert rec["host_rows"]["hefl.phase.decrypt"]["spans"] == 1
+    assert "SomeRuntimeThing" not in rec["host_rows"]
+    # Device-side attribution is untouched by host spans.
+    assert rec["rows"] == base["rows"]
+    assert rec["device_total_s"] == base["device_total_s"]
+    assert rec["unattributed_s"] == base["unattributed_s"]
+
+
 # --------------------------------------- scopes survive jit, both backends
 
 
@@ -169,6 +196,41 @@ def test_event_log_roundtrip(tmp_path):
     # numpy payloads are converted, not crashed on.
     assert evs[2]["participation"] == [1, 0]
     assert all("ts" in e for e in evs)
+
+
+def test_event_log_rotates_at_size_cap(tmp_path, monkeypatch):
+    # HEFL_EVENTS_MAX_BYTES: the append-only log must rotate to <path>.1
+    # instead of growing unbounded; both generations stay strictly
+    # parseable and no emitted record is lost across the boundary.
+    path = tmp_path / "events.jsonl"
+    monkeypatch.setenv("HEFL_EVENTS_MAX_BYTES", "400")
+    log = obs_events.EventLog(str(path))
+    for i in range(30):
+        log.emit("tick", i=i, pad="x" * 32)
+    log.close()
+    assert path.with_suffix(".jsonl.1").exists() or (
+        tmp_path / "events.jsonl.1"
+    ).exists()
+    cur = obs_events.read_events(str(path))
+    old = obs_events.read_events(str(path) + ".1")
+    assert path.stat().st_size <= 400 + 120  # cap + one record of slack
+    # The fresh generation announces where the history went.
+    assert cur[0]["event"] == "log_open" and cur[0]["rotated_from"].endswith(
+        "events.jsonl.1"
+    )
+    # The newest ticks are all in the current file, ending at the last one.
+    ticks = [e["i"] for e in cur if e["event"] == "tick"]
+    assert ticks == sorted(ticks) and ticks[-1] == 29
+    # No duplicates across generations (one generation of history kept).
+    all_ticks = ticks + [e["i"] for e in old if e["event"] == "tick"]
+    assert len(all_ticks) == len(set(all_ticks))
+    # Cap disabled: no rotation however many emits.
+    monkeypatch.setenv("HEFL_EVENTS_MAX_BYTES", "0")
+    log2 = obs_events.EventLog(str(tmp_path / "nocap.jsonl"))
+    for i in range(50):
+        log2.emit("tick", i=i, pad="x" * 32)
+    log2.close()
+    assert not (tmp_path / "nocap.jsonl.1").exists()
 
 
 def test_global_emit_honors_opt_out(tmp_path, monkeypatch):

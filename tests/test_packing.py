@@ -1,0 +1,490 @@
+"""Quantized bit-interleaved packing tests (ISSUE 6).
+
+Three layers of coverage, HE-free first:
+
+  * the quantizer as a standalone unit — round-trip exactness on the grid
+    at b in {4, 8, 16}, saturation at the clip boundary, per-tensor steps;
+  * interleave/deinterleave as a bitwise inverse for every supported k,
+    including carry-free client sums and noise-guard rounding;
+  * the packed HE pipeline — encrypt_stack_packed -> masked aggregate ->
+    decrypt_average(packing=) against plain references, within the
+    DECLARED error budget; disabled packing bit-for-bit equal to the
+    historical path; the masked no-new-compile guard under packing; and
+    the bf16-backward structural guarantee in models/folded.py.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.ckks import encoding, ops, quantize
+from hefl_tpu.ckks.keys import CkksContext, keygen
+from hefl_tpu.ckks.packing import (
+    PackedSpec,
+    PackSpec,
+    pack_quantized_flat,
+    unpack_quantized,
+)
+from hefl_tpu.ckks.quantize import PackingConfig
+from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+from hefl_tpu.fl import (
+    TrainConfig,
+    aggregate_encrypted,
+    decrypt_average,
+    encrypt_stack_packed,
+    secure_fedavg_round,
+)
+from hefl_tpu.fl.faults import POISON_HUGE
+from hefl_tpu.models import SmallCNN
+from hefl_tpu.parallel import make_mesh
+
+
+# ------------------------------------------------------------- quantizer
+
+
+@pytest.mark.parametrize("bits", [4, 8, 16])
+def test_quantizer_roundtrip_exact_on_grid(bits):
+    # Every representable grid point must survive quantize -> dequantize
+    # bit-for-bit: codes are exact integers and dequantize recomputes the
+    # identical float32 product.
+    qm = quantize.qmax(bits)
+    step = quantize.symmetric_step(0.5, bits)
+    codes = np.arange(-qm, qm + 1, dtype=np.int32)
+    grid = (codes.astype(np.float32) * np.float32(step))
+    q = quantize.quantize(jnp.asarray(grid), step, bits)
+    np.testing.assert_array_equal(np.asarray(q), codes)
+    back = quantize.dequantize(q, step)
+    np.testing.assert_array_equal(np.asarray(back), grid)
+    assert int(quantize.saturation_count(jnp.asarray(grid), step, bits)) == 0
+
+
+@pytest.mark.parametrize("bits", [4, 8, 16])
+def test_quantizer_saturates_at_clip(bits):
+    clip = 0.25
+    step = quantize.symmetric_step(clip, bits)
+    qm = quantize.qmax(bits)
+    x = jnp.asarray(
+        [clip, -clip, clip * 4.0, -clip * 4.0, 2.0**20, np.nan, np.inf, 0.0],
+        jnp.float32,
+    )
+    q = np.asarray(quantize.quantize(x, step, bits))
+    # On-boundary values are the extreme codes, NOT saturation...
+    assert q[0] == qm and q[1] == -qm
+    # ...beyond-boundary values clamp to the same extreme codes.
+    assert q[2] == qm and q[3] == -qm and q[4] == qm
+    assert q[7] == 0
+    # saturation_count flags exactly the out-of-range + non-finite inputs.
+    assert int(quantize.saturation_count(x, step, bits)) == 5
+    assert (
+        int(
+            quantize.saturation_count(
+                jnp.asarray([clip, -clip, 0.1]), step, bits
+            )
+        )
+        == 0
+    )
+
+
+def test_quantizer_per_tensor_steps_broadcast():
+    # step may be an array (per-tensor clips broadcast over the flat
+    # vector): each span quantizes on its own grid.
+    steps = np.concatenate(
+        [np.full(8, 0.1, np.float32), np.full(8, 0.001, np.float32)]
+    )
+    x = np.concatenate(
+        [np.full(8, 0.35, np.float32), np.full(8, 0.0035, np.float32)]
+    )
+    q = np.asarray(quantize.quantize(jnp.asarray(x), jnp.asarray(steps), 8))
+    np.testing.assert_array_equal(q[:8], np.full(8, 4))    # 0.35/0.1
+    np.testing.assert_array_equal(q[8:], np.full(8, 4))    # 0.0035/0.001 -> 3.5 -> 4
+    back = np.asarray(quantize.dequantize(jnp.asarray(q), jnp.asarray(steps)))
+    np.testing.assert_allclose(back[:8], 0.4, rtol=1e-6)
+    np.testing.assert_allclose(back[8:], 0.004, rtol=1e-6)
+
+
+# ------------------------------------------- interleave / deinterleave
+
+
+def _all_supported_k(bits: int, clients: int, guard_eff: int):
+    fbits = quantize.field_bits(bits, clients)
+    avail = quantize.MAX_PACKED_BITS - guard_eff
+    return range(1, avail // fbits + 1)
+
+
+@pytest.mark.parametrize("bits,clients", [(4, 2), (8, 2), (8, 16), (16, 2)])
+def test_interleave_deinterleave_bitwise_inverse(bits, clients):
+    # Bitwise inverse for EVERY supported k at this (bits, clients): pack k
+    # random full-range fields per slot, recover them exactly — with and
+    # without guard-band noise below the rounding threshold.
+    fbits = quantize.field_bits(bits, clients)
+    guard = 16 + max(clients - 1, 0).bit_length()
+    rng = np.random.default_rng(bits * 100 + clients)
+    for k in _all_supported_k(bits, clients, guard):
+        u = rng.integers(0, 1 << fbits, size=(3, k, 8)).astype(np.uint32)
+        hi, lo = quantize.interleave_fields(
+            jnp.asarray(u), k, fbits, guard
+        )
+        assert np.all(np.asarray(hi) < 1 << 31)
+        assert np.all(np.asarray(lo) < 1 << 31)
+        v = quantize.packed_value_int64(np.asarray(hi), np.asarray(lo))
+        fields = quantize.deinterleave_fields(v, k, fbits, guard)
+        np.testing.assert_array_equal(fields, u.astype(np.int64))
+        # Noise anywhere below +/-2**(guard-1) cannot touch the fields.
+        noise = rng.integers(
+            -(1 << (guard - 1)) + 1, 1 << (guard - 1), size=v.shape
+        )
+        np.testing.assert_array_equal(
+            quantize.deinterleave_fields(v + noise, k, fbits, guard),
+            u.astype(np.int64),
+        )
+
+
+def test_interleave_sum_is_carry_free():
+    # The homomorphic add is integer addition of packed values: with the
+    # ceil(log2 C) headroom per field, C clients' packed integers sum
+    # field-wise with NO carry crossing — the property the whole packed
+    # aggregation rests on. Worst case: every client at the max code.
+    bits, clients = 8, 16
+    fbits = quantize.field_bits(bits, clients)   # 8 + 4
+    guard, k = 8, 4
+    u_max = (1 << bits) - 2                      # 2*qmax, the largest code
+    u = np.full((1, k, 4), u_max, np.uint32)
+    hi, lo = quantize.interleave_fields(jnp.asarray(u), k, fbits, guard)
+    v = quantize.packed_value_int64(np.asarray(hi), np.asarray(lo))
+    total = sum(v for _ in range(clients))       # C identical uploads
+    fields = quantize.deinterleave_fields(total, k, fbits, guard)
+    np.testing.assert_array_equal(fields, np.full((1, k, 4), clients * u_max))
+
+
+def test_max_interleave_headroom_formula():
+    ctx = CkksContext.create(n=256)
+    q = ctx.modulus
+    # k = floor(log2(q_headroom) / (b + ceil(log2 C))), with
+    # log2(q_headroom) = min(floor(log2 q) - 1, 62) - guard_eff.
+    for bits, clients, guard in [(8, 2, 16), (8, 16, 16), (4, 2, 16), (16, 2, 16)]:
+        guard_eff = guard + max(clients - 1, 0).bit_length()
+        avail = min(q.bit_length() - 2, quantize.MAX_PACKED_BITS) - guard_eff
+        expect = avail // quantize.field_bits(bits, clients)
+        assert quantize.max_interleave(q, bits, clients, guard) == expect
+        assert expect >= 2   # the ring genuinely supports packing
+    # An explicit k beyond the headroom fails loudly at spec build.
+    tmpl = {"w": jnp.zeros((100,))}
+    with pytest.raises(ValueError, match="lower interleave"):
+        PackedSpec.for_params(
+            tmpl, ctx, PackingConfig(bits=16, interleave=8), num_clients=16
+        )
+
+
+def test_packing_config_validation():
+    assert not PackingConfig().enabled
+    assert not PackingConfig(bits=0).enabled
+    assert PackingConfig(bits=8).enabled
+    with pytest.raises(ValueError):
+        PackingConfig(bits=1)
+    with pytest.raises(ValueError):
+        PackingConfig(bits=24)
+    with pytest.raises(ValueError):
+        PackingConfig(bits=8, interleave=-1)
+    with pytest.raises(ValueError):
+        PackingConfig(bits=8, clip=0.0)
+    with pytest.raises(ValueError):
+        PackingConfig(bits=8, guard_bits=2)
+
+
+# ------------------------------------------------- packed HE pipeline
+
+
+@pytest.fixture(scope="module")
+def ctx_keys():
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(7))
+    return ctx, sk, pk
+
+
+def _rand_tree(key, scale=0.3):
+    k1, k2 = jax.random.split(key)
+    return {
+        "conv": {"kernel": jax.random.normal(k1, (3, 3, 2, 4)) * scale},
+        "dense": {"kernel": jax.random.normal(k2, (20, 6)) * scale},
+    }
+
+
+def test_packed_stack_aggregate_decrypt_matches_quantized_mean(ctx_keys):
+    # No training in the loop: stacked client trees through
+    # encrypt_stack_packed -> lazy modular sum -> packed decrypt must equal
+    # base + mean(dequantized quantized deltas) to float32 precision — the
+    # HE stack adds NOTHING beyond the quantizer (bit-exact field sums).
+    ctx, sk, pk = ctx_keys
+    num_clients = 3
+    base = _rand_tree(jax.random.key(0))
+    trees = [
+        jax.tree_util.tree_map(
+            lambda t: t + 0.05 * jax.random.normal(jax.random.key(50 + i), t.shape),
+            base,
+        )
+        for i in range(num_clients)
+    ]
+    cfg = PackingConfig(bits=8, interleave=3, clip=0.25)
+    spec = PackedSpec.for_params(base, ctx, cfg, num_clients)
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *trees
+    )
+    enc_keys = jax.random.split(jax.random.key(9), num_clients)
+    cts, sat = encrypt_stack_packed(ctx, pk, stacked, base, enc_keys, spec)
+    assert cts.c0.shape[:2] == (num_clients, spec.n_ct)
+    assert np.asarray(sat).tolist() == [0] * num_clients
+    ct_sum = aggregate_encrypted(ctx, cts)
+    avg = decrypt_average(
+        ctx, sk, ct_sum, num_clients, packing=spec, base_params=base
+    )
+    # Reference: quantize each client's delta on the same grid, average.
+    from jax.flatten_util import ravel_pytree
+
+    base_flat, unravel = ravel_pytree(base)
+    deltas = [
+        np.asarray(
+            quantize.dequantize(
+                quantize.quantize(
+                    ravel_pytree(t)[0] - base_flat, spec.step, spec.bits
+                ),
+                spec.step,
+            )
+        )
+        for t in trees
+    ]
+    expect = unravel(base_flat + jnp.asarray(np.mean(deltas, axis=0)))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(avg), jax.tree_util.tree_leaves(expect)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    # And against the TRUE (unquantized) mean: within the declared budget.
+    true_mean = jax.tree_util.tree_map(
+        lambda *xs: sum(xs) / num_clients, *trees
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(avg), jax.tree_util.tree_leaves(true_mean)
+    ):
+        assert float(jnp.max(jnp.abs(a - b))) <= spec.error_budget
+
+
+def test_packed_excluded_client_composes_with_surviving_count(ctx_keys):
+    # A zeroed ciphertext (the masked engine's exclusion) contributes
+    # nothing; the unpack's surviving-count offset handling must decode the
+    # remaining clients' true average.
+    ctx, sk, pk = ctx_keys
+    base = _rand_tree(jax.random.key(1))
+    trees = [
+        jax.tree_util.tree_map(
+            lambda t: t + 0.03 * (i + 1), base
+        )
+        for i in range(3)
+    ]
+    cfg = PackingConfig(bits=8, clip=0.25)    # interleave=0 -> auto k
+    spec = PackedSpec.for_params(base, ctx, cfg, 3)
+    assert spec.k >= 2
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+    enc_keys = jax.random.split(jax.random.key(11), 3)
+    cts, _ = encrypt_stack_packed(ctx, pk, stacked, base, enc_keys, spec)
+    # Exclude client 2 exactly the way fl.secure does: zero its limbs.
+    keep = jnp.asarray([1, 1, 0]).reshape(-1, 1, 1, 1)
+    cts = ops.Ciphertext(
+        c0=jnp.where(keep == 1, cts.c0, jnp.uint32(0)),
+        c1=jnp.where(keep == 1, cts.c1, jnp.uint32(0)),
+        scale=cts.scale,
+    )
+    avg = decrypt_average(
+        ctx, sk, aggregate_encrypted(ctx, cts), 2,
+        packing=spec, base_params=base,
+    )
+    expect = jax.tree_util.tree_map(lambda *xs: sum(xs) / 2, *trees[:2])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(avg), jax.tree_util.tree_leaves(expect)
+    ):
+        assert float(jnp.max(jnp.abs(a - b))) <= spec.error_budget
+
+
+def _round_setup(num_clients, n_train_per_client, ring_n):
+    (x, y), _, _ = make_dataset(
+        "mnist", seed=0, n_train=num_clients * n_train_per_client, n_test=8
+    )
+    xs, ys = stack_federated(x, y, iid_contiguous(len(x), num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    cfg = TrainConfig(
+        epochs=1, batch_size=4, num_classes=10, augment=False,
+        val_fraction=0.25,
+    )
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=ring_n)
+    sk, pk = keygen(ctx, jax.random.key(3))
+    return model, params, cfg, mesh, ctx, sk, pk, jnp.asarray(xs), jnp.asarray(ys)
+
+
+def test_disabled_packing_is_bitwise_identical_and_shares_executable():
+    # The k=1/b=none parity gate: a disabled PackingConfig routes to
+    # packing=None, and packing=None is the HISTORICAL program — same
+    # factory cache key, same executable, bitwise-identical ciphertexts.
+    from hefl_tpu.fl.secure import _build_secure_round_fn
+
+    _build_secure_round_fn.cache_clear()
+    model, params, cfg, mesh, ctx, sk, pk, xs, ys = _round_setup(2, 8, 256)
+    key = jax.random.key(4)
+    ct_a, _, _ = secure_fedavg_round(
+        model, cfg, mesh, ctx, pk, params, xs, ys, key
+    )
+    ct_b, _, _ = secure_fedavg_round(
+        model, cfg, mesh, ctx, pk, params, xs, ys, key, packing=None
+    )
+    np.testing.assert_array_equal(np.asarray(ct_a.c0), np.asarray(ct_b.c0))
+    np.testing.assert_array_equal(np.asarray(ct_a.c1), np.asarray(ct_b.c1))
+    fn = _build_secure_round_fn(model, cfg, mesh, ctx, False)
+    assert fn._cache_size() == 1
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_packed_round_within_budget_vs_plain_reference(k):
+    # The production packed round (k=1: quantized but not interleaved;
+    # k=4: the headline packing factor) in with_plain_reference mode: the
+    # decrypt must land within the DECLARED quantization-error budget of
+    # the in-program plaintext mean of the identical trained weights.
+    model, params, cfg, mesh, ctx, sk, pk, xs, ys = _round_setup(2, 8, 256)
+    pcfg = PackingConfig(bits=8, interleave=k, clip=0.25)
+    spec = PackedSpec.for_params(params, ctx, pcfg, 2)
+    assert spec.n_ct == -(-spec.base.n_ct // k)
+    ct, mets, sat, ref = secure_fedavg_round(
+        model, cfg, mesh, ctx, pk, params, xs, ys, jax.random.key(5),
+        with_plain_reference=True, packing=spec,
+    )
+    assert ct.c0.shape[0] == spec.n_ct
+    assert int(np.sum(np.asarray(sat))) == 0
+    avg = decrypt_average(
+        ctx, sk, ct, 2, packing=spec, base_params=params
+    )
+    worst = max(
+        float(jnp.max(jnp.abs(a - b)))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(avg), jax.tree_util.tree_leaves(ref)
+        )
+    )
+    assert worst <= spec.error_budget, (
+        f"packed round error {worst} exceeds declared budget "
+        f"{spec.error_budget} at k={k}"
+    )
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_packed_masked_round_with_poison_within_budget(k):
+    # The acceptance gate: a FULL masked secure round — partial
+    # participation plus a huge-norm poisoned client — under packing at
+    # k in {2, 4}. The poisoned client saturates the quantizer, the
+    # on_overflow="exclude" machinery drops it (attributed to 'overflow'),
+    # and the decrypt matches the in-program masked plain reference of the
+    # surviving clients within the declared budget.
+    import dataclasses
+
+    model, params, cfg, mesh, ctx, sk, pk, xs, ys = _round_setup(4, 8, 256)
+    cfg = dataclasses.replace(cfg, on_overflow="exclude")
+    pcfg = PackingConfig(bits=8, interleave=k, clip=0.25)
+    spec = PackedSpec.for_params(params, ctx, pcfg, 4)
+    part = jnp.asarray([1, 1, 1, 0], jnp.int32)          # client 3 drops
+    pois = jnp.asarray([0, POISON_HUGE, 0, 0], jnp.int32)  # client 1 poisoned
+    ct, mets, sat, meta, ref = secure_fedavg_round(
+        model, cfg, mesh, ctx, pk, params, xs, ys, jax.random.key(6),
+        with_plain_reference=True, packing=spec,
+        participation=part, poison=pois,
+    )
+    assert meta.surviving == 2
+    assert meta.excluded["scheduled"] == 1
+    # The +1e15 poison saturates the b-bit grid: counted AND excluded.
+    assert int(np.asarray(sat)[1]) > 0
+    assert meta.excluded["overflow"] >= 1
+    avg = decrypt_average(
+        ctx, sk, ct, meta=meta, packing=spec, base_params=params
+    )
+    worst = max(
+        float(jnp.max(jnp.abs(a - b)))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(avg), jax.tree_util.tree_leaves(ref)
+        )
+    )
+    assert worst <= spec.error_budget
+
+
+def test_packed_masked_round_compiles_once():
+    # No-new-compile guard under the packed path: three masked rounds with
+    # three different participation masks share ONE executable.
+    from hefl_tpu.fl.secure import _build_secure_round_fn
+
+    _build_secure_round_fn.cache_clear()
+    model, params, cfg, mesh, ctx, sk, pk, xs, ys = _round_setup(2, 8, 256)
+    pcfg = PackingConfig(bits=8, interleave=2, clip=0.25)
+    spec = PackedSpec.for_params(params, ctx, pcfg, 2)
+    for r, m in enumerate(([1, 1], [1, 0], [0, 1])):
+        ct, _, _, meta = secure_fedavg_round(
+            model, cfg, mesh, ctx, pk, params, xs, ys,
+            jax.random.fold_in(jax.random.key(8), r),
+            participation=jnp.asarray(m, jnp.int32), packing=spec,
+        )
+        assert meta.surviving == sum(m)
+    fn = _build_secure_round_fn(
+        model, cfg, mesh, ctx, False, None, 2, masked=True, packing=spec
+    )
+    assert fn._cache_size() == 1, (
+        f"masked packed round compiled {fn._cache_size()} times for 3 masks"
+    )
+
+
+# -------------------------------------------------- bf16 backward story
+
+
+def test_folded_backward_keeps_bf16_between_layers():
+    # models/folded.py's custom VJP contract: the gradient tensors handed
+    # BETWEEN layers are bf16 (same bytes as the forward activations), with
+    # f32 only inside GEMM accumulation / cross-tap partial sums. The
+    # relu-transpose select_n between the two convs is the observable: it
+    # operates on the inter-layer cotangent, so its dtype IS the handoff
+    # dtype (plain autodiff leaves it float32).
+    from jax.extend.core import ClosedJaxpr
+
+    from hefl_tpu.models.folded import folded_conv
+
+    C, B = 2, 2
+    x = jnp.ones((C * B, 12, 12, 3), jnp.bfloat16)
+    k1 = jnp.ones((C, 3, 3, 3, 8), jnp.float32) * 0.1
+    k2 = jnp.ones((C, 3, 3, 8, 4), jnp.float32) * 0.1
+
+    def loss(ks, x):
+        a, b = ks
+        h = folded_conv(x, a, None, num_clients=C)
+        h = jax.nn.relu(h)
+        h = folded_conv(h, b, None, num_clients=C)
+        return jnp.sum(h.astype(jnp.float32))
+
+    # Gradient wrt the bf16 input activations is bf16.
+    dx = jax.grad(loss, argnums=1)((k1, k2), x)
+    assert dx.dtype == jnp.bfloat16
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1)))((k1, k2), x)
+
+    def walk(jpr, out):
+        for eq in jpr.eqns:
+            for v in eq.outvars:
+                av = v.aval
+                if hasattr(av, "shape"):
+                    out.append((eq.primitive.name, av.shape, str(av.dtype)))
+            for val in eq.params.values():
+                if isinstance(val, ClosedJaxpr):
+                    walk(val.jaxpr, out)
+                elif isinstance(val, (list, tuple)):
+                    for vv in val:
+                        if isinstance(vv, ClosedJaxpr):
+                            walk(vv.jaxpr, out)
+        return out
+
+    rows = walk(jaxpr.jaxpr, [])
+    selects = [r for r in rows if r[0] == "select_n" and len(r[1]) >= 4]
+    assert selects, "expected a relu-transpose select_n in the backward"
+    assert all(r[2] == "bfloat16" for r in selects), (
+        f"inter-layer cotangents regressed to f32: {selects}"
+    )
