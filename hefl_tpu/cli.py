@@ -13,7 +13,13 @@ import json
 import os
 
 from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
-from hefl_tpu.fl import DpConfig, FaultConfig, PackingConfig, TrainConfig
+from hefl_tpu.fl import (
+    DpConfig,
+    FaultConfig,
+    PackingConfig,
+    StreamConfig,
+    TrainConfig,
+)
 from hefl_tpu.models import MODEL_REGISTRY
 
 
@@ -134,8 +140,54 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fault injection: comma-separated round indices "
                         "whose first attempt simulates a device loss "
                         "(exercises --max-round-retries)")
+    p.add_argument("--arrival-delay", type=float, default=0.0, metavar="S",
+                   help="fault injection: max base dispersion of upload "
+                        "arrival times consumed by the streaming engine "
+                        "(stragglers add their delay on top)")
+    p.add_argument("--duplicate-clients", type=int, default=0, metavar="K",
+                   help="fault injection: clients per round whose upload "
+                        "is delivered twice (streaming dedups by nonce)")
+    p.add_argument("--transient-clients", type=int, default=0, metavar="K",
+                   help="fault injection: clients per round whose first "
+                        "delivery is lost (recovered by streaming retries)")
+    p.add_argument("--permanent-clients", type=int, default=0, metavar="K",
+                   help="fault injection: clients per round for whom every "
+                        "delivery fails (excluded as unreachable)")
     p.add_argument("--fault-seed", type=int, default=0,
                    help="PRNG seed of the fault schedule")
+    # --- streaming quorum aggregation (fl/stream.py, README "Streaming
+    # aggregation & quorum") ---
+    p.add_argument("--stream", action="store_true",
+                   help="streaming quorum aggregation: arriving encrypted "
+                        "updates fold online into a running modular sum; "
+                        "rounds commit at --quorum, stragglers carry under "
+                        "--staleness instead of stalling the round")
+    p.add_argument("--cohort-size", type=int, default=0, metavar="K",
+                   help="clients sampled into each round's cohort "
+                        "(0 = all; implies --stream semantics)")
+    p.add_argument("--quorum", type=float, default=1.0, metavar="Q",
+                   help="fraction of the cohort whose arrivals commit the "
+                        "round; below it the round degrades gracefully "
+                        "(model carried forward, loud event)")
+    p.add_argument("--deadline", type=float, default=0.0, metavar="S",
+                   help="per-client arrival deadline in simulated seconds "
+                        "(0 = none)")
+    p.add_argument("--staleness", type=int, default=0, metavar="T",
+                   help="bounded-staleness budget: rounds a missed upload "
+                        "may carry forward before exclusion as stale")
+    p.add_argument("--stream-retries", type=int, default=0, metavar="N",
+                   help="redelivery attempts for a lost upload "
+                        "(exponential backoff + jitter)")
+    p.add_argument("--stream-backoff", type=float, default=0.25, metavar="S",
+                   help="base backoff between delivery retries")
+    p.add_argument("--stream-seed", type=int, default=0,
+                   help="PRNG seed of cohort sampling and retry jitter")
+    p.add_argument("--dp-min-surviving", type=int, default=0, metavar="K",
+                   help="dp noise floor: calibrate each client's noise "
+                        "share to K surviving clients (conservative "
+                        "over-noising for partial participation; 0 = "
+                        "full-participation calibration, auto-derived "
+                        "from the schedule/quorum under faults/streaming)")
     p.add_argument("--max-round-retries", type=int, default=0,
                    help="retry a failed round this many times with "
                         "exponential backoff, auto-resuming from the "
@@ -178,6 +230,10 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         or args.nan_clients > 0
         or args.huge_clients > 0
         or args.straggler_delay > 0
+        or args.arrival_delay > 0
+        or args.duplicate_clients > 0
+        or args.transient_clients > 0
+        or args.permanent_clients > 0
         or fail_rounds
     )
     faults = (
@@ -189,8 +245,56 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             straggler_fraction=0.25 if args.straggler_delay > 0 else 0.0,
             straggler_delay_s=args.straggler_delay,
             fail_rounds=fail_rounds,
+            arrival_delay_s=args.arrival_delay,
+            duplicate_clients=args.duplicate_clients,
+            transient_fail_clients=args.transient_clients,
+            permanent_fail_clients=args.permanent_clients,
         )
         if any_fault
+        else None
+    )
+    want_stream = (
+        args.stream
+        or args.cohort_size > 0
+        or args.quorum < 1.0
+        or args.deadline > 0
+        or args.staleness > 0
+        or args.stream_retries > 0
+    )
+    arrival_faults = (
+        args.arrival_delay > 0
+        or args.duplicate_clients > 0
+        or args.transient_clients > 0
+        or args.permanent_clients > 0
+    )
+    if arrival_faults and not want_stream:
+        # Arrival-level faults only exist on the streaming engine's
+        # timeline; the synchronous driver would SILENTLY inject nothing —
+        # a chaos run the user believes ran but didn't. Fail loudly (same
+        # pattern as the packing flags).
+        raise SystemExit(
+            "--arrival-delay/--duplicate-clients/--transient-clients/"
+            "--permanent-clients are consumed by the streaming engine; "
+            "add --stream (or another streaming knob) to enable it"
+        )
+    if args.dp_min_surviving > 0 and args.dp_noise <= 0:
+        # Same silent-no-op guard: a declared noise floor without dp
+        # enabled would be dropped without a word.
+        raise SystemExit(
+            "--dp-min-surviving has no effect without --dp-noise; add "
+            "--dp-noise SIGMA to enable dp"
+        )
+    stream = (
+        StreamConfig(
+            cohort_size=args.cohort_size,
+            quorum=args.quorum,
+            deadline_s=args.deadline,
+            max_retries=args.stream_retries,
+            retry_backoff_s=args.stream_backoff,
+            staleness_rounds=args.staleness,
+            seed=args.stream_seed,
+        )
+        if want_stream
         else None
     )
     return ExperimentConfig(
@@ -229,11 +333,13 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
                 clip_norm=args.dp_clip,
                 noise_multiplier=args.dp_noise,
                 delta=args.dp_delta,
+                min_surviving=args.dp_min_surviving,
             )
             if args.dp_noise > 0
             else None
         ),
         faults=faults,
+        stream=stream,
         max_round_retries=args.max_round_retries,
         retry_backoff_s=args.retry_backoff,
         events_path=args.events,

@@ -36,6 +36,7 @@ from hefl_tpu.fl import (
     DeviceLost,
     DpConfig,
     FaultConfig,
+    StreamConfig,
     TrainConfig,
     decrypt_average,
     epsilon_spent,
@@ -115,6 +116,12 @@ class ExperimentConfig:
     # simulated device loss. None = no faults AND no masked engine (the
     # historical all-clients-present fast path, seeds untouched).
     faults: "FaultConfig | None" = None
+    # Streaming quorum aggregation (fl/stream.py): per-round sampled
+    # cohorts, arriving encrypted updates folded online into a running
+    # modular sum, per-client deadlines with retry/backoff, bounded
+    # staleness, quorum commit with graceful degradation. Encrypted runs
+    # only. None = the synchronous wait-for-everyone round loop.
+    stream: "StreamConfig | None" = None
     # Driver-level resilience: how many times to retry a round whose
     # execution died (device loss / runtime error), with exponential
     # backoff, auto-resuming params+RNG from the round checkpoint when one
@@ -234,17 +241,56 @@ def run_experiment(
             "packing quantizes the CKKS upload; remove "
             "--plaintext/--centralized or drop the packing config"
         )
-    if cfg.dp is not None and cfg.faults is not None:
-        # fl.dp's distributed noise shares are calibrated for FULL
-        # participation (sigma*C/sqrt(K) each); excluding any client also
-        # excludes its noise share, silently weakening the accounted
-        # (epsilon, delta) guarantee. fl.secure fail-louds if an exclusion
-        # actually happens; here the combination is rejected up front.
+    if cfg.stream is not None and (not cfg.encrypted or cfg.centralized):
+        # The streaming engine folds ENCRYPTED uploads into a running
+        # modular sum; a plaintext/centralized run has no such stream.
         raise ValueError(
-            "dp and fault injection cannot be combined: dropped/poisoned "
-            "clients would take their noise shares with them and the "
-            "release would be less private than epsilon_spent reports"
+            "streaming quorum aggregation runs on the encrypted federated "
+            "path; remove --plaintext/--centralized or drop the stream "
+            "config"
         )
+    if (
+        cfg.dp is not None
+        and cfg.stream is not None
+        and cfg.stream.staleness_rounds > 0
+    ):
+        # A carried upload gives one client 2x the accounted per-round
+        # sensitivity and breaks cohort-subsampling amplification (see
+        # fl.stream.run_round, which enforces the same rule) — reject up
+        # front, before any dataset/compile work.
+        raise ValueError(
+            "dp cannot be combined with a staleness budget: set "
+            "StreamConfig.staleness_rounds=0 for dp runs (a carried "
+            "upload would double a client's accounted sensitivity)"
+        )
+    # dp under partial participation: each client's distributed noise
+    # share is calibrated to the surviving-cohort floor
+    # (DpConfig.min_surviving; fl/dp.py) — conservative over-noising whose
+    # effective noise provably never drops below the full-participation
+    # calibration. When faults or streaming make exclusions expected and
+    # the user declared no floor, derive a conservative one here: the
+    # quorum (streaming commits guarantee at least that many uploads) or
+    # the schedule's worst-case surviving count. fl.secure still fails
+    # loudly if a round survives BELOW the floor.
+    dp_cfg = cfg.dp
+    if (
+        dp_cfg is not None
+        and dp_cfg.min_surviving <= 0
+        and (cfg.faults is not None or cfg.stream is not None)
+    ):
+        from hefl_tpu.fl import quorum_count
+        from hefl_tpu.fl.stream import sample_cohort
+
+        if cfg.stream is not None:
+            cohort = len(sample_cohort(cfg.stream, 0, cfg.num_clients))
+            floor = quorum_count(cfg.stream, cohort)
+        else:
+            floor = max(
+                1,
+                cfg.num_clients
+                - cfg.faults.max_scheduled_exclusions(cfg.num_clients),
+            )
+        dp_cfg = dataclasses.replace(dp_cfg, min_surviving=floor)
     # Observability (obs): route this run's structured events to one JSONL
     # file (events.jsonl next to the checkpoint by default; events_path=""
     # or HEFL_EVENTS=0 disables) and start counting new XLA executables /
@@ -264,6 +310,7 @@ def run_experiment(
         rounds=cfg.rounds, encrypted=cfg.encrypted,
         centralized=cfg.centralized, faults=cfg.faults is not None,
         dp=cfg.dp is not None, seed=cfg.seed,
+        stream=cfg.stream is not None,
         # The event fires before the HE context exists, so it carries the
         # CONFIGURED interleave (0 = auto) under an unambiguous name; the
         # RESOLVED k lives in the result record's `packing.interleave`.
@@ -276,6 +323,18 @@ def run_experiment(
             else None
         ),
     )
+    if cfg.dp is not None and dp_cfg.min_surviving != cfg.dp.min_surviving:
+        say(
+            f"dp: noise shares recalibrated to a surviving-cohort floor of "
+            f"{dp_cfg.min_surviving}/{cfg.num_clients} clients "
+            "(conservative over-noising; effective noise never below the "
+            "full-participation calibration)"
+        )
+        obs_events.emit(
+            "dp_recalibrated",
+            min_surviving=dp_cfg.min_surviving,
+            num_clients=cfg.num_clients,
+        )
     train_cfg = cfg.train
     if cfg.data_dir is not None:
         # The reference's primary workflow: point the tool at a folder of
@@ -421,6 +480,22 @@ def run_experiment(
         train_cfg, cfg.num_clients, client_mesh_size(mesh),
         explicit=cfg.faults is not None, secure=cfg.encrypted,
     )
+    # Streaming quorum aggregation (fl.stream): ONE engine per experiment —
+    # it owns the cross-round state (uploads carried under the staleness
+    # budget, the dedup nonce window). Streaming rounds always carry a
+    # RoundMeta, so they ride the robust unpack/record path.
+    streaming = cfg.stream is not None
+    engine = None
+    if streaming:
+        from hefl_tpu.fl import StreamEngine
+
+        engine = StreamEngine(cfg.stream, cfg.faults)
+        robust = True
+    dp_sample_rate = 1.0
+    if streaming and 0 < cfg.stream.cohort_size < cfg.num_clients:
+        # Per-round uniform cohorts: the dp accountant applies privacy
+        # amplification by subsampling at this rate (fl.dp.epsilon_spent).
+        dp_sample_rate = cfg.stream.cohort_size / cfg.num_clients
 
     history: list[dict[str, Any]] = []
     for r in range(start_round, cfg.rounds):
@@ -456,13 +531,29 @@ def run_experiment(
                     )
                 timer = PhaseTimer()
                 meta = None
+                smeta = None
                 if cfg.encrypted:
                     with timer.phase("train+encrypt+aggregate"):
-                        if robust:
+                        if streaming:
+                            # Streaming quorum aggregation: arrivals fold
+                            # online into a running modular sum; straggler
+                            # delays become ARRIVAL TIMES the engine
+                            # consumes (no driver-side sleep), deadlines /
+                            # retries / staleness / quorum per fl.stream.
+                            ct_sum, metrics, overflow, smeta = (
+                                engine.run_round(
+                                    module, train_cfg, mesh, ctx, pk,
+                                    params, xs_d, ys_d, k_round, r,
+                                    dp=dp_cfg, packing=pspec,
+                                    num_real_clients=num_real,
+                                )
+                            )
+                            meta = smeta.meta
+                        elif robust:
                             ct_sum, metrics, overflow, meta = (
                                 secure_fedavg_round(
                                     module, train_cfg, mesh, ctx, pk, params,
-                                    xs_d, ys_d, k_round, dp=cfg.dp,
+                                    xs_d, ys_d, k_round, dp=dp_cfg,
                                     participation=part, poison=pois,
                                     num_real_clients=num_real,
                                     packing=pspec,
@@ -471,7 +562,7 @@ def run_experiment(
                         else:
                             ct_sum, metrics, overflow = secure_fedavg_round(
                                 module, train_cfg, mesh, ctx, pk, params,
-                                xs_d, ys_d, k_round, dp=cfg.dp,
+                                xs_d, ys_d, k_round, dp=dp_cfg,
                                 num_real_clients=num_real, packing=pspec,
                             )
                         # Stage the next round's arrays while this round
@@ -479,14 +570,17 @@ def run_experiment(
                         # resident; see RoundPrefetcher).
                         prefetcher.prefetch(xs, ys)
                         jax.block_until_ready((ct_sum.c0, ct_sum.c1, metrics))
-                        if straggler_s > 0:
+                        if straggler_s > 0 and not streaming:
                             # The synchronous round waits for its slowest
                             # scheduled straggler (driver-level simulation;
                             # shows up in the phase wall-clock like a real
                             # straggler would). The TraceAnnotation makes
                             # the wait a first-class host span in profiler
                             # traces (obs.trace `host_rows`) instead of an
-                            # unexplained wall-vs-device gap.
+                            # unexplained wall-vs-device gap. The streaming
+                            # engine instead CONSUMES the schedule as
+                            # per-client arrival times (hefl.quorum_wait
+                            # carries any real waiting there).
                             with jax.profiler.TraceAnnotation(
                                 obs_scopes.STRAGGLER_WAIT
                             ):
@@ -498,9 +592,19 @@ def run_experiment(
                             # the same carry-over the plaintext masked
                             # engine applies (masked_mean_tree's count==0
                             # branch) — instead of decoding a 0/0.
-                            say(f"round {r}: every client excluded "
-                                f"({meta.excluded}); keeping previous "
-                                "global model")
+                            if smeta is not None and not smeta.committed:
+                                why = (
+                                    "released sum below the dp noise floor"
+                                    if smeta.degraded_reason == "dp_floor"
+                                    else f"quorum not reached ({smeta.fresh}"
+                                    f"/{smeta.quorum} fresh arrivals)"
+                                )
+                                say(f"round {r}: {why}; keeping previous "
+                                    "global model")
+                            else:
+                                say(f"round {r}: every client excluded "
+                                    f"({meta.excluded}); keeping previous "
+                                    "global model")
                             new_params = params
                         else:
                             exact = (
@@ -588,7 +692,8 @@ def run_experiment(
             **(
                 {
                     "dp_epsilon": epsilon_spent(
-                        r + 1, cfg.dp.noise_multiplier, cfg.dp.delta
+                        r + 1, dp_cfg.noise_multiplier, dp_cfg.delta,
+                        sample_rate=dp_sample_rate,
                     )
                 }
                 if cfg.dp is not None and cfg.encrypted
@@ -659,6 +764,10 @@ def run_experiment(
             # counters by cause + one round_robust event line).
             record_round_meta(meta, r)
             rob: dict[str, Any] = {**meta.record(), "round_retries": attempt}
+            if smeta is not None:
+                # The streaming round's arrival-level story (quorum,
+                # commit time, dedup/retry/staleness accounting).
+                record["stream"] = smeta.record()
             if sched is not None:
                 rob["faults"] = {
                     "dropped": np.flatnonzero(sched.dropped).tolist(),
@@ -736,6 +845,11 @@ def run_experiment(
         # float path): packed vs unpacked ciphertext counts and the
         # declared quantization-error budget.
         "packing": pspec.geometry_record() if pspec is not None else None,
+        # Streaming quorum-aggregation knobs this run used (None = the
+        # synchronous round loop).
+        "stream": (
+            dataclasses.asdict(cfg.stream) if cfg.stream is not None else None
+        ),
         # Observability record: where this run's events.jsonl went (None =
         # disabled) + THIS RUN's metrics (counters as deltas against the
         # run-start baseline; exclusions by cause, retries, resumes,

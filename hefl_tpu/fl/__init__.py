@@ -13,14 +13,22 @@ local epochs run simultaneously (vmap within a device, shard_map across
 devices) and aggregation is a collective.
 """
 
-from hefl_tpu.fl.config import PackingConfig, TrainConfig
+from hefl_tpu.fl.config import PackingConfig, StreamConfig, TrainConfig
 from hefl_tpu.fl.client import local_train, train_centralized
-from hefl_tpu.fl.dp import DpConfig, clip_by_global_norm, dp_sanitize, epsilon_spent
+from hefl_tpu.fl.dp import (
+    DpConfig,
+    calibration_clients,
+    clip_by_global_norm,
+    dp_sanitize,
+    epsilon_spent,
+)
 from hefl_tpu.fl.faults import (
+    ArrivalFaults,
     DeviceLost,
     FaultConfig,
     RoundFaults,
     RoundMeta,
+    schedule_arrivals,
     schedule_for_round,
 )
 from hefl_tpu.fl.fedavg import evaluate, fedavg_round, train_clients
@@ -34,19 +42,37 @@ from hefl_tpu.fl.secure import (
     encrypt_stack_packed,
     secure_fedavg_round,
 )
+from hefl_tpu.fl.stream import (
+    OnlineAccumulator,
+    StreamEngine,
+    StreamRoundMeta,
+    produce_uploads,
+    quorum_count,
+    sample_cohort,
+)
 
 __all__ = [
     "PackingConfig",
+    "StreamConfig",
     "TrainConfig",
     "DpConfig",
     "DeviceLost",
+    "ArrivalFaults",
     "FaultConfig",
     "RoundFaults",
     "RoundMeta",
+    "schedule_arrivals",
     "schedule_for_round",
+    "calibration_clients",
     "clip_by_global_norm",
     "dp_sanitize",
     "epsilon_spent",
+    "OnlineAccumulator",
+    "StreamEngine",
+    "StreamRoundMeta",
+    "produce_uploads",
+    "quorum_count",
+    "sample_cohort",
     "local_train",
     "train_centralized",
     "fedavg_round",

@@ -94,3 +94,76 @@ class TrainConfig:
                 f"client_fusion={self.client_fusion!r}: must be one of "
                 "'auto' | 'fused' | 'vmap'"
             )
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamConfig:
+    """Streaming quorum-aggregation knobs (fl.stream; frozen => hashable,
+    rides in ExperimentConfig).
+
+    Defined here — not next to the engine — because stream.py imports the
+    FL layer's round machinery and the config surface must stay cycle-free
+    (the same reason PackingConfig lives with its quantizer and is
+    re-exported here).
+
+    cohort_size:      clients sampled into each round's cohort
+                      (deterministic PRNG; 0 = every client, i.e. full
+                      participation remains available but is no longer
+                      assumed).
+    quorum:           fraction of the cohort whose arrivals COMMIT the
+                      round (the round closes as soon as
+                      ceil(quorum * cohort) fresh uploads have folded);
+                      below quorum the round degrades gracefully — the
+                      global model carries forward with a loud
+                      round_robust/stream_round event.
+    deadline_s:       per-client arrival deadline (0 = none): an upload
+                      arriving after it cannot fold fresh this round — it
+                      is carried under the staleness budget or dropped.
+                      Server-solicited RETRIES may land after the deadline
+                      and still fold (the server extended the round for
+                      them).
+    max_retries:      redelivery attempts for a LOST upload (exponential
+                      backoff + jitter); 0 = lost means gone.
+    retry_backoff_s:  base backoff between delivery retries (doubles per
+                      attempt).
+    retry_jitter:     +/- fraction of each backoff drawn from a
+                      deterministic per-(round, client, attempt) PRNG —
+                      de-synchronizes retry storms, reproducibly.
+    staleness_rounds: bounded-staleness budget tau: how many rounds a
+                      missed upload may carry forward before it is
+                      excluded as "stale" (0 = synchronous semantics:
+                      missed means dropped with cause "timeout").
+    seed:             PRNG seed of cohort sampling and retry jitter
+                      (independent of both the experiment seed and the
+                      fault-schedule seed).
+    time_scale:       real seconds slept per simulated second of arrival
+                      waiting (under the hefl.quorum_wait host
+                      TraceAnnotation). 0 = fully virtual clock: the
+                      arrival timeline is simulated exactly but the driver
+                      never sleeps — the CI/chaos default.
+    """
+
+    cohort_size: int = 0
+    quorum: float = 1.0
+    deadline_s: float = 0.0
+    max_retries: int = 0
+    retry_backoff_s: float = 0.25
+    retry_jitter: float = 0.25
+    staleness_rounds: int = 0
+    seed: int = 0
+    time_scale: float = 0.0
+
+    def __post_init__(self):
+        if not 0.0 < self.quorum <= 1.0:
+            raise ValueError(
+                f"StreamConfig.quorum={self.quorum}: must be in (0, 1]"
+            )
+        for name in ("cohort_size", "deadline_s", "max_retries",
+                     "retry_backoff_s", "staleness_rounds", "time_scale"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"StreamConfig.{name} must be >= 0")
+        if not 0.0 <= self.retry_jitter <= 1.0:
+            raise ValueError(
+                f"StreamConfig.retry_jitter={self.retry_jitter}: must be "
+                "in [0, 1] (a fraction of the backoff)"
+            )

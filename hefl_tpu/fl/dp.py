@@ -33,6 +33,28 @@ release is a composition of `rounds` Gaussian mechanisms, accounted in
 Renyi-DP: RDP(alpha) = rounds * alpha / (2 * noise_multiplier^2),
 converted to (epsilon, delta) by the standard bound
 epsilon = min_alpha [ RDP(alpha) + log(1/delta) / (alpha - 1) ].
+
+Partial participation (the streaming/faulted regimes, ISSUE 7) changes
+both halves of the story:
+
+  * Noise calibration. The per-client share sigma*C/sqrt(K) assumes all K
+    shares land in the sum; an excluded client takes its share with it and
+    the release silently carries LESS noise than accounted — the one
+    failure mode this module must never allow. `DpConfig.min_surviving`
+    declares a floor k on the surviving-cohort size and each share is
+    calibrated to sigma*C/sqrt(k) instead (conservative over-noising): any
+    s >= k survivors sum to noise std sigma*C*sqrt(s/k) >= sigma*C, i.e.
+    the effective noise is PROVABLY never below the full-participation
+    calibration. A round surviving below the declared floor still fails
+    loudly (fl.secure), because then the bound no longer holds.
+  * Amplification. When each round samples a cohort of q*C clients
+    uniformly, the release is a composition of SUBSAMPLED Gaussian
+    mechanisms and privacy amplifies: `epsilon_spent(..., sample_rate=q)`
+    applies the standard amplification-by-subsampling bound
+    eps_q = log(1 + q*(e^eps - 1)) per round, composed both basically and
+    by advanced composition, and returns the tightest of those and the
+    (always valid) unsampled bound — a conservative upper bound, not a
+    tight moments accountant.
 """
 
 from __future__ import annotations
@@ -52,11 +74,39 @@ class DpConfig:
     noise_multiplier: sigma of the CENTRAL mechanism in units of C
                       (per-client share is sigma*C/sqrt(K)).
     delta:            target delta for `epsilon_spent`.
+    min_surviving:    noise floor k for partial participation: each share
+                      is calibrated to sigma*C/sqrt(k) so any >= k
+                      surviving shares sum to AT LEAST the central
+                      mechanism's noise (conservative over-noising; see
+                      module doc). 0 = the historical full-participation
+                      calibration, under which ANY exclusion fails loudly.
+                      The driver derives a floor from the fault schedule /
+                      quorum when faults or streaming are enabled and no
+                      explicit floor is set (experiment.py).
     """
 
     clip_norm: float = 1.0
     noise_multiplier: float = 1.0
     delta: float = 1e-5
+    min_surviving: int = 0
+
+    def __post_init__(self):
+        if self.min_surviving < 0:
+            raise ValueError(
+                f"DpConfig.min_surviving={self.min_surviving}: must be >= 0 "
+                "(0 = full-participation calibration)"
+            )
+
+
+def calibration_clients(dp: DpConfig, num_clients: int) -> int:
+    """The share-calibration count K_cal: the denominator under the sqrt in
+    each client's noise share sigma*C/sqrt(K_cal), and the surviving-count
+    floor below which a round must fail loudly rather than release an
+    under-noised aggregate. min_surviving=0 keeps the historical
+    full-participation calibration (K_cal = num_clients) bit-for-bit."""
+    if dp.min_surviving <= 0:
+        return int(num_clients)
+    return min(int(dp.min_surviving), int(num_clients))
 
 
 def global_l2_norm(tree) -> jax.Array:
@@ -116,19 +166,36 @@ def dp_sanitize(
     return out, norm
 
 
-def epsilon_spent(
-    rounds: int, noise_multiplier: float, delta: float = 1e-5
-) -> float:
-    """(epsilon, delta)-DP spent after `rounds` full-participation rounds.
+def _subsampled_gaussian_rdp(q: float, sigma: float, alpha: int) -> float:
+    """RDP(alpha) of ONE Poisson-subsampled Gaussian mechanism at sampling
+    rate q and noise sigma — the integer-alpha binomial-expansion upper
+    bound (Wang/Balle/Kasiviswanathan 2019, Mironov et al. 2019):
 
-    Renyi accounting of the composed Gaussian mechanism (no subsampling:
-    every client participates every round, like the reference's FL loop),
-    optimized over an alpha grid. Monotone in rounds, decreasing in sigma.
+        (1/(a-1)) * log( sum_j C(a,j) (1-q)^(a-j) q^j e^{j(j-1)/(2 sigma^2)} )
+
+    Evaluated in log space (lgamma + log-sum-exp) so large alphas cannot
+    overflow. At q=1 the j=alpha term dominates and the bound degenerates
+    to the unsampled Gaussian's alpha/(2 sigma^2), as it must.
     """
-    if noise_multiplier <= 0:
-        return float("inf")
-    if rounds <= 0:
-        return 0.0
+    lq, l1q = math.log(q), math.log1p(-q)
+    terms = []
+    for j in range(alpha + 1):
+        lc = (
+            math.lgamma(alpha + 1)
+            - math.lgamma(j + 1)
+            - math.lgamma(alpha - j + 1)
+        )
+        terms.append(
+            lc + (alpha - j) * l1q + j * lq + j * (j - 1) / (2.0 * sigma**2)
+        )
+    m = max(terms)
+    lse = m + math.log(sum(math.exp(t - m) for t in terms))
+    return lse / (alpha - 1)
+
+
+def _rdp_epsilon(rounds: int, noise_multiplier: float, delta: float) -> float:
+    """Renyi accounting of `rounds` composed (unsampled) Gaussian
+    mechanisms, optimized over an alpha grid."""
     best = float("inf")
     # Dense low alphas (optimum for small sigma) + sparse high tail.
     alphas = [1.0 + x / 10.0 for x in range(1, 400)] + list(range(41, 512))
@@ -136,4 +203,45 @@ def epsilon_spent(
         rdp = rounds * a / (2.0 * noise_multiplier**2)
         eps = rdp + math.log(1.0 / delta) / (a - 1.0)
         best = min(best, eps)
+    return best
+
+
+def epsilon_spent(
+    rounds: int,
+    noise_multiplier: float,
+    delta: float = 1e-5,
+    sample_rate: float = 1.0,
+) -> float:
+    """(epsilon, delta)-DP spent after `rounds` rounds.
+
+    sample_rate=1 (every client participates every round, the reference's
+    FL loop): Renyi accounting of the composed Gaussian mechanism,
+    optimized over an alpha grid — bit-identical to the historical
+    accountant. Monotone in rounds, decreasing in sigma.
+
+    sample_rate=q<1 (each round samples a uniform cohort of q*C clients,
+    fl.stream's cohort scheduler): privacy amplification by subsampling —
+    RDP of the subsampled Gaussian (`_subsampled_gaussian_rdp`, the
+    standard Poisson-subsampling upper bound applied at the cohort's rate,
+    the usual practice for fixed-size uniform cohorts), composed over
+    rounds in alpha and optimized over integer alphas. The unsampled bound
+    caps the result (always valid: subsampling never hurts), so the
+    accountant is a conservative upper bound, never an optimistic one.
+    """
+    if noise_multiplier <= 0:
+        return float("inf")
+    if rounds <= 0:
+        return 0.0
+    if not 0.0 <= sample_rate <= 1.0:
+        raise ValueError(f"sample_rate={sample_rate}: must be in [0, 1]")
+    full = _rdp_epsilon(rounds, noise_multiplier, delta)
+    if sample_rate >= 1.0:
+        return full
+    if sample_rate == 0.0:
+        return 0.0  # nobody is ever sampled; the release is data-free
+    q = float(sample_rate)
+    best = full
+    for a in range(2, 257):
+        rdp_a = _subsampled_gaussian_rdp(q, noise_multiplier, a)
+        best = min(best, rounds * rdp_a + math.log(1.0 / delta) / (a - 1))
     return best

@@ -23,7 +23,12 @@ three pieces that make those behaviors first-class and *reproducible*:
 Exclusion causes are a bitmask so one int32[C] program output carries full
 attribution (a client can be both scheduled-out and NaN-poisoned):
 bit 0 scheduled (dropout / padding), bit 1 non-finite update, bit 2
-update-norm bound, bit 3 encoder saturation.
+update-norm bound, bit 3 encoder saturation. The streaming round engine
+(fl.stream) extends the same mask with ARRIVAL-level causes: bit 4 stale
+(a late upload exceeded the bounded-staleness budget), bit 5 timeout (the
+upload missed this round's commit), bit 6 unreachable (delivery failed
+and retries were exhausted), bit 7 unsampled (the client was not in this
+round's cohort — attribution, not a fault).
 """
 
 from __future__ import annotations
@@ -40,12 +45,22 @@ EXCLUDED_SCHEDULED = 1   # external mask: scheduled dropout or a padding slot
 EXCLUDED_NONFINITE = 2   # NaN/Inf anywhere in the trained update
 EXCLUDED_NORM = 4        # finite but ||update - global||_2 > max_update_norm
 EXCLUDED_OVERFLOW = 8    # encode_overflow > 0 under on_overflow="exclude"
+# Arrival-level causes set host-side by the streaming engine (fl.stream) —
+# never by the in-program predicates above.
+EXCLUDED_STALE = 16        # late upload exceeded the staleness budget tau
+EXCLUDED_TIMEOUT = 32      # upload missed this round's commit (may carry)
+EXCLUDED_UNREACHABLE = 64  # delivery failed, retries exhausted
+EXCLUDED_UNSAMPLED = 128   # not in this round's cohort (attribution only)
 
 EXCLUSION_CAUSES = {
     "scheduled": EXCLUDED_SCHEDULED,
     "nonfinite": EXCLUDED_NONFINITE,
     "norm": EXCLUDED_NORM,
     "overflow": EXCLUDED_OVERFLOW,
+    "stale": EXCLUDED_STALE,
+    "timeout": EXCLUDED_TIMEOUT,
+    "unreachable": EXCLUDED_UNREACHABLE,
+    "unsampled": EXCLUDED_UNSAMPLED,
 }
 
 # Poison codes (the int32[C] `poison` input of a masked round).
@@ -83,6 +98,23 @@ class FaultConfig:
                          round waits for its slowest client).
     fail_rounds:         rounds whose FIRST attempt raises DeviceLost — the
                          deterministic hook for the retry/auto-resume path.
+
+    Arrival-level faults (consumed by the streaming engine, fl.stream; the
+    synchronous driver ignores them):
+
+    arrival_delay_s:         max base dispersion of upload arrival times —
+                             every client's first delivery lands at
+                             U(0, arrival_delay_s) plus its scheduled
+                             straggler delay.
+    duplicate_clients:       clients per round whose (successful) first
+                             delivery is delivered TWICE — the engine must
+                             dedup idempotently by client-round nonce.
+    transient_fail_clients:  clients per round whose first delivery is
+                             LOST in flight; only the engine's retry
+                             machinery (backoff + jitter) can recover it.
+    permanent_fail_clients:  clients per round for whom EVERY delivery
+                             attempt fails (a crashed client) — excluded
+                             as "unreachable" once retries are exhausted.
     """
 
     seed: int = 0
@@ -92,6 +124,40 @@ class FaultConfig:
     straggler_fraction: float = 0.0
     straggler_delay_s: float = 0.0
     fail_rounds: tuple[int, ...] = ()
+    arrival_delay_s: float = 0.0
+    duplicate_clients: int = 0
+    transient_fail_clients: int = 0
+    permanent_fail_clients: int = 0
+
+    def __post_init__(self):
+        # Negative knobs would crash deep inside the numpy draws
+        # (rng.choice with a negative count) instead of failing loudly at
+        # config time.
+        for name in (
+            "drop_fraction", "nan_clients", "huge_clients",
+            "straggler_fraction", "straggler_delay_s", "arrival_delay_s",
+            "duplicate_clients", "transient_fail_clients",
+            "permanent_fail_clients",
+        ):
+            if getattr(self, name) < 0:
+                raise ValueError(f"FaultConfig.{name} must be >= 0")
+
+    def max_scheduled_exclusions(self, num_clients: int) -> int:
+        """Worst-case per-round exclusion count this schedule can cause —
+        the bound fl.dp's surviving-cohort noise floor is derived from
+        (experiment.py): dropout + poison targets (every poisoned client
+        is excluded by the sanitizer) + arrival failures that exhaust
+        retries. Sanitization causes outside the schedule (norm bound,
+        encoder saturation on organic updates) are NOT modeled here; a
+        round that exceeds this bound under dp fails loudly downstream."""
+        return min(
+            int(num_clients),
+            int(round(self.drop_fraction * num_clients))
+            + int(self.nan_clients)
+            + int(self.huge_clients)
+            + int(self.permanent_fail_clients)
+            + int(self.transient_fail_clients),
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -152,6 +218,69 @@ def schedule_for_round(
         poison=poison,
         straggler_s=straggler_s,
         device_loss=int(round_index) in fc.fail_rounds,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrivalFaults:
+    """One round's concrete arrival-fault assignment (host-side numpy).
+
+    The streaming engine (fl.stream) consumes this as the per-client
+    delivery behavior: WHEN each upload lands (`arrival_s`, which already
+    folds in the round's scheduled straggler delays), which deliveries are
+    duplicated, and which are lost transiently (first attempt only) or
+    permanently (every attempt)."""
+
+    arrival_s: np.ndarray   # float64[C] first-delivery offsets
+    duplicate: np.ndarray   # bool[C]  successful first delivery lands twice
+    transient: np.ndarray   # bool[C]  first delivery lost; retries succeed
+    permanent: np.ndarray   # bool[C]  every delivery attempt fails
+
+
+def schedule_arrivals(
+    fc: FaultConfig, round_index: int, num_clients: int
+) -> ArrivalFaults:
+    """The deterministic arrival-fault assignment for one round.
+
+    Keyed by (fc.seed, round_index, 1) — an independent PRNG stream from
+    `schedule_for_round` (which uses (seed, round_index)) so adding arrival
+    faults never reshuffles an existing dropout/poison schedule. Like the
+    poison draw, arrival faults target only clients the dropout schedule
+    left alive (a dropped client never uploads at all), and the three
+    failure kinds are disjoint so every scheduled fault is observable:
+    permanent first, then transient, then duplicates among the clean
+    remainder.
+    """
+    rng = np.random.default_rng([int(fc.seed), int(round_index), 1])
+    sched = schedule_for_round(fc, round_index, num_clients)
+    base = (
+        rng.uniform(0.0, fc.arrival_delay_s, num_clients)
+        if fc.arrival_delay_s > 0
+        else np.zeros(num_clients)
+    )
+    arrival_s = base + sched.straggler_s
+    duplicate = np.zeros(num_clients, dtype=bool)
+    transient = np.zeros(num_clients, dtype=bool)
+    permanent = np.zeros(num_clients, dtype=bool)
+    alive = np.flatnonzero(~sched.dropped)
+    n_perm = min(int(fc.permanent_fail_clients), len(alive))
+    if n_perm:
+        picks = rng.choice(alive, n_perm, replace=False)
+        permanent[picks] = True
+        alive = np.setdiff1d(alive, picks)
+    n_tran = min(int(fc.transient_fail_clients), len(alive))
+    if n_tran:
+        picks = rng.choice(alive, n_tran, replace=False)
+        transient[picks] = True
+        alive = np.setdiff1d(alive, picks)
+    n_dup = min(int(fc.duplicate_clients), len(alive))
+    if n_dup:
+        duplicate[rng.choice(alive, n_dup, replace=False)] = True
+    return ArrivalFaults(
+        arrival_s=arrival_s,
+        duplicate=duplicate,
+        transient=transient,
+        permanent=permanent,
     )
 
 

@@ -43,6 +43,7 @@ from hefl_tpu.ckks.packing import (
     unpack_quantized,
 )
 from hefl_tpu.fl.config import TrainConfig
+from hefl_tpu.fl.dp import calibration_clients
 from hefl_tpu.fl.faults import RoundMeta, exclusion_bits, poison_tree
 from hefl_tpu.fl.fedavg import (
     _mask_inputs,
@@ -545,22 +546,88 @@ def secure_fedavg_round(
     outs = fn(*args + (part, pois))
     ct_sum, mets, overflow, bits = outs[:4]
     meta = RoundMeta.from_bits(np.asarray(bits)[:num_clients])
-    if dp is not None and meta.surviving < num_clients:
-        # fl.dp calibrates each client's noise share to sigma*C/sqrt(K) so
-        # K surviving shares sum to the central mechanism's sigma*C. A
-        # masked-out client's zeroed limbs also zero its noise share, so
-        # the aggregate would carry only sqrt(k/K) of the accounted noise —
-        # a silently weakened (epsilon, delta) guarantee, the one failure
-        # mode the dp path must never allow. Fail loudly instead.
+    if dp is not None and meta.surviving < calibration_clients(dp, num_clients):
+        # fl.dp calibrates each client's noise share to sigma*C/sqrt(K_cal)
+        # so any >= K_cal surviving shares sum to AT LEAST the central
+        # mechanism's sigma*C (conservative over-noising under partial
+        # participation; K_cal = num_clients when no floor is declared). A
+        # round surviving BELOW the declared floor would carry less noise
+        # than epsilon_spent accounts — the silently-weakened-guarantee
+        # failure mode the dp path must never allow. Fail loudly instead.
         raise ValueError(
-            f"dp round excluded {num_clients - meta.surviving} of "
-            f"{num_clients} clients ({meta.excluded}); distributed noise "
-            "shares are calibrated for full participation, so the release "
-            "would carry less noise than epsilon_spent accounts — disable "
-            "fault injection/sanitization for dp runs, or re-run the round"
+            f"dp round survived {meta.surviving} clients, below the "
+            f"declared noise-calibration floor "
+            f"{calibration_clients(dp, num_clients)} of {num_clients} "
+            f"({meta.excluded}); the release would carry less noise than "
+            "epsilon_spent accounts — raise DpConfig.min_surviving (more "
+            "over-noising headroom) or reduce the fault pressure"
         )
     out = (ct_sum, mets[:num_clients], overflow[:num_clients], meta)
     return out + tuple(outs[4:])
+
+
+def client_upload_body(
+    module, cfg, backend, ctx, dp, dp_k, packing, want_bits,
+    gp, pk, x_blk, y_blk, kt_blk, ke_blk,
+    kd_blk=None, m_blk=None, po_blk=None,
+):
+    """The per-client half of BOTH round programs: train -> dp sanitize
+    (shares calibrated to dp_k) -> poison -> pack/encode/encrypt (+
+    overflow count) -> exclusion predicates. ONE body shared by the
+    batched secure round (`_build_secure_round_fn`, which adds the
+    mask-and-psum tail) and the streaming upload producer
+    (`fl.stream._build_upload_fn`, which ships the per-client rows to the
+    host engine) — the streaming-vs-batched bitwise-equality gates only
+    hold while the two programs trace the identical per-client ops, so
+    that body must exist exactly once.
+
+    `want_bits=False` (the unmasked legacy path) traces NO exclusion
+    predicates — computing them would add ops to the historical program.
+    -> (cts, mets, overflow, bits | None, p_out).
+    """
+    p_out, mets = train_block(
+        module, cfg, gp, x_blk, y_blk, kt_blk, m_blk=m_blk, backend=backend
+    )
+    if dp is not None:
+        from hefl_tpu.fl.dp import dp_sanitize
+
+        with jax.named_scope(obs_scopes.SANITIZE):
+            # Shares calibrated to the declared surviving-cohort floor
+            # (dp.min_surviving; = num_clients when none): conservative
+            # over-noising so partial participation never under-noises.
+            p_out, _ = jax.vmap(
+                lambda k, t: dp_sanitize(k, gp, t, dp, dp_k)
+            )(kd_blk, p_out)
+    if po_blk is not None:
+        # Fault injection corrupts the UPLOAD (after training and after
+        # any DP sanitize — a poisoned client does not run its own
+        # defenses); POISON_NONE is a pure where-select no-op.
+        with jax.named_scope(obs_scopes.SANITIZE):
+            p_out = jax.vmap(poison_tree)(p_out, po_blk)
+    # Phase scope (obs): pack/encode/overflow-count + the encrypt core
+    # are one hefl.encrypt trace bucket.
+    with jax.named_scope(obs_scopes.ENCRYPT):
+        if packing is not None:
+            # Quantized bit-interleaved upload: k-fold fewer ciphertext
+            # rows; `overflow` carries the quantizer saturation count
+            # (same slot, same on_overflow machinery).
+            cts, overflow = encrypt_stack_packed(
+                ctx, pk, p_out, gp, ke_blk, packing
+            )                                          # [cpd, n_ct/k, ...]
+        else:
+            # Saturation diagnostic on exactly what gets encoded (the
+            # packed blocks); XLA CSEs the duplicate pack with
+            # encrypt_params' own.
+            ov_one = lambda prm: encoding.encode_overflow_count(  # noqa: E731
+                pack_pytree(prm, ctx.n), ctx.scale
+            )
+            overflow = jax.vmap(ov_one)(p_out)         # [cpd] int32
+            cts = encrypt_stack(ctx, pk, p_out, ke_blk)  # [cpd, n_ct, L, N]
+    bits = None
+    if want_bits:
+        with jax.named_scope(obs_scopes.SANITIZE):
+            bits = exclusion_bits(cfg, gp, p_out, m_blk, overflow)
+    return cts, mets, overflow, bits, p_out
 
 
 @functools.lru_cache(maxsize=32)
@@ -601,6 +668,7 @@ def _build_secure_round_fn(
     from hefl_tpu.fl.fusion import resolve_fusion_backend
 
     backend = resolve_fusion_backend(cfg.client_fusion, module)
+    dp_k = calibration_clients(dp, num_clients) if dp is not None else 0
 
     def body(gp, pk, x_blk, y_blk, kt_blk, ke_blk, *rest):
         i = 0
@@ -608,46 +676,13 @@ def _build_secure_round_fn(
         if dp is not None:
             kd_blk, i = rest[0], 1
         m_blk, po_blk = (rest[i], rest[i + 1]) if masked else (None, None)
-        p_out, mets = train_block(
-            module, cfg, gp, x_blk, y_blk, kt_blk,
-            m_blk=m_blk, backend=backend,
+        cts, mets, overflow, bits, p_out = client_upload_body(
+            module, cfg, backend, ctx, dp, dp_k, packing, masked,
+            gp, pk, x_blk, y_blk, kt_blk, ke_blk,
+            kd_blk=kd_blk, m_blk=m_blk, po_blk=po_blk,
         )
-        if dp is not None:
-            from hefl_tpu.fl.dp import dp_sanitize
-
-            with jax.named_scope(obs_scopes.SANITIZE):
-                p_out, _ = jax.vmap(
-                    lambda k, t: dp_sanitize(k, gp, t, dp, num_clients)
-                )(kd_blk, p_out)
-        if masked:
-            # Fault injection corrupts the UPLOAD (after training and after
-            # any DP sanitize — a poisoned client does not run its own
-            # defenses); POISON_NONE is a pure where-select no-op.
-            with jax.named_scope(obs_scopes.SANITIZE):
-                p_out = jax.vmap(poison_tree)(p_out, po_blk)
-        # Phase scope (obs): pack/encode/overflow-count + the encrypt core
-        # are one hefl.encrypt trace bucket.
-        with jax.named_scope(obs_scopes.ENCRYPT):
-            if packing is not None:
-                # Quantized bit-interleaved upload: k-fold fewer ciphertext
-                # rows; `overflow` carries the quantizer saturation count
-                # (same slot, same on_overflow machinery).
-                cts, overflow = encrypt_stack_packed(
-                    ctx, pk, p_out, gp, ke_blk, packing
-                )                                          # [cpd, n_ct/k, ...]
-            else:
-                # Saturation diagnostic on exactly what gets encoded (the
-                # packed blocks); XLA CSEs the duplicate pack with
-                # encrypt_params' own.
-                ov_one = lambda prm: encoding.encode_overflow_count(  # noqa: E731
-                    pack_pytree(prm, ctx.n), ctx.scale
-                )
-                overflow = jax.vmap(ov_one)(p_out)         # [cpd] int32
-                cts = encrypt_stack(ctx, pk, p_out, ke_blk)  # [cpd, n_ct, L, N]
         with jax.named_scope(obs_scopes.PSUM_AGGREGATE):
             if masked:
-                with jax.named_scope(obs_scopes.SANITIZE):
-                    bits = exclusion_bits(cfg, gp, p_out, m_blk, overflow)
                 keep = bits == 0
                 sel = keep.reshape((-1, 1, 1, 1))
                 cts = Ciphertext(

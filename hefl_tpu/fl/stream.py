@@ -1,0 +1,797 @@
+"""Streaming quorum aggregation: deadline-driven cohorts, bounded staleness.
+
+The reference pipeline — and until this module, this repo's driver — is
+one-round-everyone-arrives FedAvg: materialize every client's ciphertext,
+psum, wait for the slowest straggler (`time.sleep` in experiment.py). That
+synchronous assumption is the last blocker between "benchmark loop" and
+the ROADMAP's million-client aggregation service: one slow client stalls
+the whole round, and the full [C, n_ct, L, N] ciphertext block scales
+memory linearly with the cohort.
+
+CKKS addition is associative and commutative over exact residues mod p,
+so neither assumption is load-bearing. This module replaces them:
+
+  * `sample_cohort` — per-round cohorts drawn by a deterministic PRNG:
+    partial participation is the DEFAULT regime, not a fault.
+  * `OnlineAccumulator` — each arriving encrypted update folds into a
+    running modular sum: O(1) memory in cohort size, and — because every
+    fold is exact arithmetic mod p — BITWISE equal to the batched
+    psum-of-limbs whatever the arrival order (hash-gated in
+    tests/test_stream.py and the chaos smoke). Duplicate deliveries dedup
+    idempotently by (client, round) nonce.
+  * `StreamEngine` — the round lifecycle: every cohort client carries a
+    delivery deadline; a LOST upload is retried with exponential backoff
+    and deterministic jitter; an upload that misses the round's commit is
+    carried into the next round under a bounded-staleness budget tau
+    (beyond tau it is excluded as "stale", attributed through the PR-2
+    exclusion bitmask) or dropped; the round COMMITS as soon as a quorum
+    Q of the cohort has arrived, and degrades gracefully below quorum
+    (global model carried forward with a loud event — exactly the
+    all-excluded-round semantics the driver already has).
+
+The arrival timeline is SIMULATED on a virtual clock from the
+deterministic fault schedule (fl.faults.schedule_arrivals): the engine
+consumes per-client arrival times instead of the driver sleeping out the
+max straggler delay, so chaos runs are both faster and richer
+(duplicates, transient/permanent failures, cross-round arrivals).
+`StreamConfig.time_scale` optionally maps simulated waiting onto real
+wall-clock (slept under the hefl.quorum_wait host TraceAnnotation, the
+same host_rows contract as hefl.straggler_wait).
+
+Simulation vs service: the per-client uploads are produced here by ONE
+batched SPMD program (`produce_uploads` — the same train/sanitize/encrypt
+body as fl.secure's round, minus the psum), because the clients are
+simulated in-process; a real deployment feeds network arrivals to the
+same `OnlineAccumulator.fold` interface and the aggregation memory stays
+O(1) either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from hefl_tpu.ckks.ops import Ciphertext
+from hefl_tpu.fl.config import StreamConfig, TrainConfig
+from hefl_tpu.fl.dp import calibration_clients
+from hefl_tpu.fl.faults import (
+    EXCLUDED_NONFINITE,
+    EXCLUDED_NORM,
+    EXCLUDED_OVERFLOW,
+    EXCLUDED_STALE,
+    EXCLUDED_TIMEOUT,
+    EXCLUDED_UNREACHABLE,
+    EXCLUDED_UNSAMPLED,
+    EXCLUSION_CAUSES,
+    RoundMeta,
+    schedule_arrivals,
+    schedule_for_round,
+)
+from hefl_tpu.fl.fedavg import (
+    _mask_inputs,
+    _round_geometry,
+    replicate_on,
+)
+from hefl_tpu.obs import events as obs_events
+from hefl_tpu.obs import metrics as obs_metrics
+from hefl_tpu.obs import scopes as obs_scopes
+from hefl_tpu.parallel import client_axes, client_mesh_size, shard_map
+
+# In-program sanitization causes: an upload whose bits carry any of these
+# ARRIVES but is rejected at the accumulator (the sanitizer's verdict is
+# part of the upload's validity, not of its delivery).
+_REJECT_MASK = EXCLUDED_NONFINITE | EXCLUDED_NORM | EXCLUDED_OVERFLOW
+
+# The staleness histogram ("rounds late" per folded upload) uses the
+# registry's default bucket bounds — one source, obs.metrics.
+
+
+# ---------------------------------------------------------------------------
+# Cohort scheduler
+# ---------------------------------------------------------------------------
+
+
+def sample_cohort(
+    stream: StreamConfig, round_index: int, num_clients: int
+) -> np.ndarray:
+    """The round's cohort: sorted client indices, drawn without replacement
+    by a PRNG keyed on (stream.seed, round_index, 2) — deterministic,
+    independent of call order and of the fault schedule's streams."""
+    size = int(stream.cohort_size)
+    if size <= 0 or size >= num_clients:
+        return np.arange(num_clients)
+    rng = np.random.default_rng([int(stream.seed), int(round_index), 2])
+    return np.sort(rng.choice(num_clients, size, replace=False))
+
+
+def quorum_count(stream: StreamConfig, cohort_size: int) -> int:
+    """Fresh arrivals needed to commit: ceil(quorum * cohort), floor 1."""
+    return max(1, int(math.ceil(stream.quorum * cohort_size)))
+
+
+# ---------------------------------------------------------------------------
+# Online accumulator: the O(1)-memory streaming half of the aggregation.
+# ---------------------------------------------------------------------------
+
+
+class OnlineAccumulator:
+    """Running modular sum of ciphertext uploads, folded one arrival at a
+    time.
+
+    Each fold is an exact canonical addition mod p of uint32 RNS residues
+    (int64 intermediate, so no wraparound at any prime size), which makes
+    the running sum BITWISE equal to fl.secure's batched lazy-sum/psum
+    over the same uploads in any arrival order — modular addition is
+    associative and commutative, and every representation here is the
+    canonical residue. Duplicate deliveries are rejected idempotently by
+    nonce. Memory is O(1) in the number of uploads: one [n_ct, L, N]
+    residue pair, however many clients fold.
+    """
+
+    def __init__(self, p: np.ndarray):
+        self.p = np.asarray(p, dtype=np.int64)
+        self._c0: np.ndarray | None = None
+        self._c1: np.ndarray | None = None
+        self._nonces: set = set()
+        self.folded = 0
+        self.duplicates = 0
+
+    def _add(self, acc, row):
+        return (
+            (acc.astype(np.int64) + np.asarray(row, dtype=np.int64)) % self.p
+        ).astype(np.uint32)
+
+    def fold(self, nonce, c0, c1) -> bool:
+        """Fold one upload; False (and count a duplicate) if its nonce was
+        already folded — redelivery must be idempotent."""
+        if nonce in self._nonces:
+            self.duplicates += 1
+            return False
+        self._nonces.add(nonce)
+        if self._c0 is None:
+            # Canonicalize the first upload too (producer rows already are;
+            # this keeps the invariant independent of the caller).
+            z = np.zeros_like(np.asarray(c0, dtype=np.uint32))
+            self._c0, self._c1 = self._add(z, c0), self._add(z, c1)
+        else:
+            self._c0 = self._add(self._c0, c0)
+            self._c1 = self._add(self._c1, c1)
+        self.folded += 1
+        return True
+
+    def value(self, like_shape=None) -> tuple[np.ndarray, np.ndarray]:
+        """The running sum (canonical residues); zeros of `like_shape` when
+        nothing folded (the encryption-of-zero an empty round yields)."""
+        if self._c0 is None:
+            if like_shape is None:
+                raise ValueError(
+                    "OnlineAccumulator.value: nothing folded and no shape"
+                )
+            z = np.zeros(like_shape, np.uint32)
+            return z, z.copy()
+        return self._c0, self._c1
+
+
+def ct_hash(c0, c1) -> str:
+    """Pipeline hash of a ciphertext's residues — the bitwise-equality
+    currency of the streaming-vs-batched gates."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(np.asarray(c0, dtype=np.uint32)))
+    h.update(np.ascontiguousarray(np.asarray(c1, dtype=np.uint32)))
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Upload producer: one SPMD program -> per-client encrypted uploads + bits.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=16)
+def _build_upload_fn(
+    module,
+    cfg: TrainConfig,
+    mesh,
+    ctx,
+    dp=None,
+    num_clients: int = 0,
+    packing=None,
+):
+    """Compile-once factory for the streaming upload program: EXACTLY the
+    per-client body of fl.secure's masked round (`client_upload_body` —
+    one shared function, so the streaming-vs-batched bitwise gates cannot
+    drift), WITHOUT the mask-and-psum tail — the per-client ciphertexts
+    leave the program (P(axes)-sharded) so the host-side engine can fold
+    them as they "arrive". dp shares are calibrated to the declared
+    surviving floor (fl.dp.calibration_clients), like the batched path."""
+    from hefl_tpu.fl.fusion import resolve_fusion_backend
+    from hefl_tpu.fl.secure import client_upload_body
+
+    axes = client_axes(mesh)
+    backend = resolve_fusion_backend(cfg.client_fusion, module)
+    dp_k = calibration_clients(dp, num_clients) if dp is not None else 0
+
+    def body(gp, pk, x_blk, y_blk, kt_blk, ke_blk, *rest):
+        i = 0
+        kd_blk = None
+        if dp is not None:
+            kd_blk, i = rest[0], 1
+        m_blk, po_blk = rest[i], rest[i + 1]
+        cts, mets, overflow, bits, _ = client_upload_body(
+            module, cfg, backend, ctx, dp, dp_k, packing, True,
+            gp, pk, x_blk, y_blk, kt_blk, ke_blk,
+            kd_blk=kd_blk, m_blk=m_blk, po_blk=po_blk,
+        )
+        return cts, mets, overflow, bits
+
+    in_specs = (P(), P(), P(axes), P(axes), P(axes), P(axes))
+    if dp is not None:
+        in_specs = in_specs + (P(axes),)
+    in_specs = in_specs + (P(axes), P(axes))
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(P(axes), P(axes), P(axes), P(axes)),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def produce_uploads(
+    module,
+    cfg: TrainConfig,
+    mesh,
+    ctx,
+    pk,
+    global_params,
+    xs,
+    ys,
+    key,
+    participation=None,
+    poison=None,
+    dp=None,
+    num_real_clients: int | None = None,
+    packing=None,
+):
+    """Train every client and return its ENCRYPTED upload, per client.
+
+    -> (Ciphertext [C, n_ct, L, N], metrics [C, E, 4], overflow int32[C],
+    bits int32[C]): the streaming engine's arrival payloads plus the
+    in-program sanitization verdicts. Key-split convention is IDENTICAL to
+    secure_fedavg_round's (train/enc[/dp] streams), so a cohort's
+    trainings match what the batched round would have computed for the
+    same key.
+    """
+    n_dev = client_mesh_size(mesh)
+    num_clients, pad_idx, prepadded = _round_geometry(
+        xs, n_dev, num_real_clients
+    )
+    if packing is not None and packing.clients < num_clients:
+        raise ValueError(
+            f"packing spec sized for {packing.clients} clients cannot hold "
+            f"a carry-free sum over {num_clients} — rebuild "
+            "PackedSpec.for_params with the experiment's count"
+        )
+    if dp is None:
+        k_train, k_enc = jax.random.split(key)
+        dp_keys = None
+    else:
+        k_train, k_enc, k_dp = jax.random.split(key, 3)
+        dp_keys = jax.random.split(k_dp, num_clients)
+    train_keys = jax.random.split(k_train, num_clients)
+    enc_keys = jax.random.split(k_enc, num_clients)
+    gp = replicate_on(mesh, global_params)
+    part, pois = _mask_inputs(num_clients, participation, poison, pad_idx)
+    if pad_idx is not None:
+        train_keys, enc_keys = train_keys[pad_idx], enc_keys[pad_idx]
+        if dp_keys is not None:
+            dp_keys = dp_keys[pad_idx]
+        if not prepadded:
+            xs, ys = xs[pad_idx], ys[pad_idx]
+    fn = _build_upload_fn(
+        module, cfg, mesh, ctx, dp, num_clients, packing
+    )
+    args = (gp, pk, xs, ys, train_keys, enc_keys)
+    if dp is not None:
+        args = args + (dp_keys,)
+    cts, mets, overflow, bits = fn(*args + (part, pois))
+    return (
+        Ciphertext(
+            c0=cts.c0[:num_clients], c1=cts.c1[:num_clients], scale=cts.scale
+        ),
+        mets[:num_clients],
+        overflow[:num_clients],
+        bits[:num_clients],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Round metadata + cross-round carry state
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PendingUpload:
+    """An upload carried across rounds under the staleness budget."""
+
+    client: int
+    origin_round: int
+    nonce: tuple
+    c0: np.ndarray
+    c1: np.ndarray
+    lands_at: float      # arrival offset within its landing round
+    lateness: int        # rounds behind its origin when it lands
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamRoundMeta:
+    """One streaming round's public outcome: the RoundMeta the decoder
+    needs (surviving = uploads in the released sum) plus the arrival-level
+    story — quorum, commit time, dedup/retry/staleness accounting."""
+
+    meta: RoundMeta
+    round_index: int
+    cohort: tuple[int, ...]
+    quorum: int
+    committed: bool          # round released (False = degraded: model
+                             # carried forward, nothing released)
+    degraded_reason: str | None  # None | "quorum" | "dp_floor"
+    fresh: int               # this round's cohort arrivals folded
+    stale_folded: int        # carried uploads folded this round
+    carried: int             # uploads carried into the NEXT round
+    stale_excluded: int      # late uploads dropped past the budget
+    unreachable: int         # deliveries lost with retries exhausted
+    arrivals: int            # deliveries received (incl. duplicates)
+    duplicates: int          # deduped redeliveries
+    rejected: int            # arrivals the in-program sanitizer rejected
+    retries: int             # redelivery attempts made
+    commit_s: float          # simulated time at which the round closed
+
+    def record(self) -> dict:
+        """JSON-ready summary for history[r] / the stream_round event."""
+        return {
+            "cohort": list(self.cohort),
+            "quorum": self.quorum,
+            "committed": self.committed,
+            "degraded_reason": self.degraded_reason,
+            "fresh": self.fresh,
+            "stale_folded": self.stale_folded,
+            "carried": self.carried,
+            "stale_excluded": self.stale_excluded,
+            "unreachable": self.unreachable,
+            "arrivals": self.arrivals,
+            "duplicates": self.duplicates,
+            "rejected": self.rejected,
+            "retries": self.retries,
+            "commit_s": round(self.commit_s, 6),
+        }
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _Delivery:
+    """One simulated delivery event."""
+
+    t: float
+    seq: int
+    kind: str            # "fresh" | "stale"
+    client: int
+    nonce: tuple
+    retried: bool = False
+    pending: Any = None  # PendingUpload for kind == "stale"
+
+
+class StreamEngine:
+    """Round lifecycle driver for streaming quorum aggregation.
+
+    One instance per experiment: it owns the cross-round state (uploads
+    carried under the staleness budget, the dedup nonce window) and runs
+    each round's arrival simulation against the deterministic fault
+    schedule. All waiting is on a virtual clock unless
+    StreamConfig.time_scale > 0 maps it onto real sleeping (under the
+    hefl.quorum_wait host TraceAnnotation).
+    """
+
+    def __init__(self, stream: StreamConfig, faults=None):
+        self.stream = stream
+        self.faults = faults
+        self._pending: list[PendingUpload] = []   # land next round
+        self._seen: set = set()                   # dedup nonce window
+
+    # -- deterministic retry timeline --------------------------------------
+
+    def _retry_times(self, round_index: int, client: int, t0: float) -> list:
+        """Redelivery times for a lost upload: exponential backoff with
+        deterministic +/- jitter, starting from the server's miss point
+        (the deadline when one is set, else the original send)."""
+        s = self.stream
+        rng = np.random.default_rng(
+            [int(s.seed), int(round_index), int(client), 3]
+        )
+        t = max(s.deadline_s, t0) if s.deadline_s > 0 else t0
+        out = []
+        for i in range(s.max_retries):
+            back = s.retry_backoff_s * (2.0**i)
+            t += back * (1.0 + s.retry_jitter * float(rng.uniform(-1.0, 1.0)))
+            out.append(t)
+        return out
+
+    # -- one round ---------------------------------------------------------
+
+    def run_round(
+        self,
+        module,
+        cfg: TrainConfig,
+        mesh,
+        ctx,
+        pk,
+        global_params,
+        xs,
+        ys,
+        key,
+        round_index: int,
+        dp=None,
+        packing=None,
+        num_real_clients: int | None = None,
+    ):
+        """-> (Ciphertext sum, metrics [C, E, 4], overflow [C],
+        StreamRoundMeta). meta.meta.surviving is the decode denominator;
+        0 (or committed=False) means nothing was released this round and
+        the driver keeps the global model."""
+        s = self.stream
+        if dp is not None and s.staleness_rounds > 0:
+            # A carried upload lets one client contribute to a release
+            # TWICE (its stale + fresh uploads: sensitivity 2C while
+            # epsilon_spent accounts C per round) and makes a release
+            # depend on a client outside the round's cohort (voiding the
+            # subsampling amplification). Until a staleness-aware
+            # accountant exists, the combination is rejected loudly — the
+            # silently-weakened-guarantee failure mode fl.dp must never
+            # allow.
+            raise ValueError(
+                "dp cannot be combined with a staleness budget "
+                f"(staleness_rounds={s.staleness_rounds}): a carried "
+                "upload gives one client 2x the accounted per-round "
+                "sensitivity and breaks cohort-subsampling amplification "
+                "— set staleness_rounds=0 for dp runs"
+            )
+        n_dev = client_mesh_size(mesh)
+        num_clients, _, _ = _round_geometry(xs, n_dev, num_real_clients)
+        cohort = sample_cohort(s, round_index, num_clients)
+        in_cohort = np.zeros(num_clients, dtype=bool)
+        in_cohort[cohort] = True
+        qcount = quorum_count(s, len(cohort))
+        tau = int(s.staleness_rounds)
+
+        if self.faults is not None:
+            sched = schedule_for_round(self.faults, round_index, num_clients)
+            arr = schedule_arrivals(self.faults, round_index, num_clients)
+        else:
+            sched = arr = None
+        dropped = (
+            sched.dropped if sched is not None else np.zeros(num_clients, bool)
+        )
+        part = (in_cohort & ~dropped).astype(np.int32)
+        pois = (
+            np.where(in_cohort, sched.poison, 0).astype(np.int32)
+            if sched is not None
+            else None
+        )
+
+        cts, mets, overflow, bits_dev = produce_uploads(
+            module, cfg, mesh, ctx, pk, global_params, xs, ys, key,
+            participation=part, poison=pois, dp=dp,
+            num_real_clients=num_real_clients, packing=packing,
+        )
+        bits = np.asarray(bits_dev).astype(np.int64).copy()
+        # The program's sanitizer verdict, immutable: the arrival-time
+        # reject predicate must read THIS, not the attribution copy below
+        # (a stale fold clears a client's attribution, and that must never
+        # un-reject the same client's poisoned fresh upload).
+        prog_bits = bits.copy()
+        # Host-side attribution fix-up: the program marks every mask-0
+        # client "scheduled"; a client that simply was not sampled this
+        # round is attributed "unsampled" instead (not a fault).
+        bits[~in_cohort] = EXCLUDED_UNSAMPLED
+        c0 = np.asarray(cts.c0)
+        c1 = np.asarray(cts.c1)
+        row_shape = c0.shape[1:]
+
+        # Cross-round state is COMMITTED only at the end of a successful
+        # round (transactional): a round that dies mid-execution — the
+        # exact case the driver's retry envelope exists for — must leave
+        # the carried uploads and the dedup window untouched for the
+        # retry, not half-consumed.
+        # Dedup window: nonces stay live while a duplicate could still
+        # arrive (the staleness budget bounds how far one can trail).
+        seen = {n for n in self._seen if round_index - n[1] <= tau + 1}
+        pending_next: list[PendingUpload] = []
+
+        # ---- build this round's delivery timeline ------------------------
+        events: list[_Delivery] = []
+        seq = 0
+        retries_made = 0
+        unreachable = 0
+        for up in self._pending:
+            events.append(_Delivery(
+                t=float(up.lands_at), seq=seq, kind="stale",
+                client=up.client, nonce=up.nonce, pending=up,
+            ))
+            seq += 1
+        for c in cohort:
+            if part[c] == 0:
+                continue   # scheduled out: never uploads
+            nonce = (int(c), int(round_index))
+            t0 = float(arr.arrival_s[c]) if arr is not None else 0.0
+            permanent = bool(arr is not None and arr.permanent[c])
+            transient = bool(arr is not None and arr.transient[c])
+            if permanent:
+                # Every delivery fails; the engine still pays the retries.
+                retries_made += len(self._retry_times(round_index, c, t0))
+                bits[c] |= EXCLUDED_UNREACHABLE
+                unreachable += 1
+                continue
+            if transient:
+                retry_at = self._retry_times(round_index, c, t0)
+                if not retry_at:
+                    bits[c] |= EXCLUDED_UNREACHABLE
+                    unreachable += 1
+                    continue
+                retries_made += 1
+                events.append(_Delivery(
+                    t=float(retry_at[0]), seq=seq, kind="fresh", client=int(c),
+                    nonce=nonce, retried=True,
+                ))
+                seq += 1
+                continue
+            events.append(_Delivery(
+                t=t0, seq=seq, kind="fresh", client=int(c), nonce=nonce,
+            ))
+            seq += 1
+            if arr is not None and arr.duplicate[c]:
+                events.append(_Delivery(
+                    t=t0 + max(s.retry_backoff_s * 0.5, 1e-6), seq=seq,
+                    kind="fresh", client=int(c), nonce=nonce,
+                ))
+                seq += 1
+
+        # ---- process arrivals in time order ------------------------------
+        deadline = s.deadline_s if s.deadline_s > 0 else float("inf")
+        acc = OnlineAccumulator(ctx.ntt.p)
+        staleness_hist = obs_metrics.histogram("stream.staleness_rounds")
+        committed_at: float | None = None
+        fresh = stale_folded = arrivals = rejected = 0
+        stale_excluded = 0
+        headroom_blocked = 0
+        folded_clients: list[int] = []
+        fresh_used: list[tuple] = []   # (client, t) folded fresh this round
+        stale_used: list[tuple] = []   # (PendingUpload, t) folded stale
+        missed: list[tuple] = []   # (kind, client, t, lateness, c0, c1, nonce)
+        # Packed uploads share carry-free headroom sized for `clients`
+        # field summands; EVERY fold — fresh or stale — must respect it or
+        # the quantized lanes silently overflow into their neighbors. A
+        # fresh upload blocked by headroom takes the missed path
+        # (carry/timeout); worst case the round degrades, never corrupts.
+        max_folds = int(packing.clients) if packing is not None else None
+        last_t = 0.0
+        for ev in sorted(events, key=lambda e: (e.t, e.seq)):
+            last_t = max(last_t, ev.t)
+            headroom_ok = max_folds is None or acc.folded < max_folds
+            if ev.kind == "stale":
+                up = ev.pending
+                if committed_at is None and headroom_ok:
+                    acc.fold(("stale",) + up.nonce, up.c0, up.c1)
+                    stale_folded += 1
+                    folded_clients.append(up.client)
+                    stale_used.append((up, ev.t))
+                    # The client participates via its late upload; clear
+                    # ONLY the not-in-this-cohort attribution — same-round
+                    # fresh-upload causes (nonfinite, unreachable, ...)
+                    # must survive for the exclusion accounting.
+                    bits[up.client] &= ~EXCLUDED_UNSAMPLED
+                    staleness_hist.observe(up.lateness)
+                else:
+                    if committed_at is None and not headroom_ok:
+                        headroom_blocked += 1
+                    missed.append((
+                        "stale", up.client, ev.t, up.lateness,
+                        up.c0, up.c1, up.nonce,
+                    ))
+                continue
+            arrivals += 1
+            if ev.nonce in seen:
+                acc.duplicates += 1
+                continue
+            seen.add(ev.nonce)
+            c = ev.client
+            if prog_bits[c] & _REJECT_MASK:
+                rejected += 1
+                continue
+            if (
+                committed_at is None
+                and (ev.t <= deadline or ev.retried)
+                and headroom_ok
+            ):
+                acc.fold(ev.nonce, c0[c], c1[c])
+                fresh += 1
+                folded_clients.append(c)
+                fresh_used.append((c, ev.t))
+                staleness_hist.observe(0)
+                if fresh >= qcount:
+                    committed_at = ev.t
+            else:
+                if committed_at is None and not headroom_ok:
+                    headroom_blocked += 1
+                missed.append((
+                    "fresh", c, ev.t, 0, c0[c], c1[c], ev.nonce,
+                ))
+        committed = committed_at is not None
+        commit_s = (
+            committed_at
+            if committed
+            else min(max(last_t, 0.0), deadline)
+            if events
+            else 0.0
+        )
+        # DP surviving-cohort floor (fl.dp.calibration_clients): a round
+        # whose released sum would hold fewer uploads than the declared
+        # noise-calibration floor must NOT be released — the aggregate
+        # would carry less noise than epsilon_spent accounts, the exact
+        # failure the batched path fail-louds on (fl.secure). Streaming
+        # degrades instead of raising: the model carries forward, loudly.
+        degraded_reason = None if committed else "quorum"
+        if dp is not None and committed:
+            dp_floor = calibration_clients(dp, num_clients)
+            if acc.folded < dp_floor:
+                committed = False
+                degraded_reason = "dp_floor"
+                obs_metrics.counter("stream.dp_floor_degraded").inc()
+
+        # ---- misses: carry under the staleness budget, or drop -----------
+        carried = 0
+        for kind, c, t, lateness, mc0, mc1, nonce in missed:
+            next_late = lateness + 1
+            if next_late <= tau:
+                pending_next.append(PendingUpload(
+                    client=int(c), origin_round=int(nonce[-1]), nonce=nonce,
+                    c0=np.array(mc0), c1=np.array(mc1),
+                    lands_at=max(0.0, float(t) - float(commit_s)),
+                    lateness=next_late,
+                ))
+                carried += 1
+                if kind == "fresh":
+                    bits[c] |= EXCLUDED_TIMEOUT
+            else:
+                if kind == "fresh":
+                    bits[c] |= EXCLUDED_TIMEOUT
+                else:
+                    bits[c] |= EXCLUDED_STALE
+                    stale_excluded += 1
+        if not committed:
+            # Degraded round: the accumulator is discarded, but an upload
+            # that FOLDED into it was delivered in good faith — re-carry
+            # it under the staleness budget (a stale upload one round
+            # deeper; a fresh one at lateness 1) instead of destroying it
+            # mid-budget, and attribute what cannot carry.
+            for up, t in stale_used:
+                next_late = up.lateness + 1
+                if next_late <= tau:
+                    pending_next.append(PendingUpload(
+                        client=up.client, origin_round=up.origin_round,
+                        nonce=up.nonce, c0=up.c0, c1=up.c1,
+                        lands_at=max(0.0, float(t) - float(commit_s)),
+                        lateness=next_late,
+                    ))
+                    carried += 1
+                    # The fold was undone: restore attribution (the fold
+                    # had cleared it), or the client would read as neither
+                    # surviving nor excluded this round.
+                    bits[up.client] |= EXCLUDED_TIMEOUT
+                else:
+                    bits[up.client] |= EXCLUDED_STALE
+                    stale_excluded += 1
+            for c, t in fresh_used:
+                bits[c] |= EXCLUDED_TIMEOUT
+                if tau >= 1:
+                    pending_next.append(PendingUpload(
+                        client=int(c), origin_round=int(round_index),
+                        nonce=(int(c), int(round_index)),
+                        c0=np.array(c0[c]), c1=np.array(c1[c]),
+                        lands_at=max(0.0, float(t) - float(commit_s)),
+                        lateness=1,
+                    ))
+                    carried += 1
+
+        # ---- public metadata + observability -----------------------------
+        surviving = acc.folded if committed else 0
+        participation = np.zeros(num_clients, np.int32)
+        if committed:
+            participation[np.asarray(folded_clients, dtype=int)] = 1
+        meta = RoundMeta(
+            num_clients=num_clients,
+            bits=tuple(int(v) for v in bits),
+            participation=tuple(int(v) for v in participation),
+            surviving=int(surviving),
+            excluded={
+                name: int(np.count_nonzero(bits & flag))
+                for name, flag in EXCLUSION_CAUSES.items()
+            },
+            sanitized=True,
+        )
+        smeta = StreamRoundMeta(
+            meta=meta,
+            round_index=int(round_index),
+            cohort=tuple(int(c) for c in cohort),
+            quorum=qcount,
+            committed=committed,
+            degraded_reason=degraded_reason,
+            fresh=fresh,
+            stale_folded=stale_folded,
+            carried=carried,
+            stale_excluded=stale_excluded,
+            unreachable=unreachable,
+            arrivals=arrivals,
+            duplicates=acc.duplicates,
+            rejected=rejected,
+            retries=retries_made,
+            commit_s=float(commit_s),
+        )
+        obs_metrics.counter("stream.arrivals").inc(arrivals)
+        obs_metrics.counter("stream.duplicates").inc(acc.duplicates)
+        obs_metrics.counter("stream.rejected").inc(rejected)
+        obs_metrics.counter("stream.retries").inc(retries_made)
+        obs_metrics.counter("stream.late_carried").inc(carried)
+        obs_metrics.counter("stream.stale_excluded").inc(stale_excluded)
+        obs_metrics.counter("stream.headroom_blocked").inc(headroom_blocked)
+        if not committed:
+            obs_metrics.counter("stream.degraded_rounds").inc()
+        obs_events.emit(
+            "stream_round", round=round_index, **smeta.record()
+        )
+        # Quorum-wait span: how long (simulated) the round held open before
+        # committing — the streaming analog of the straggler wait.
+        obs_events.emit(
+            "quorum_wait", round=round_index, seconds=round(float(commit_s), 6),
+            quorum=qcount, fresh=fresh, committed=committed,
+        )
+        if s.time_scale > 0 and commit_s > 0:
+            # Map simulated waiting onto wall-clock so the wait is a real,
+            # attributable host span (obs.trace host_rows), like the
+            # synchronous driver's straggler sleep.
+            with jax.profiler.TraceAnnotation(obs_scopes.QUORUM_WAIT):
+                time.sleep(float(commit_s) * s.time_scale)
+
+        # Commit the transactional cross-round state — only a round that
+        # ran to completion updates it; a raise anywhere above leaves the
+        # previous round's carried uploads and dedup window intact for
+        # the driver's retry.
+        self._pending = pending_next
+        self._seen = seen
+
+        if committed:
+            sum_c0, sum_c1 = acc.value(like_shape=row_shape)
+        else:
+            # Below quorum nothing is released: hand back an encryption of
+            # zero, NOT the partial sum — a sub-quorum aggregate is both
+            # semantically void (the driver carries the model) and more
+            # privacy-sensitive than a full one (fewer contributors).
+            sum_c0 = np.zeros(row_shape, np.uint32)
+            sum_c1 = np.zeros(row_shape, np.uint32)
+        ct_sum = Ciphertext(
+            c0=jnp.asarray(sum_c0), c1=jnp.asarray(sum_c1), scale=cts.scale
+        )
+        return ct_sum, mets, overflow, smeta

@@ -44,6 +44,15 @@ multi-day aggregation-service run keeps a bounded recent window plus one
 generation of history instead of an unbounded append. Gates that read the
 CURRENT file see a parseable log either way (`read_events` never needs
 the rotated half).
+
+Rotated generations can be SHIPPED: `on_rotation(callback)` registers a
+hook invoked with the rotated file's path right after each rotation
+(the fresh generation is already open, so a hook may itself emit; the
+rotated file is guaranteed to exist until the NEXT rotation replaces
+it), so a long-lived service run can upload/archive `<path>.1` instead
+of silently orphaning it. Default is no hooks (pure local rotation); a
+hook that raises is swallowed with a one-line stderr warning — telemetry
+shipping must never take down the training loop.
 """
 
 from __future__ import annotations
@@ -57,6 +66,42 @@ SCHEMA_VERSION = 1
 
 # Fields every line carries; gates can demand them without knowing kinds.
 REQUIRED_FIELDS = ("ts", "event")
+
+# Rotation-shipper hooks: callables invoked with the rotated generation's
+# path (`<path>.1`) right after each rotation. Process-global, like the
+# writer itself, so deep producers and the driver share one registry.
+_ROTATION_HOOKS: list = []
+
+
+def on_rotation(callback):
+    """Register a shipper hook `callback(rotated_path: str) -> None` for
+    rotated events.jsonl generations (idempotent per callable). Returns
+    the callback so it can be used as a decorator."""
+    if callback not in _ROTATION_HOOKS:
+        _ROTATION_HOOKS.append(callback)
+    return callback
+
+
+def remove_rotation_hook(callback) -> bool:
+    """Unregister a shipper hook; True if it was registered."""
+    try:
+        _ROTATION_HOOKS.remove(callback)
+        return True
+    except ValueError:
+        return False
+
+
+def _fire_rotation_hooks(rotated_path: str) -> None:
+    for cb in list(_ROTATION_HOOKS):
+        try:
+            cb(rotated_path)
+        except Exception as e:  # never raise into the training loop
+            import sys
+
+            print(
+                f"events: rotation hook {cb!r} failed: {e!r}",
+                file=sys.stderr,
+            )
 
 
 def enabled() -> bool:
@@ -127,6 +172,13 @@ class EventLog:
         except OSError:
             rotated = None
         self._open(rotated_from=rotated)
+        if rotated:
+            # Shipper hooks run AFTER the fresh generation opens (the
+            # rotated file still exists — os.replace is done): a hook
+            # that itself emits an event must find a healthy open log,
+            # not re-enter a half-finished rotation (which would leak the
+            # handle and overwrite the rotated_from header).
+            _fire_rotation_hooks(rotated)
 
     def emit(self, event: str, **fields: Any) -> dict:
         rec = {"ts": round(time.time(), 6), "event": event, **fields}

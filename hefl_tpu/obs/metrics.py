@@ -53,6 +53,64 @@ class Gauge:
         self.value = v if self.value is None else max(self.value, v)
 
 
+DEFAULT_HISTOGRAM_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0)
+
+
+class Histogram:
+    """Cumulative bucket counts over fixed upper bounds (plus +inf).
+
+    The distribution companion to Counter/Gauge — e.g. the streaming
+    engine's staleness histogram ("how many rounds late was each folded
+    upload"). `observe(v)` increments every bucket whose bound is >= v
+    (Prometheus-style cumulative buckets), so `value` is JSON-ready:
+    {"le_1": n, ..., "le_inf": n, "count": n, "sum": s}.
+    """
+
+    __slots__ = ("bounds", "counts", "count", "sum")
+
+    def __init__(self, bounds: tuple = DEFAULT_HISTOGRAM_BUCKETS) -> None:
+        self.bounds = tuple(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)   # + the inf bucket
+        self.count = 0
+        self.sum: float = 0.0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                self.counts[i] += 1
+        self.counts[-1] += 1
+
+    @staticmethod
+    def _label(b: float) -> str:
+        return f"le_{int(b)}" if float(b).is_integer() else f"le_{b}"
+
+    @property
+    def value(self) -> dict:
+        out = {self._label(b): self.counts[i] for i, b in enumerate(self.bounds)}
+        out["le_inf"] = self.counts[-1]
+        out["count"] = self.count
+        out["sum"] = round(self.sum, 6)
+        return out
+
+    def delta(self, baseline: dict | None) -> dict:
+        """This histogram minus a snapshot()-shaped baseline (per-run view,
+        same contract as Counter deltas in `snapshot_delta`)."""
+        cur = self.value
+        if not isinstance(baseline, dict):
+            return cur
+        return {
+            k: (
+                round(v - (baseline.get(k) or 0), 6)
+                if isinstance(v, (int, float))
+                else v
+            )
+            for k, v in cur.items()
+        }
+
+
 class MetricsRegistry:
     """Thread-safe name -> metric map. Metrics are created on first use so
     producers never need registration order."""
@@ -79,6 +137,31 @@ class MetricsRegistry:
                 raise TypeError(f"metric {name!r} already registered as counter")
             return m
 
+    def histogram(self, name: str, bounds: tuple | None = None) -> Histogram:
+        """bounds=None fetches-or-creates with the default buckets;
+        explicit bounds that CONFLICT with an existing registration raise
+        (silently bucketing under bounds a producer never asked for is
+        the same failure class as a type collision)."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Histogram(
+                    DEFAULT_HISTOGRAM_BUCKETS if bounds is None else bounds
+                )
+            elif not isinstance(m, Histogram):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__.lower()}"
+                )
+            elif bounds is not None and m.bounds != tuple(
+                float(b) for b in bounds
+            ):
+                raise ValueError(
+                    f"histogram {name!r} already registered with bounds "
+                    f"{m.bounds}, conflicting with {tuple(bounds)}"
+                )
+            return m
+
     def snapshot(self) -> dict[str, Any]:
         """JSON-ready {name: value}; the record artifacts embed."""
         with self._lock:
@@ -95,6 +178,8 @@ class MetricsRegistry:
                 k: (
                     m.value - (baseline.get(k) or 0)
                     if isinstance(m, Counter)
+                    else m.delta(baseline.get(k))
+                    if isinstance(m, Histogram)
                     else m.value
                 )
                 for k, m in sorted(self._metrics.items())
@@ -111,6 +196,7 @@ REGISTRY = MetricsRegistry()
 # Module-level conveniences: the spelling every producer uses.
 counter = REGISTRY.counter
 gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
 snapshot = REGISTRY.snapshot
 snapshot_delta = REGISTRY.snapshot_delta
 reset = REGISTRY.reset

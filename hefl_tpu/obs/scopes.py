@@ -44,6 +44,7 @@ EVALUATE = "hefl.evaluate"            # test-set forward + softmax
 # reports them as `host_rows` so e.g. a straggler wait is a first-class
 # row instead of an unexplained wall-vs-device gap.
 STRAGGLER_WAIT = "hefl.straggler_wait"  # driver-side straggler sleep
+QUORUM_WAIT = "hefl.quorum_wait"        # streaming engine's wait-for-quorum
 
 # Canonical ordering for tables; the trace parser buckets ANY "hefl.*"
 # component it finds, so adding a scope never requires touching the parser.

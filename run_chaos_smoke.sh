@@ -22,8 +22,17 @@
 #       clients, keep all params finite, and land within the accuracy
 #       tolerance of the unpacked faulted run — quantization at the
 #       declared budget must not change robustness behavior.
-# Artifact: CHAOS_SMOKE.json (both accuracy curves + per-round exclusions
-# + the events.jsonl cross-check).
+#   (g) streaming quorum aggregation (ISSUE 7): the faulted schedule plus
+#       arrival-level faults (stragglers past the deadline, duplicate and
+#       transiently-lost deliveries) run through the streaming engine:
+#       every round must COMMIT at quorum, the per-round stream_round
+#       events' arrival/dedup/retry counters and the cross-round staleness
+#       bookkeeping must match the deterministic schedule EXACTLY, the
+#       experiment_end stream.* counters must equal the per-round sums,
+#       and the final accuracy must land within tolerance of the
+#       synchronous faulted twin.
+# Artifact: CHAOS_SMOKE.json (accuracy curves + per-round exclusions
+# + the events.jsonl cross-checks, streaming twin included).
 # Wired into run_tpu_suite.sh as stage 0b (CPU-only, no TPU probe needed).
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -36,11 +45,13 @@ if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
 fi
 
 # The faulted run's structured events land here; the clean twin runs with
-# the writer disabled so the log is exactly one run's evidence.
+# the writer disabled so the log is exactly one run's evidence. The
+# streaming twin gets its OWN log so the two runs' counters never mix.
 workdir=$(mktemp -d)
 trap 'rm -rf "$workdir"' EXIT
 export HEFL_EVENTS=1
 export CHAOS_EVENTS_PATH="$workdir/events.jsonl"
+export CHAOS_STREAM_EVENTS_PATH="$workdir/stream_events.jsonl"
 
 python - <<'PY'
 import dataclasses
@@ -81,6 +92,28 @@ packed_cfg = dataclasses.replace(
 )
 print("chaos smoke: packed faulted twin (b=8 k=2) ...", flush=True)
 packed = run_experiment(packed_cfg, verbose=False)
+
+# (g) streaming twin (ISSUE 7): the same dropout/NaN schedule PLUS
+# arrival-level faults — two stragglers whose uploads can miss the 2 s
+# deadline (carried under tau=1), one duplicated delivery, one transient
+# loss recovered by a single retry — through the streaming quorum engine.
+# quorum=0.375 (3 of the 8-cohort) keeps every round committable even in
+# the schedule's worst case.
+from hefl_tpu.fl import StreamConfig, schedule_arrivals
+
+stream_faults = dataclasses.replace(
+    cfg.faults, straggler_fraction=0.25, straggler_delay_s=6.0,
+    arrival_delay_s=0.5, duplicate_clients=1, transient_fail_clients=1,
+)
+stream_cfg = dataclasses.replace(
+    cfg, faults=stream_faults,
+    stream=StreamConfig(quorum=0.375, deadline_s=2.0, max_retries=1,
+                        staleness_rounds=1, seed=0),
+    events_path=os.environ["CHAOS_STREAM_EVENTS_PATH"],
+)
+print("chaos smoke: streaming twin (quorum 3/8, deadline 2s, tau 1) ...",
+      flush=True)
+streamed = run_experiment(stream_cfg, verbose=False)
 
 fail = []
 rounds = []
@@ -242,16 +275,137 @@ if evs:
         "counters": counters,
     }
 
+# (g) streaming twin gates: every round commits at quorum; the per-round
+# stream_round events' arrival/dedup/retry counters match the
+# deterministic schedule EXACTLY; cross-round staleness bookkeeping is
+# conserved; experiment_end stream.* counters equal the per-round sums;
+# accuracy within tolerance of the synchronous faulted twin.
+stream_summary = {}
+try:
+    sevs = obs_events.read_events(os.environ["CHAOS_STREAM_EVENTS_PATH"])
+except (OSError, ValueError) as e:
+    sevs = []
+    fail.append(f"stream events.jsonl unusable: {e}")
+if sevs:
+    stream_by_round = {
+        e["round"]: e for e in sevs if e["event"] == "stream_round"
+    }
+    exp_arrivals = exp_dups = exp_retries = exp_rejected = 0
+    for r in range(stream_cfg.rounds):
+        ev = stream_by_round.get(r)
+        if ev is None:
+            fail.append(f"stream events: no stream_round event for round {r}")
+            continue
+        sched = schedule_for_round(stream_faults, r, cfg.num_clients)
+        arr = schedule_arrivals(stream_faults, r, cfg.num_clients)
+        alive = int(np.count_nonzero(~sched.dropped))
+        n_dup = int(arr.duplicate.sum())
+        n_tran = int(arr.transient.sum())
+        n_rej = int(np.count_nonzero(sched.poison))
+        # every alive client delivers once (transients via their single
+        # retry) and each duplicated delivery adds one more arrival
+        want = {
+            "arrivals": alive + n_dup,
+            "duplicates": n_dup,
+            "retries": n_tran,
+            "rejected": n_rej,
+        }
+        for k, v in want.items():
+            if ev.get(k) != v:
+                fail.append(
+                    f"stream round {r}: {k} {ev.get(k)} != schedule {v}"
+                )
+        if not ev.get("committed"):
+            fail.append(f"stream round {r}: did not commit at quorum")
+        if ev.get("fresh", 0) < ev.get("quorum", 99):
+            fail.append(
+                f"stream round {r}: committed with fresh {ev.get('fresh')} "
+                f"below quorum {ev.get('quorum')}"
+            )
+        exp_arrivals += want["arrivals"]
+        exp_dups += n_dup
+        exp_retries += n_tran
+        exp_rejected += n_rej
+    # cross-round staleness conservation: what round r carried either
+    # folds or is excluded as stale in round r+1 (tau=1 forbids a second
+    # carry)
+    for r in range(stream_cfg.rounds - 1):
+        a, b = stream_by_round.get(r), stream_by_round.get(r + 1)
+        if a is None or b is None:
+            continue
+        if a["carried"] != b["stale_folded"] + b["stale_excluded"]:
+            fail.append(
+                f"stream rounds {r}->{r + 1}: carried {a['carried']} != "
+                f"stale_folded {b['stale_folded']} + stale_excluded "
+                f"{b['stale_excluded']}"
+            )
+    send = [e for e in sevs if e["event"] == "experiment_end"]
+    scounters = (send[-1].get("metrics") or {}) if send else {}
+    for name, want_total in (
+        ("stream.arrivals", exp_arrivals),
+        ("stream.duplicates", exp_dups),
+        ("stream.retries", exp_retries),
+        ("stream.rejected", exp_rejected),
+    ):
+        if scounters.get(name, 0) != want_total:
+            fail.append(
+                f"stream counters: {name} {scounters.get(name)} != "
+                f"schedule {want_total}"
+            )
+    # surviving (round_robust) must equal fresh + stale folds (stream_round)
+    srobust = {e["round"]: e for e in sevs if e["event"] == "round_robust"}
+    for r, ev in stream_by_round.items():
+        rr = srobust.get(r)
+        if rr is None:
+            fail.append(f"stream events: no round_robust for round {r}")
+        elif rr["surviving"] != ev["fresh"] + ev["stale_folded"]:
+            fail.append(
+                f"stream round {r}: surviving {rr['surviving']} != fresh "
+                f"{ev['fresh']} + stale {ev['stale_folded']}"
+            )
+    acc_stream = streamed["history"][-1]["accuracy"]
+    if abs(acc_stream - acc_chaos) > ACC_TOL:
+        fail.append(
+            f"streaming twin diverged from synchronous: {acc_stream:.4f} "
+            f"vs {acc_chaos:.4f} (tol {ACC_TOL})"
+        )
+    stream_summary = {
+        "events": len(sevs),
+        "arrivals": exp_arrivals,
+        "duplicates": exp_dups,
+        "retries": exp_retries,
+        "rejected": exp_rejected,
+        "counters": {
+            k: v for k, v in scounters.items() if k.startswith("stream.")
+        },
+        "rounds": [
+            {k: stream_by_round[r][k]
+             for k in ("round", "committed", "quorum", "fresh",
+                       "stale_folded", "carried", "duplicates", "retries")}
+            for r in sorted(stream_by_round)
+        ],
+    }
+import jax as _jax_s
+
+for leaf in _jax_s.tree_util.tree_leaves(streamed["params"]):
+    if not np.all(np.isfinite(np.asarray(leaf))):
+        fail.append("streaming twin's final params contain non-finite values")
+        break
+
 artifact = {
     "preset": "chaos-smoke",
     "acc_clean_by_round": [h["accuracy"] for h in clean["history"]],
     "acc_chaos_by_round": [h["accuracy"] for h in chaos["history"]],
     "acc_packed_by_round": [h["accuracy"] for h in packed["history"]],
+    "acc_stream_by_round": [h["accuracy"] for h in streamed["history"]],
     "packing": packed.get("packing"),
+    "stream": streamed.get("stream"),
     "rounds": rounds,
     "acc_tolerance": ACC_TOL,
     # The structured-event cross-check (events.jsonl vs fault schedule).
     "events_check": events_summary,
+    # The streaming twin's cross-check (stream events vs arrival schedule).
+    "stream_check": stream_summary,
     "passed": not fail,
     "failures": fail,
 }
@@ -265,8 +419,10 @@ if fail:
     sys.exit(1)
 print(
     f"chaos smoke OK: clean {acc_clean:.4f} vs chaos {acc_chaos:.4f} vs "
-    f"packed {acc_packed:.4f}, exclusions match the schedule exactly "
-    "(packed twin included), no unflagged NaNs, device-loss retry "
-    "exercised, events.jsonl counters match the fault schedule"
+    f"packed {acc_packed:.4f} vs streamed "
+    f"{streamed['history'][-1]['accuracy']:.4f}, exclusions match the "
+    "schedule exactly (packed + streaming twins included), no unflagged "
+    "NaNs, device-loss retry exercised, events.jsonl counters match the "
+    "fault schedule, streaming rounds all committed at quorum"
 )
 PY

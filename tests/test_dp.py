@@ -86,6 +86,66 @@ def test_sanitize_bounds_influence():
     np.testing.assert_allclose(float(moved), 0.5, rtol=1e-4)
 
 
+def test_noise_floor_never_below_full_participation():
+    # ISSUE 7 acceptance: with shares calibrated to the surviving-cohort
+    # floor k (sigma*C/sqrt(k) each), the EFFECTIVE aggregate noise over
+    # any s >= k survivors is never below the full-participation central
+    # calibration sigma*C.
+    from hefl_tpu.fl import calibration_clients
+
+    dp_full = DpConfig(clip_norm=2.0, noise_multiplier=1.5)
+    K = 8
+    assert calibration_clients(dp_full, K) == K
+    dp_floor = DpConfig(clip_norm=2.0, noise_multiplier=1.5, min_surviving=3)
+    k = calibration_clients(dp_floor, K)
+    assert k == 3
+    # a floor above the client count clamps (cannot under-noise by lying)
+    assert calibration_clients(
+        DpConfig(min_surviving=99), K
+    ) == K
+    central = dp_full.noise_multiplier * dp_full.clip_norm
+    share = central / math.sqrt(k)
+    for s in range(k, K + 1):
+        effective = share * math.sqrt(s)   # s independent Gaussian shares
+        assert effective >= central - 1e-12, (s, effective, central)
+    with pytest.raises(ValueError, match="min_surviving"):
+        DpConfig(min_surviving=-1)
+    # empirical: dp_sanitize's per-client share really is sigma*C/sqrt(k)
+    g = _tree(jax.random.key(6), scale=0.5)
+    keys = jax.random.split(jax.random.key(7), 48)
+    flat = np.concatenate([
+        np.concatenate([
+            (np.asarray(a) - np.asarray(b)).ravel()
+            for a, b in zip(
+                jax.tree_util.tree_leaves(dp_sanitize(kk, g, g, dp_floor, k)[0]),
+                jax.tree_util.tree_leaves(g),
+            )
+        ])
+        for kk in keys
+    ])
+    np.testing.assert_allclose(flat.std(), share, rtol=0.03)
+
+
+def test_epsilon_amplification_by_subsampling():
+    # sample_rate=1 is bit-identical to the historical accountant; q<1
+    # amplifies (smaller epsilon), monotone in q; edge cases hold.
+    e_full = epsilon_spent(8, 1.0, 1e-5)
+    assert epsilon_spent(8, 1.0, 1e-5, sample_rate=1.0) == e_full
+    e_half = epsilon_spent(8, 1.0, 1e-5, sample_rate=0.5)
+    e_tenth = epsilon_spent(8, 1.0, 1e-5, sample_rate=0.1)
+    assert e_tenth < e_half < e_full
+    # the amplified bound is never worse than the always-valid q=1 bound
+    for q in (0.05, 0.3, 0.9):
+        assert epsilon_spent(4, 0.8, 1e-5, sample_rate=q) <= epsilon_spent(
+            4, 0.8, 1e-5
+        )
+    assert epsilon_spent(5, 1.0, 1e-5, sample_rate=0.0) == 0.0
+    assert math.isinf(epsilon_spent(5, 0.0, 1e-5, sample_rate=0.5))
+    assert epsilon_spent(0, 1.0, 1e-5, sample_rate=0.5) == 0.0
+    with pytest.raises(ValueError, match="sample_rate"):
+        epsilon_spent(2, 1.0, 1e-5, sample_rate=1.5)
+
+
 def test_epsilon_accountant_contract():
     # Single Gaussian mechanism at sigma=1, delta=1e-5: the optimized RDP
     # bound lands near 5.3 (alpha* ~ 5.8); pin the band, not the digit.

@@ -233,6 +233,80 @@ def test_event_log_rotates_at_size_cap(tmp_path, monkeypatch):
     assert not (tmp_path / "nocap.jsonl.1").exists()
 
 
+def test_rotation_shipper_hook(tmp_path, monkeypatch):
+    # ISSUE 7 satellite: a pluggable shipper hook fires with the rotated
+    # generation's path on every rotation (while the file still exists),
+    # defaults to no-op, and a raising hook is swallowed — telemetry
+    # shipping must never take down the run.
+    path = tmp_path / "events.jsonl"
+    monkeypatch.setenv("HEFL_EVENTS_MAX_BYTES", "400")
+    shipped: list[str] = []
+
+    log = obs_events.EventLog(str(path))
+
+    def shipper(rotated_path):
+        # the rotated generation must exist at callback time
+        import os
+
+        assert os.path.exists(rotated_path)
+        shipped.append(rotated_path)
+        # a hook may itself emit (e.g. record the shipment): the fresh
+        # generation is already open, so this must not re-enter the
+        # rotation or clobber the rotated_from header
+        log.emit("shipped", path=rotated_path)
+
+    def broken(rotated_path):
+        raise RuntimeError("uploader down")
+
+    obs_events.on_rotation(shipper)
+    obs_events.on_rotation(shipper)   # idempotent registration
+    obs_events.on_rotation(broken)    # must not break emission
+    try:
+        for i in range(30):
+            log.emit("tick", i=i, pad="x" * 32)
+        log.close()
+    finally:
+        assert obs_events.remove_rotation_hook(shipper)
+        assert obs_events.remove_rotation_hook(broken)
+        assert not obs_events.remove_rotation_hook(shipper)  # already gone
+    assert shipped and all(p == str(path) + ".1" for p in shipped)
+    # every rotation fired the hook exactly once (no double-registration)
+    cur = obs_events.read_events(str(path))
+    assert cur[0]["event"] == "log_open" and "rotated_from" in cur[0]
+    # the log itself survived the broken hook: no record lost after it
+    ticks = [e["i"] for e in cur if e["event"] == "tick"]
+    assert ticks[-1] == 29
+
+
+def test_histogram_metric_and_snapshot_delta():
+    # The staleness-histogram leg: cumulative buckets, JSON-ready value,
+    # per-run deltas through snapshot_delta, and type collisions loud.
+    from hefl_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.histogram("stream.staleness_rounds", (0.0, 1.0, 2.0))
+    for v in (0, 0, 1, 3, 10):
+        h.observe(v)
+    val = reg.snapshot()["stream.staleness_rounds"]
+    assert val == {
+        "le_0": 2, "le_1": 3, "le_2": 3, "le_inf": 5, "count": 5, "sum": 14.0
+    }
+    base = reg.snapshot()
+    h.observe(1)
+    delta = reg.snapshot_delta(base)["stream.staleness_rounds"]
+    assert delta["count"] == 1 and delta["le_1"] == 1 and delta["le_0"] == 0
+    # same name, same instance; different type or conflicting bounds, loud
+    assert reg.histogram("stream.staleness_rounds") is h
+    assert reg.histogram("stream.staleness_rounds", (0.0, 1.0, 2.0)) is h
+    with pytest.raises(ValueError, match="bounds"):
+        reg.histogram("stream.staleness_rounds", (0.0, 10.0))
+    with pytest.raises(TypeError):
+        reg.counter("stream.staleness_rounds")
+    reg.counter("y")
+    with pytest.raises(TypeError):
+        reg.histogram("y")
+
+
 def test_global_emit_honors_opt_out(tmp_path, monkeypatch):
     path = tmp_path / "events.jsonl"
     obs_events.configure(str(path))

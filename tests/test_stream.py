@@ -1,0 +1,650 @@
+"""Streaming quorum aggregation tests (ISSUE 7):
+
+  * deterministic cohort sampling + arrival-fault schedules
+  * ONLINE accumulation bitwise-equal (hash-gated) to the batched
+    psum path — unpacked and packed (k in {1, 4}), under exclusions,
+    duplicate deliveries (idempotence), out-of-order permutations,
+    and through the real mesh psum collective
+  * engine lifecycle: quorum commit, per-client deadlines, retries with
+    backoff+jitter, bounded-staleness carry/fold/exclusion, graceful
+    degradation below quorum
+  * driver integration: run_experiment streaming history records
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.ckks.keys import CkksContext, keygen
+from hefl_tpu.ckks.ops import Ciphertext
+from hefl_tpu.ckks.packing import PackedSpec, PackSpec
+from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+from hefl_tpu.fl import (
+    FaultConfig,
+    PackingConfig,
+    StreamConfig,
+    StreamEngine,
+    TrainConfig,
+    aggregate_encrypted,
+    decrypt_average,
+    encrypt_stack,
+    encrypt_stack_packed,
+    quorum_count,
+    sample_cohort,
+    schedule_arrivals,
+)
+from hefl_tpu.fl.faults import (
+    EXCLUDED_STALE,
+    EXCLUDED_TIMEOUT,
+    EXCLUDED_UNREACHABLE,
+    EXCLUDED_UNSAMPLED,
+)
+from hefl_tpu.fl.stream import OnlineAccumulator, ct_hash
+from hefl_tpu.models import SmallCNN
+from hefl_tpu.parallel import make_mesh
+
+CFG = TrainConfig(
+    epochs=1, batch_size=4, num_classes=10, augment=False, val_fraction=0.25
+)
+
+
+def _leaves(t):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(t)]
+
+
+def _setup(num_clients, per_client=8, seed=0):
+    n = num_clients * per_client
+    (x, y), _, _ = make_dataset("mnist", seed=seed, n_train=n, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(n, num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    return model, params, jnp.asarray(xs), jnp.asarray(ys)
+
+
+# --------------------------------------------------------------- schedulers
+
+
+def test_stream_config_validation():
+    with pytest.raises(ValueError, match="quorum"):
+        StreamConfig(quorum=0.0)
+    with pytest.raises(ValueError, match="quorum"):
+        StreamConfig(quorum=1.5)
+    with pytest.raises(ValueError, match="retry_jitter"):
+        StreamConfig(retry_jitter=2.0)
+    with pytest.raises(ValueError, match=">= 0"):
+        StreamConfig(staleness_rounds=-1)
+
+
+def test_cohort_sampling_deterministic_and_exact():
+    s = StreamConfig(cohort_size=3, seed=7)
+    a = sample_cohort(s, 2, 8)
+    b = sample_cohort(s, 2, 8)
+    np.testing.assert_array_equal(a, b)
+    assert len(a) == 3 and len(np.unique(a)) == 3
+    assert np.all(a == np.sort(a))
+    # different rounds differ (overwhelmingly, 8 choose 3 = 56)
+    rounds = [tuple(sample_cohort(s, r, 8)) for r in range(6)]
+    assert len(set(rounds)) > 1
+    # 0 / >= C samples everyone
+    np.testing.assert_array_equal(sample_cohort(StreamConfig(), 0, 4),
+                                  np.arange(4))
+    np.testing.assert_array_equal(
+        sample_cohort(StreamConfig(cohort_size=9), 0, 4), np.arange(4)
+    )
+    assert quorum_count(StreamConfig(quorum=0.5), 5) == 3
+    assert quorum_count(StreamConfig(quorum=1.0), 4) == 4
+    assert quorum_count(StreamConfig(quorum=0.01), 4) == 1
+
+
+def test_arrival_schedule_deterministic_and_disjoint():
+    fc = FaultConfig(
+        seed=3, drop_fraction=0.25, arrival_delay_s=2.0, duplicate_clients=2,
+        transient_fail_clients=1, permanent_fail_clients=1,
+        straggler_fraction=0.25, straggler_delay_s=4.0,
+    )
+    a = schedule_arrivals(fc, 1, 8)
+    b = schedule_arrivals(fc, 1, 8)
+    np.testing.assert_array_equal(a.arrival_s, b.arrival_s)
+    np.testing.assert_array_equal(a.duplicate, b.duplicate)
+    np.testing.assert_array_equal(a.transient, b.transient)
+    np.testing.assert_array_equal(a.permanent, b.permanent)
+    # exact counts, disjoint kinds, never on a dropped client
+    from hefl_tpu.fl import schedule_for_round
+
+    sched = schedule_for_round(fc, 1, 8)
+    assert int(a.duplicate.sum()) == 2
+    assert int(a.transient.sum()) == 1
+    assert int(a.permanent.sum()) == 1
+    assert not np.any(a.duplicate & (a.transient | a.permanent))
+    assert not np.any(a.transient & a.permanent)
+    for kind in (a.duplicate, a.transient, a.permanent):
+        assert not np.any(kind & sched.dropped)
+    # arrivals fold in the straggler delays
+    assert np.all(a.arrival_s >= sched.straggler_s)
+    # stream of round r independent of other rounds having been asked
+    c = schedule_arrivals(fc, 2, 8)
+    assert not np.array_equal(a.arrival_s, c.arrival_s)
+    assert fc.max_scheduled_exclusions(8) == 2 + 0 + 0 + 1 + 1
+    # negative knobs fail loudly at config time, not inside a numpy draw
+    with pytest.raises(ValueError, match="duplicate_clients"):
+        FaultConfig(duplicate_clients=-1)
+    with pytest.raises(ValueError, match="arrival_delay_s"):
+        FaultConfig(arrival_delay_s=-0.5)
+
+
+# ------------------------------------------- streaming vs batched, bitwise
+
+
+def _random_trees(num, key, shape=(64,)):
+    ks = jax.random.split(key, num)
+    mk = lambda k: {  # noqa: E731
+        "w": jax.random.normal(k, shape) * 0.05,
+        "b": {"v": jax.random.normal(jax.random.fold_in(k, 1), (32,)) * 0.05},
+    }
+    return jax.vmap(mk)(ks)
+
+
+def _masked_batched_sum(ctx, cts, keep):
+    """The batched reference: zero excluded rows (fl.secure's masked
+    limb-select) then the lazy chunked sum — the per-device half of the
+    psum path."""
+    sel = jnp.asarray(keep).reshape((-1, 1, 1, 1))
+    masked = Ciphertext(
+        c0=jnp.where(sel, cts.c0, jnp.uint32(0)),
+        c1=jnp.where(sel, cts.c1, jnp.uint32(0)),
+        scale=cts.scale,
+    )
+    return aggregate_encrypted(ctx, masked)
+
+
+@pytest.mark.parametrize("interleave", [0, 1, 4])
+def test_streaming_sum_bitwise_equals_batched(interleave):
+    # The tentpole equality gate: folding uploads ONE AT A TIME into the
+    # running modular sum gives the hash-identical ciphertext to the
+    # batched masked psum path — for the float upload (interleave=0 row)
+    # and the packed-quantized upload at k in {1, 4} — under exclusions,
+    # duplicate deliveries, and EVERY arrival-order permutation tried.
+    num_clients = 6
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(0))
+    trees = _random_trees(num_clients, jax.random.key(1))
+    base = jax.tree_util.tree_map(lambda t: jnp.zeros_like(t[0]), trees)
+    enc_keys = jax.random.split(jax.random.key(2), num_clients)
+    if interleave == 0:
+        cts = encrypt_stack(ctx, pk, trees, enc_keys)
+    else:
+        pcfg = PackingConfig(
+            bits=8, interleave=interleave, clip=0.5, guard_bits=12
+        )
+        spec = PackedSpec.for_params(base, ctx, pcfg, num_clients)
+        assert spec.k == interleave
+        cts, sat = encrypt_stack_packed(ctx, pk, trees, base, enc_keys, spec)
+        assert int(np.sum(np.asarray(sat))) == 0
+    keep = np.array([1, 1, 0, 1, 0, 1])
+    batched = _masked_batched_sum(ctx, cts, keep)
+    want = ct_hash(batched.c0, batched.c1)
+    c0, c1 = np.asarray(cts.c0), np.asarray(cts.c1)
+    rng = np.random.default_rng(0)
+    kept = np.flatnonzero(keep)
+    for trial in range(4):
+        order = rng.permutation(kept)
+        acc = OnlineAccumulator(ctx.ntt.p)
+        for c in order:
+            assert acc.fold((int(c), 0), c0[c], c1[c])
+            if trial % 2:  # duplicate redelivery of every upload
+                assert not acc.fold((int(c), 0), c0[c], c1[c])
+        assert acc.folded == len(kept)
+        s0, s1 = acc.value()
+        assert ct_hash(s0, s1) == want, f"order {order} diverged"
+    # duplicates were counted, not folded
+    assert acc.duplicates == len(kept)
+
+
+def test_streaming_sum_matches_mesh_psum_collective():
+    # Same equality through the REAL collective: per-device lazy sums +
+    # psum_mod over the 8-device mesh (the round program's aggregation
+    # tail) against the one-arrival-at-a-time running sum.
+    from jax.sharding import PartitionSpec as P
+
+    from hefl_tpu.parallel import shard_map
+    from hefl_tpu.parallel.collectives import psum_mod
+
+    num_clients = 8
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(3))
+    trees = _random_trees(num_clients, jax.random.key(4))
+    enc_keys = jax.random.split(jax.random.key(5), num_clients)
+    cts = encrypt_stack(ctx, pk, trees, enc_keys)
+    mesh = make_mesh(num_clients)
+    p = jnp.asarray(ctx.ntt.p)
+
+    def body(c0_blk, c1_blk):
+        local = aggregate_encrypted(
+            ctx, Ciphertext(c0=c0_blk, c1=c1_blk, scale=ctx.scale)
+        )
+        return (
+            psum_mod(local.c0, p, "clients"),
+            psum_mod(local.c1, p, "clients"),
+        )
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P("clients"), P("clients")),
+        out_specs=(P(), P()), check_vma=False,
+    ))
+    ps0, ps1 = fn(cts.c0, cts.c1)
+    acc = OnlineAccumulator(ctx.ntt.p)
+    for c in np.random.default_rng(1).permutation(num_clients):
+        acc.fold((int(c), 0), np.asarray(cts.c0)[c], np.asarray(cts.c1)[c])
+    s0, s1 = acc.value()
+    assert ct_hash(s0, s1) == ct_hash(ps0, ps1)
+
+
+# ------------------------------------------------------------- the engine
+
+
+def test_engine_quorum_commit_timeout_and_dedup():
+    # Quorum 3-of-4 with one straggler past the deadline: the round
+    # commits on the three fast arrivals, the straggler is dropped with
+    # cause "timeout" (tau=0), a duplicate delivery dedups, and the
+    # decode denominator is the folded count.
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(21))
+    spec = PackSpec.for_params(params, ctx.n)
+    eng = StreamEngine(
+        StreamConfig(quorum=0.75, deadline_s=1.0),
+        FaultConfig(seed=3, straggler_fraction=0.25, straggler_delay_s=3.0,
+                    duplicate_clients=1),
+    )
+    ct, mets, ov, smeta = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(22), 0
+    )
+    assert smeta.committed and smeta.quorum == 3 and smeta.fresh == 3
+    assert smeta.duplicates == 1 and smeta.arrivals == 5
+    meta = smeta.meta
+    assert meta.surviving == 3
+    assert meta.excluded["timeout"] == 1 and smeta.carried == 0
+    straggler = [c for c in range(4) if meta.bits[c] & EXCLUDED_TIMEOUT]
+    assert len(straggler) == 1
+    avg = decrypt_average(ctx, sk, ct, None, spec, meta=meta)
+    for leaf in _leaves(avg):
+        assert np.all(np.isfinite(leaf))
+
+
+def test_engine_streaming_equals_batched_over_same_uploads():
+    # The engine's released sum is hash-identical to the batched masked
+    # psum over the SAME uploads it folded — the round-level half of the
+    # tentpole equality gate.
+    from hefl_tpu.fl import produce_uploads
+
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(31))
+    fc = FaultConfig(seed=3, straggler_fraction=0.25, straggler_delay_s=3.0,
+                     nan_clients=1)
+    eng = StreamEngine(StreamConfig(quorum=0.5, deadline_s=1.0), fc)
+    key = jax.random.key(32)
+    ct, mets, ov, smeta = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, key, 0
+    )
+    # reproduce the uploads with the identical key/mask derivation
+    cohort = sample_cohort(eng.stream, 0, num_clients)
+    from hefl_tpu.fl import schedule_for_round
+
+    sched = schedule_for_round(fc, 0, num_clients)
+    in_cohort = np.zeros(num_clients, bool)
+    in_cohort[cohort] = True
+    part = (in_cohort & ~sched.dropped).astype(np.int32)
+    pois = np.where(in_cohort, sched.poison, 0).astype(np.int32)
+    cts, _, _, bits = produce_uploads(
+        model, CFG, mesh, ctx, pk, params, xs, ys, key,
+        participation=part, poison=pois,
+    )
+    keep = np.asarray(smeta.meta.participation)
+    batched = _masked_batched_sum(ctx, cts, keep)
+    assert ct_hash(ct.c0, ct.c1) == ct_hash(batched.c0, batched.c1)
+    # and the NaN-poisoned arrival was rejected, not folded
+    assert smeta.rejected == int(np.sum(sched.poison > 0))
+
+
+def test_engine_stale_carry_fold_and_budget_exclusion():
+    # tau=1: an upload that misses round r's commit carries and FOLDS into
+    # round r+1 (surviving = fresh + stale there); with tau=0 the same
+    # miss is dropped. A carried upload that misses AGAIN is excluded as
+    # "stale" once past the budget.
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(41))
+    spec = PackSpec.for_params(params, ctx.n)
+    fc = FaultConfig(seed=3, straggler_fraction=0.25, straggler_delay_s=3.0)
+    key = jax.random.key(42)
+
+    # quorum commits instantly on the 3 fast clients; the straggler (t~3s,
+    # deadline 1s) carries under tau=1 and lands next round at t-commit.
+    eng = StreamEngine(
+        StreamConfig(quorum=0.75, deadline_s=1.0, staleness_rounds=1), fc
+    )
+    _, _, _, s0 = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, key, 0
+    )
+    assert s0.committed and s0.carried == 1 and s0.meta.excluded["timeout"] == 1
+    assert len(eng._pending) == 1
+    # Round 1 must stay open past the stale landing for the fold to be
+    # deterministic: stretch round 1's straggler far beyond round 0's so
+    # the full quorum (1.0, no deadline) waits for it.
+    eng.stream = dataclasses.replace(eng.stream, quorum=1.0, deadline_s=0.0)
+    eng.faults = dataclasses.replace(fc, straggler_delay_s=50.0)
+    ct1, _, _, s1 = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(43), 1
+    )
+    assert s1.stale_folded == 1 and s1.stale_excluded == 0
+    assert s1.meta.surviving == s1.fresh + 1
+    avg = decrypt_average(ctx, sk, ct1, None, spec, meta=s1.meta)
+    for leaf in _leaves(avg):
+        assert np.all(np.isfinite(leaf))
+
+    # tau=0: the identical miss is dropped, nothing pends
+    eng0 = StreamEngine(
+        StreamConfig(quorum=0.75, deadline_s=1.0, staleness_rounds=0), fc
+    )
+    _, _, _, d0 = eng0.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, key, 0
+    )
+    assert d0.carried == 0 and len(eng0._pending) == 0
+    assert d0.meta.excluded["timeout"] == 1
+
+    # past the budget: carry once, then miss the NEXT commit too ->
+    # excluded "stale". Round 1's quorum (3 fast arrivals at t=0) commits
+    # at t=0.0 while the carried upload lands at round 0's straggler
+    # offset (> 0), so lateness 2 > tau=1, deterministically.
+    eng2 = StreamEngine(
+        StreamConfig(quorum=0.75, deadline_s=1.0, staleness_rounds=1), fc
+    )
+    _, _, _, r0 = eng2.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, key, 0
+    )
+    assert r0.carried == 1
+    _, _, _, r1 = eng2.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(44), 1
+    )
+    assert r1.stale_excluded == 1 and r1.stale_folded == 0
+    stale = [c for c in range(4) if r1.meta.bits[c] & EXCLUDED_STALE]
+    assert len(stale) == 1
+
+
+def test_engine_retries_recover_transient_and_mark_unreachable():
+    # A transiently-lost upload is recovered by one retry (backoff +
+    # jitter, deterministic) and still folds; a permanently-failed client
+    # exhausts retries and is excluded as "unreachable".
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(51))
+    fc = FaultConfig(seed=5, transient_fail_clients=1,
+                     permanent_fail_clients=1)
+    # quorum 3-of-4: the two clean arrivals are not enough, so the commit
+    # WAITS for the retried transient delivery (which folds even past the
+    # deadline — the server solicited it).
+    eng = StreamEngine(
+        StreamConfig(quorum=0.75, deadline_s=1.0, max_retries=2,
+                     retry_backoff_s=0.2), fc,
+    )
+    _, _, _, smeta = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(52), 0
+    )
+    # 2 clean + 1 retried transient fold; the permanent one never arrives
+    assert smeta.fresh == 3 and smeta.committed
+    assert smeta.commit_s > 1.0   # the commit waited for the retry
+    assert smeta.unreachable == 1
+    assert smeta.retries == 1 + 2   # transient recovered + permanent budget
+    unreachable = [
+        c for c in range(4) if smeta.meta.bits[c] & EXCLUDED_UNREACHABLE
+    ]
+    assert len(unreachable) == 1
+    # no retries allowed: the transient loss becomes unreachable too
+    eng0 = StreamEngine(
+        StreamConfig(quorum=0.5, deadline_s=1.0, max_retries=0), fc
+    )
+    _, _, _, s0 = eng0.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(53), 0
+    )
+    assert s0.unreachable == 2 and s0.fresh == 2
+
+
+def test_engine_below_quorum_degrades_gracefully():
+    # Permanent failures push fresh arrivals below quorum: the round does
+    # NOT commit, surviving=0 tells the driver to carry the model forward.
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(61))
+    fc = FaultConfig(seed=7, permanent_fail_clients=2)
+    eng = StreamEngine(StreamConfig(quorum=0.75, deadline_s=1.0), fc)
+    ct, _, _, smeta = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(62), 0
+    )
+    assert not smeta.committed and smeta.degraded_reason == "quorum"
+    assert smeta.fresh == 2 and smeta.quorum == 3
+    assert smeta.meta.surviving == 0
+    assert np.all(np.asarray(smeta.meta.participation) == 0)
+    # the returned ciphertext is an encryption of zero (all-zero residues)
+    assert not np.any(np.asarray(ct.c0)) and not np.any(np.asarray(ct.c1))
+    # the folded-but-unreleased fresh uploads got timeout attribution
+    # (tau=0 here, so they cannot carry)
+    timed_out = [
+        c for c in range(4) if smeta.meta.bits[c] & EXCLUDED_TIMEOUT
+    ]
+    assert len(timed_out) == 2
+
+
+def test_engine_dp_floor_degrades_instead_of_underreleasing():
+    # A committed-at-quorum round whose released sum would hold FEWER
+    # uploads than the dp noise-calibration floor must degrade (model
+    # carried forward), never release an under-noised aggregate — the
+    # streaming analog of fl.secure's loud ValueError.
+    from hefl_tpu.fl import DpConfig
+
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(81))
+    dp = DpConfig(clip_norm=0.5, noise_multiplier=0.2, min_surviving=4)
+    # quorum 2-of-4: the round commits on the first two arrivals, the
+    # other two land post-commit — folded=2 < floor=4.
+    eng = StreamEngine(StreamConfig(quorum=0.5), None)
+    ct, _, _, smeta = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(82), 0,
+        dp=dp,
+    )
+    assert not smeta.committed and smeta.degraded_reason == "dp_floor"
+    assert smeta.fresh == 2 and smeta.meta.surviving == 0
+    assert not np.any(np.asarray(ct.c0))
+    # full participation reaches the floor and releases normally
+    eng2 = StreamEngine(StreamConfig(quorum=1.0), None)
+    _, _, _, s2 = eng2.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(83), 0,
+        dp=dp,
+    )
+    assert s2.committed and s2.meta.surviving == 4
+
+
+def test_engine_packed_headroom_never_overflows_and_salvages():
+    # Packed carry-free headroom is sized for `clients` summands: a stale
+    # fold plus a full cohort must NOT overflow it. The blocked fresh
+    # upload takes the missed path; a degraded round re-carries its
+    # folded uploads within the staleness budget instead of destroying
+    # them (and stale-excludes what cannot carry).
+    num_clients = 2
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(91))
+    pcfg = PackingConfig(bits=8, interleave=1, clip=0.5)
+    pspec = PackedSpec.for_params(params, ctx, pcfg, num_clients)
+    fc = FaultConfig(seed=3, straggler_fraction=0.5, straggler_delay_s=3.0)
+    eng = StreamEngine(
+        StreamConfig(quorum=0.5, deadline_s=1.0, staleness_rounds=1), fc
+    )
+    # round 0: 1 fast fold commits (quorum 1), the straggler carries
+    _, _, _, s0 = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(92), 0,
+        packing=pspec,
+    )
+    assert s0.committed and s0.carried == 1
+    # round 1 at full quorum, no deadline: the stale upload folds, one
+    # fresh folds (headroom 2/2 full), the second fresh is BLOCKED by
+    # headroom -> quorum unreachable -> degrade; salvage re-carries the
+    # folded fresh (lateness 1 <= tau) and stale-excludes the stale one
+    # (lateness 2 > tau).
+    eng.stream = dataclasses.replace(eng.stream, quorum=1.0, deadline_s=0.0)
+    eng.faults = dataclasses.replace(fc, straggler_delay_s=50.0)
+    ct1, _, _, s1 = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(93), 1,
+        packing=pspec,
+    )
+    assert not s1.committed
+    assert s1.stale_folded == 1 and s1.fresh == 1
+    assert s1.stale_excluded == 1
+    # carried: the blocked/late fresh straggler + the salvaged folded fresh
+    assert s1.carried == 2
+    assert not np.any(np.asarray(ct1.c0))
+
+
+def test_engine_cohort_sampling_attributes_unsampled():
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(71))
+    eng = StreamEngine(StreamConfig(cohort_size=2, seed=9), None)
+    _, _, _, smeta = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(72), 0
+    )
+    assert len(smeta.cohort) == 2
+    assert smeta.meta.surviving == 2
+    assert smeta.meta.excluded["unsampled"] == 2
+    for c in range(num_clients):
+        if c in smeta.cohort:
+            assert smeta.meta.bits[c] == 0
+        else:
+            assert smeta.meta.bits[c] == EXCLUDED_UNSAMPLED
+
+
+def test_engine_dp_rejects_staleness_budget():
+    # A carried upload would give one client 2x the accounted per-round
+    # sensitivity (its stale + fresh uploads in one release) and void the
+    # cohort-subsampling amplification: dp + staleness is rejected loudly
+    # at both the engine and the driver.
+    from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
+    from hefl_tpu.fl import DpConfig
+
+    num_clients = 2
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(95))
+    eng = StreamEngine(StreamConfig(staleness_rounds=1), None)
+    with pytest.raises(ValueError, match="staleness"):
+        eng.run_round(
+            model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(96), 0,
+            dp=DpConfig(noise_multiplier=0.1),
+        )
+    train = TrainConfig(epochs=1, batch_size=8, num_classes=10, augment=False,
+                        val_fraction=0.25)
+    with pytest.raises(ValueError, match="staleness"):
+        run_experiment(
+            ExperimentConfig(
+                model="smallcnn", dataset="mnist", num_clients=2, rounds=1,
+                train=train, he=HEConfig(n=256), n_train=32, n_test=16,
+                dp=DpConfig(noise_multiplier=0.1),
+                stream=StreamConfig(staleness_rounds=1),
+            ),
+            verbose=False,
+        )
+
+
+def test_engine_state_survives_a_failed_round(monkeypatch):
+    # Transactional cross-round state: a round that dies mid-execution
+    # (the driver's retry envelope case) must leave the carried uploads
+    # and the dedup window untouched, so the retry replays identically.
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(97))
+    fc = FaultConfig(seed=3, straggler_fraction=0.25, straggler_delay_s=3.0)
+    eng = StreamEngine(
+        StreamConfig(quorum=0.75, deadline_s=1.0, staleness_rounds=1), fc
+    )
+    key = jax.random.key(98)
+    _, _, _, s0 = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, key, 0
+    )
+    assert s0.carried == 1 and len(eng._pending) == 1
+    seen_before = set(eng._seen)
+    pend_before = list(eng._pending)
+
+    import hefl_tpu.fl.stream as stream_mod
+
+    real = stream_mod.produce_uploads
+
+    def boom(*a, **kw):
+        raise RuntimeError("device fell over mid-round")
+
+    monkeypatch.setattr(stream_mod, "produce_uploads", boom)
+    with pytest.raises(RuntimeError, match="mid-round"):
+        eng.run_round(
+            model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(99), 1
+        )
+    # nothing consumed by the failed attempt
+    assert eng._pending == pend_before and eng._seen == seen_before
+    monkeypatch.setattr(stream_mod, "produce_uploads", real)
+    eng.faults = dataclasses.replace(fc, straggler_delay_s=50.0)
+    eng.stream = dataclasses.replace(eng.stream, quorum=1.0, deadline_s=0.0)
+    _, _, _, s1 = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(99), 1
+    )
+    assert s1.stale_folded == 1   # the carried upload survived the failure
+
+
+def test_experiment_streaming_history_and_finite(tmp_path):
+    # Driver-level: streaming + arrival faults through run_experiment;
+    # history carries stream + robust records, params stay finite, and
+    # the round_end/robust events agree with the engine.
+    from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
+
+    train = TrainConfig(epochs=1, batch_size=8, num_classes=10, augment=False,
+                        val_fraction=0.25)
+    cfg = ExperimentConfig(
+        model="smallcnn", dataset="mnist", num_clients=4, rounds=2,
+        train=train, he=HEConfig(n=256), n_train=64, n_test=32, seed=3,
+        faults=FaultConfig(seed=1, drop_fraction=0.25, nan_clients=1,
+                           duplicate_clients=1),
+        stream=StreamConfig(quorum=0.5, deadline_s=2.0, staleness_rounds=1),
+    )
+    out = run_experiment(cfg, verbose=False)
+    assert len(out["history"]) == 2
+    for rec in out["history"]:
+        st = rec["stream"]
+        assert st["committed"] and st["fresh"] >= st["quorum"]
+        assert rec["robust"]["surviving"] == st["fresh"] + st["stale_folded"]
+    assert out["stream"]["quorum"] == 0.5
+    for leaf in _leaves(out["params"]):
+        assert np.all(np.isfinite(leaf))
+    # plaintext + stream is rejected loudly
+    with pytest.raises(ValueError, match="encrypted"):
+        run_experiment(
+            dataclasses.replace(cfg, encrypted=False), verbose=False
+        )
