@@ -1,0 +1,5 @@
+"""`python -m hefl_tpu.analysis` == the `hefl-lint` console entry."""
+
+from hefl_tpu.analysis.cli import main
+
+raise SystemExit(main())

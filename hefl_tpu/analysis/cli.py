@@ -1,0 +1,197 @@
+"""`hefl-lint`: the whole-tree static-analysis gate as one command.
+
+    hefl-lint                  # full gate (exit 1 on any violation)
+    hefl-lint --fast           # skip the compile-heavy coverage stages
+    hefl-lint --json           # machine-readable findings
+    hefl-lint --fixture F.py   # run ONE rule against a violation fixture
+                               # (exit 1 when the seeded violation fires —
+                               # the fixture CONTRACT is that it does)
+
+Stages of the full gate, each a CI failure on findings:
+
+  1. source sweep — AST-level `jnp.remainder`/`lax.rem`/`jnp.mod` scan
+  2. exact-integer regions — the modules' declared probes, no rem/div,
+     no float contamination
+  3. range certification — aggregation no-wrap at the default ring's
+     prime size, plus the full supported PackingConfig grid (b × C at
+     auto-k; every point certified by interval analysis, with the
+     formula-vs-analysis divergence tripwire armed inside
+     `max_interleave`)
+  4. hot-path lint — the real round programs (both fusion backends,
+     secure included): integer rem/div, f64, host callbacks
+  5. donation — declared `donate_argnums` sites actually alias
+  6. scope coverage — every leaf compute op phase-attributed (jaxpr +
+     compiled HLO, both fusion backends, secure included)
+
+Fixture protocol (tests/fixtures/lint/*.py): the module defines `RULE`
+(one of forbidden-primitive | float-contamination | missing-scope |
+broken-donation) and `build()` returning `(fn, args)` — jitted for
+missing-scope, `(jitted, args)` with donation declared for
+broken-donation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+
+# The supported PackingConfig grid the tree gate certifies end to end:
+# every quantizer width the config validator admits, across client counts
+# up to the million-client service's per-axis fan-in.
+GRID_BITS = (2, 4, 8, 16)
+GRID_CLIENTS = (2, 8, 32, 256, 1024)
+GRID_GUARD = 16
+
+
+def _default_ring() -> tuple[int, int]:
+    """(modulus q, largest RNS prime) of the default HEConfig ring."""
+    import numpy as np
+
+    from hefl_tpu.experiment import HEConfig
+
+    ctx = HEConfig().build()
+    return int(ctx.modulus), int(np.asarray(ctx.ntt.p).max())
+
+
+def run_fixture(path: str) -> list:
+    """Run one violation fixture's declared rule; -> findings."""
+    from hefl_tpu.analysis import coverage, lint
+
+    spec = importlib.util.spec_from_file_location(
+        os.path.splitext(os.path.basename(path))[0], path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rule = mod.RULE
+    fn, args = mod.build()
+    name = f"fixture:{os.path.basename(path)}"
+    if rule in ("forbidden-primitive", "float-contamination", "f64",
+                "host-callback"):
+        found = lint.lint_fn(fn, tuple(args), name, exact_int=True)
+    elif rule == "missing-scope":
+        found = coverage.check_fn_coverage(fn, tuple(args), name)
+    elif rule == "broken-donation":
+        found = lint.check_donation(fn, tuple(args), name)
+    else:
+        raise SystemExit(f"{path}: unknown fixture RULE {rule!r}")
+    # The fixture contract: its seeded violation must fire under ITS rule.
+    return [f for f in found if f.rule == rule] or found
+
+
+def run_tree_gate(fast: bool = False, progress=print) -> list:
+    """The whole-tree gate; -> findings (empty on a healthy tree)."""
+    from hefl_tpu.analysis import coverage, lint, ranges
+
+    findings: list = []
+
+    def stage(label, fn):
+        t0 = time.time()
+        got = fn()
+        findings.extend(got)
+        progress(
+            f"  {label}: {len(got)} finding(s) [{time.time() - t0:.1f}s]"
+        )
+
+    stage("source sweep", lint.source_sweep)
+    stage("exact-int regions", lint.lint_exact_regions)
+
+    def certs():
+        got = []
+        q, max_prime = _default_ring()
+        agg = ranges.certify_aggregation(max_prime)
+        got.extend(agg.findings)
+        from hefl_tpu.ckks.quantize import max_interleave
+
+        points = 0
+        for bits in GRID_BITS:
+            for clients in GRID_CLIENTS:
+                try:
+                    k = max_interleave(q, bits, clients, GRID_GUARD)
+                except ValueError:
+                    continue  # no headroom at all: correctly unsupported
+                cert = ranges.certify_packing(
+                    q, bits, k, clients, GRID_GUARD
+                )
+                got.extend(cert.findings)
+                points += 1
+        progress(f"    packing grid: {points} (b, C) points certified")
+        return got
+
+    stage("range certification", certs)
+    stage(
+        "hot-path lint [vmap+secure]",
+        lambda: lint.lint_round_programs(fusion="vmap", secure=True),
+    )
+    stage(
+        "hot-path lint [fused]",
+        lambda: lint.lint_round_programs(fusion="fused", secure=False),
+    )
+    stage("donation", lint.check_tree_donations)
+    if not fast:
+        stage(
+            "scope coverage [vmap]",
+            lambda: coverage.check_round_coverage(fusion="vmap"),
+        )
+        stage(
+            "scope coverage [fused]",
+            lambda: coverage.check_round_coverage(fusion="fused"),
+        )
+        stage(
+            "scope coverage [secure]",
+            lambda: coverage.check_round_coverage(fusion="vmap", secure=True),
+        )
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="hefl-lint",
+        description="static-analysis gate: jaxpr lint, range proofs, "
+                    "scope coverage",
+    )
+    p.add_argument("--fixture", default=None, metavar="FILE.py",
+                   help="run one violation fixture's declared rule "
+                        "instead of the tree gate")
+    p.add_argument("--fast", action="store_true",
+                   help="skip the compile-heavy coverage stages")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as JSON lines")
+    args = p.parse_args(argv)
+
+    # The gate must see the library exactly as CI does: deterministic
+    # backend, no event-log side effects from probe experiments.
+    os.environ.setdefault("HEFL_EVENTS", "0")
+    os.environ.setdefault("HEFL_AUTOSELECT_CACHE", "0")
+
+    quiet = args.json
+    progress = (lambda *_: None) if quiet else print
+    if args.fixture:
+        findings = run_fixture(args.fixture)
+    else:
+        progress("hefl-lint: whole-tree static-analysis gate")
+        findings = run_tree_gate(fast=args.fast, progress=progress)
+
+    if args.json:
+        for f in findings:
+            print(json.dumps(
+                {"rule": f.rule, "where": f.where, "message": f.message}
+            ))
+    else:
+        for f in findings:
+            print(f"  FAIL {f}")
+    if findings:
+        if not quiet:
+            print(f"hefl-lint: {len(findings)} violation(s)")
+        return 1
+    if not quiet:
+        print("hefl-lint: clean")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

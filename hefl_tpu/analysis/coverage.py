@@ -1,0 +1,169 @@
+"""Named-scope coverage: every leaf compute op attributed to a phase.
+
+PR 5's trace-native observability rests on one structural guarantee: every
+LEAF compute region of the round program carries a canonical
+`obs/scopes.py` named scope, so profiler device events join back to
+phases. tests/test_obs.py asserts a handful of scopes *exist*; this module
+closes the guarantee structurally, at two layers:
+
+  * **jaxpr layer** (strict) — every `dot_general` / `conv_general_dilated`
+    eqn in the traced round program must carry a `hefl.*` component in its
+    `source_info.name_stack`. This is the faithful record of what the
+    SOURCE wrapped: a refactor that hoists a conv out of its
+    `jax.named_scope` block fails here, deterministically, on both
+    cross-client fusion backends.
+  * **compiled-HLO layer** — every `dot`/`convolution` instruction that
+    still carries `op_name` provenance must resolve to a `hefl.*` scope
+    (`obs.scopes.scope_of`). Instructions XLA synthesizes during
+    optimization with NO metadata are exempt — they are exactly the
+    `unattributed` remainder `obs.trace` already reports per trace, and no
+    source-level rule can prevent a compiler rewrite from dropping
+    provenance.
+
+The GEMM/conv stream is the rule's scope on purpose: that is where device
+time lives. Reshapes, rng, and collective glue are free or counted as
+`unattributed`, and requiring scopes on them would force annotating
+infrastructure code that has no phase.
+"""
+
+from __future__ import annotations
+
+import re
+
+from hefl_tpu.analysis.lint import LintFinding
+
+# jaxpr-level leaf compute primitives (pre-lowering names).
+LEAF_PRIMS = ("dot_general", "conv_general_dilated")
+# compiled-HLO leaf opcodes.
+LEAF_OPCODES = ("convolution", "dot")
+
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*[^=\s]+\s+(" +
+    "|".join(LEAF_OPCODES) + r")\(([^\n]*)$",
+    re.M,
+)
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def jaxpr_scope_findings(closed, where: str) -> list[LintFinding]:
+    """missing-scope findings for leaf compute eqns whose trace-time name
+    stack carries no hefl.* scope (the strict, source-structural rule).
+
+    Name stacks inside call-like sub-jaxprs (custom_vjp_call, pjit, scan,
+    shard_map, ...) are RELATIVE to the call eqn, so the walk threads the
+    inherited prefix down — an einsum inside a custom-VJP body whose CALL
+    sits under `hefl.sgd_core` is correctly attributed."""
+    from jax.extend import core as jex_core
+
+    from hefl_tpu.analysis.lint import _as_jaxprs
+    from hefl_tpu.obs import scopes as obs_scopes
+
+    findings: list[LintFinding] = []
+
+    def walk(jaxpr, prefix: str):
+        for eqn in jaxpr.eqns:
+            stack = str(getattr(eqn.source_info, "name_stack", ""))
+            full = f"{prefix}/{stack}"
+            if (
+                eqn.primitive.name in LEAF_PRIMS
+                and obs_scopes.scope_of(full) is None
+            ):
+                shape = getattr(eqn.outvars[0].aval, "shape", ())
+                findings.append(LintFinding(
+                    rule="missing-scope", where=where,
+                    message=(
+                        f"`{eqn.primitive.name}` -> {tuple(shape)} traced "
+                        f"with name stack {full.strip('/')!r}: no hefl.* "
+                        "phase scope — its device time would leak into "
+                        "the unattributed bucket"
+                    ),
+                ))
+            for v in eqn.params.values():
+                for sub in _as_jaxprs(v, jex_core):
+                    walk(sub, full)
+
+    walk(closed.jaxpr, "")
+    return findings
+
+
+def leaf_scope_findings(hlo_text: str, where: str) -> list[LintFinding]:
+    """missing-scope findings for one compiled module's HLO text: leaf
+    instructions that KEPT their op_name provenance but resolve to no
+    hefl.* scope. Metadata-less (XLA-synthesized) instructions are the
+    trace parser's documented `unattributed` bucket, not a violation."""
+    from hefl_tpu.obs import scopes as obs_scopes
+
+    findings: list[LintFinding] = []
+    for m in _INSTR_RE.finditer(hlo_text):
+        name, opcode, rest = m.groups()
+        op_name_m = _OPNAME_RE.search(rest)
+        if op_name_m is None:
+            continue
+        op_name = op_name_m.group(1)
+        if obs_scopes.scope_of(op_name) is not None:
+            continue
+        findings.append(LintFinding(
+            rule="missing-scope", where=where,
+            message=(
+                f"leaf compute `{opcode}` instruction %{name} carries "
+                f"provenance op_name={op_name!r} but no hefl.* scope — "
+                "its device time would leak into the unattributed bucket"
+            ),
+        ))
+    return findings
+
+
+def check_fn_coverage(fn, args: tuple, where: str) -> list[LintFinding]:
+    """Both layers for one function: the strict jaxpr rule plus the
+    compiled-HLO rule (metadata-preserving compile — a persistent-cache
+    deserialization answers as_text() without op_name)."""
+    import jax
+
+    from hefl_tpu.obs.trace import metadata_preserving_compile
+
+    findings = jaxpr_scope_findings(jax.make_jaxpr(fn)(*args), where)
+    with metadata_preserving_compile():
+        txt = fn.lower(*args).compile().as_text()
+    findings.extend(leaf_scope_findings(txt, where))
+    return findings
+
+
+def check_round_coverage(
+    *, fusion: str = "vmap", secure: bool = False
+) -> list[LintFinding]:
+    """The whole-tree gate: the real round program at tiny geometry."""
+    from hefl_tpu.analysis.lint import _tiny_round_inputs
+    from hefl_tpu.fl import TrainConfig
+    from hefl_tpu.fl.fedavg import _build_round_fn
+
+    module, params, mesh, gp, xs, ys, keys = _tiny_round_inputs()
+    cfg = TrainConfig(
+        epochs=1, batch_size=4, num_classes=10, val_fraction=0.25,
+        client_fusion=fusion,
+    )
+    if secure:
+        import jax
+
+        from hefl_tpu.ckks.keys import CkksContext, keygen
+        from hefl_tpu.fl.secure import _build_secure_round_fn
+
+        ctx = CkksContext.create(n=256)
+        _, pk = keygen(ctx, jax.random.key(2))
+        fn = _build_secure_round_fn(module, cfg, mesh, ctx, False)
+        return check_fn_coverage(
+            fn, (gp, pk, xs, ys, keys, keys), f"fl.secure.round[{fusion}]"
+        )
+    fn = _build_round_fn(module, cfg, mesh)
+    return check_fn_coverage(
+        fn, (gp, xs, ys, keys), f"fl.fedavg.round[{fusion}]"
+    )
+
+
+__all__ = [
+    "LEAF_PRIMS",
+    "LEAF_OPCODES",
+    "jaxpr_scope_findings",
+    "leaf_scope_findings",
+    "check_fn_coverage",
+    "check_round_coverage",
+]

@@ -1,0 +1,673 @@
+"""Integer-range abstract interpretation over jaxprs.
+
+The packed-quantized CKKS pipeline rests on arithmetic invariants — the
+carry-free headroom `field_bits = b + ceil(log2 C)`, the guard band that
+absorbs decrypt noise, the 2**62 exact-integer ceiling of the hi/lo split
+encode, the q/2 wall of the centered decode, the uint32 lazy-sum bound of
+`psum_mod` — that PR 6/7 enforce with *sampled* runtime tests. A config
+outside the tested grid, or a refactor that widens a shift, ships silently.
+
+This module proves those invariants statically, for ALL inputs, by interval
+abstract interpretation of the real jaxprs:
+
+  * :class:`Interval` — the abstract domain: one [lo, hi] pair per value,
+    exact Python ints for integer dtypes (no 64-bit ceiling in the
+    *analysis*, which is how an op that would overflow int64 gets caught
+    rather than wrapped), floats with ±inf for float dtypes.
+  * :func:`eval_jaxpr_ranges` — the interpreter: propagates intervals
+    through add/mul/shift/and/or/select/reduce/convert/psum/... including
+    sub-jaxprs (pjit, shard_map, custom_{j,v}jp), recording a
+    :class:`RangeFinding` at the exact eqn whose INTEGER output interval
+    escapes the declared ceiling or its dtype — the "offending op".
+  * :func:`certify_packing` — the headroom proof: traces
+    `ckks.quantize.packing_sum_probe` (the shaped jaxpr of the plaintext
+    integer math that encode_packed → encrypt → psum_mod /
+    OnlineAccumulator fold → decode_int_center implements homomorphically)
+    and checks, for one (modulus, bits, k, clients, guard) point:
+      - every field's C-client sum stays below 2**field_bits (carry-free),
+      - the accumulated decrypt noise stays inside the guard band,
+      - the packed client-sum stays below min(q/2, 2**62) at EVERY op.
+    The certificate either proves the config safe for all inputs or names
+    the overflowing op. `ckks.quantize.max_interleave` cross-checks its
+    closed-form k against this proof on every call (loud error on
+    divergence), and `PackedSpec.for_params` rejects uncertified configs
+    at build time.
+
+The interpreter is deliberately conservative: an unsupported primitive
+yields an unbounded interval (sound — it can only cause false alarms
+downstream, never a false proof), and the wrapping Montgomery cores
+(`ckks.modular`) are NOT range-probed — their uint32 wraparound is
+intentional and bitwise-tested; the lint layer (analysis.lint) covers them
+with the no-divide/no-float rules instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any
+
+import numpy as np
+
+_POS_INF = float("inf")
+_NEG_INF = float("-inf")
+
+
+@dataclasses.dataclass(frozen=True)
+class Interval:
+    """Closed interval [lo, hi]; ints stay exact Python ints (unbounded)."""
+
+    lo: Any
+    hi: Any
+
+    def __post_init__(self):
+        if self.lo > self.hi:  # pragma: no cover - internal invariant
+            raise ValueError(f"empty interval [{self.lo}, {self.hi}]")
+
+    def union(self, other: "Interval") -> "Interval":
+        return Interval(min(self.lo, other.lo), max(self.hi, other.hi))
+
+    def __repr__(self):
+        return f"[{_fmt(self.lo)}, {_fmt(self.hi)}]"
+
+
+TOP = Interval(_NEG_INF, _POS_INF)
+BOOL = Interval(0, 1)
+
+
+def _fmt(v) -> str:
+    """Log-friendly bound: huge exact ints print as 2**k, not 40 digits."""
+    if isinstance(v, int) and abs(v) >= 1 << 40:
+        sign = "-" if v < 0 else ""
+        a = abs(v)
+        if a & (a - 1) == 0:
+            return f"{sign}2**{a.bit_length() - 1}"
+        if (a + 1) & a == 0:
+            return f"{sign}(2**{a.bit_length()}-1)"
+        return f"{sign}~2**{a.bit_length() - 1}"
+    return str(v)
+
+
+@dataclasses.dataclass(frozen=True)
+class RangeFinding:
+    """One op whose statically-derived range violates a declared bound."""
+
+    kind: str        # "ceiling" | "dtype-overflow" | "output-bound"
+    op: str          # primitive name — the offending op
+    eqn_index: int   # position in the (flattened) eqn walk
+    interval: Interval
+    bound: Interval
+    message: str
+
+    def __str__(self):
+        return self.message
+
+
+@dataclasses.dataclass
+class RangeResult:
+    out_intervals: list
+    findings: list
+    notes: list      # non-fatal analysis caveats (unknown primitives, ...)
+
+
+def _is_int_dtype(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.integer)
+
+
+def _dtype_interval(dtype) -> Interval:
+    info = np.iinfo(np.dtype(dtype))
+    return Interval(int(info.min), int(info.max))
+
+
+def _mul_bound(a, b):
+    if a == 0 or b == 0:
+        return 0
+    return a * b
+
+
+def _imul(a: Interval, b: Interval) -> Interval:
+    cands = [
+        _mul_bound(a.lo, b.lo), _mul_bound(a.lo, b.hi),
+        _mul_bound(a.hi, b.lo), _mul_bound(a.hi, b.hi),
+    ]
+    return Interval(min(cands), max(cands))
+
+
+def _pow2_shift(x: Interval, s: Interval) -> Interval:
+    """x << s as x * 2**s (mathematical, never wrapping)."""
+    s_lo = max(int(s.lo), 0) if s.lo != _NEG_INF else 0
+    if s.hi == _POS_INF:
+        return TOP
+    return _imul(x, Interval(1 << s_lo, 1 << int(s.hi)))
+
+
+def _floordiv_pow2(x: Interval, s: Interval) -> Interval:
+    s_lo = max(int(s.lo), 0) if s.lo != _NEG_INF else 0
+    s_hi = int(s.hi) if s.hi != _POS_INF else s_lo
+    cands = []
+    for v in (x.lo, x.hi):
+        for sh in (s_lo, s_hi):
+            if v in (_NEG_INF, _POS_INF):
+                cands.append(v)
+            else:
+                cands.append(math.floor(v / (1 << sh)))
+    return Interval(min(cands), max(cands))
+
+
+def _bitwise(a: Interval, b: Interval, dtype) -> Interval:
+    """and/or/xor bound for non-negative operands; dtype range otherwise."""
+    if a.lo >= 0 and b.lo >= 0 and a.hi != _POS_INF and b.hi != _POS_INF:
+        bits = max(int(a.hi).bit_length(), int(b.hi).bit_length())
+        return Interval(0, (1 << bits) - 1)
+    return _dtype_interval(dtype) if _is_int_dtype(dtype) else TOP
+
+
+def _reduced_size(in_aval, out_aval) -> int:
+    n_in = int(np.prod(in_aval.shape)) if in_aval.shape else 1
+    n_out = int(np.prod(out_aval.shape)) if out_aval.shape else 1
+    return max(n_in // max(n_out, 1), 1)
+
+
+def _array_interval(x) -> Interval:
+    arr = np.asarray(x)
+    if arr.size == 0:
+        return Interval(0, 0)
+    if _is_int_dtype(arr.dtype):
+        return Interval(int(arr.min()), int(arr.max()))
+    if arr.dtype == np.bool_:
+        return Interval(int(arr.min()), int(arr.max()))
+    return Interval(float(arr.min()), float(arr.max()))
+
+
+def _sub_jaxpr(params: dict):
+    """The (closed_jaxpr, consts_known) of a call-like eqn, if any."""
+    from jax.extend import core as jex_core
+
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        sub = params.get(key)
+        if sub is None:
+            continue
+        if isinstance(sub, jex_core.ClosedJaxpr):
+            return sub
+        if isinstance(sub, jex_core.Jaxpr):  # shard_map carries a bare Jaxpr
+            return jex_core.ClosedJaxpr(sub, ())
+    return None
+
+
+class _RangeInterpreter:
+    def __init__(self, ceiling: Interval | None, check_dtype: bool,
+                 axis_sizes: dict | None):
+        self.ceiling = ceiling
+        self.check_dtype = check_dtype
+        self.axis_sizes = dict(axis_sizes or {})
+        self.findings: list[RangeFinding] = []
+        self.notes: list[str] = []
+        self.counter = 0
+
+    # -- environment ------------------------------------------------------
+    def _read(self, env, v) -> Interval:
+        from jax.extend import core as jex_core
+
+        if isinstance(v, jex_core.Literal):
+            return _array_interval(v.val)
+        return env[v]
+
+    # -- one eqn ----------------------------------------------------------
+    def _check(self, eqn, out: Interval, aval) -> None:
+        if not _is_int_dtype(getattr(aval, "dtype", np.float32)):
+            return
+        name = eqn.primitive.name
+        if self.ceiling is not None and (
+            out.lo < self.ceiling.lo or out.hi > self.ceiling.hi
+        ):
+            self.findings.append(RangeFinding(
+                kind="ceiling", op=name, eqn_index=self.counter,
+                interval=out, bound=self.ceiling,
+                message=(
+                    f"`{name}` (eqn {self.counter}) produces values in "
+                    f"{out}, outside the declared exact-integer ceiling "
+                    f"{self.ceiling}"
+                ),
+            ))
+        elif self.check_dtype:
+            drange = _dtype_interval(aval.dtype)
+            if out.lo < drange.lo or out.hi > drange.hi:
+                self.findings.append(RangeFinding(
+                    kind="dtype-overflow", op=name, eqn_index=self.counter,
+                    interval=out, bound=drange,
+                    message=(
+                        f"`{name}` (eqn {self.counter}) produces values in "
+                        f"{out}, wrapping its {np.dtype(aval.dtype).name} "
+                        f"carrier {drange}"
+                    ),
+                ))
+
+    def _eval_eqn(self, eqn, ins: list[Interval]) -> list[Interval]:
+        name = eqn.primitive.name
+        out_aval = eqn.outvars[0].aval
+        a = ins[0] if ins else TOP
+        b = ins[1] if len(ins) > 1 else None
+
+        if name in ("add", "add_any"):
+            return [Interval(a.lo + b.lo, a.hi + b.hi)]
+        if name == "sub":
+            return [Interval(a.lo - b.hi, a.hi - b.lo)]
+        if name == "mul":
+            return [_imul(a, b)]
+        if name == "neg":
+            return [Interval(-a.hi, -a.lo)]
+        if name == "abs":
+            lo = 0 if a.lo <= 0 <= a.hi else min(abs(a.lo), abs(a.hi))
+            return [Interval(lo, max(abs(a.lo), abs(a.hi)))]
+        if name == "max":
+            return [Interval(max(a.lo, b.lo), max(a.hi, b.hi))]
+        if name == "min":
+            return [Interval(min(a.lo, b.lo), min(a.hi, b.hi))]
+        if name == "div":
+            if b.lo <= 0 <= b.hi:
+                return [TOP]
+            cands = [a.lo / b.lo, a.lo / b.hi, a.hi / b.lo, a.hi / b.hi]
+            return [Interval(min(cands), max(cands))]
+        if name == "rem":
+            # numpy/lax rem bounds depend on sign conventions; conservative.
+            m = max(abs(b.lo), abs(b.hi))
+            return [Interval(-m, m)]
+        if name == "integer_pow":
+            p = int(eqn.params.get("y", 2))
+            cands = [x**p for x in (a.lo, a.hi) if x not in (_NEG_INF, _POS_INF)]
+            if not cands:
+                return [TOP]
+            if p % 2 == 0 and a.lo <= 0 <= a.hi:
+                cands.append(0)
+            return [Interval(min(cands), max(cands))]
+        if name in ("floor", "ceil", "round", "round_nearest_even",
+                    "nextafter"):
+            lo = a.lo if a.lo in (_NEG_INF,) else math.floor(a.lo)
+            hi = a.hi if a.hi in (_POS_INF,) else math.ceil(a.hi)
+            return [Interval(lo, hi)]
+        if name == "sign":
+            return [Interval(-1, 1)]
+        if name == "clamp":
+            lo_b, x, hi_b = ins
+            return [Interval(
+                max(lo_b.lo, min(x.lo, hi_b.lo)),
+                max(lo_b.hi, min(x.hi, hi_b.hi)),
+            )]
+        if name == "shift_left":
+            return [_pow2_shift(a, b)]
+        if name == "shift_right_arithmetic":
+            return [_floordiv_pow2(a, b)]
+        if name == "shift_right_logical":
+            if a.lo >= 0:
+                return [_floordiv_pow2(a, b)]
+            return [_dtype_interval(out_aval.dtype)]
+        if name in ("and", "or", "xor"):
+            return [_bitwise(a, b, out_aval.dtype)]
+        if name == "not":
+            return [_dtype_interval(out_aval.dtype)
+                    if _is_int_dtype(out_aval.dtype) else BOOL]
+        if name == "select_n":
+            out = ins[1]
+            for case in ins[2:]:
+                out = out.union(case)
+            return [out]
+        if name == "convert_element_type":
+            if np.dtype(out_aval.dtype) == np.bool_:
+                return [BOOL]
+            if _is_int_dtype(out_aval.dtype) and not isinstance(a.lo, int):
+                lo = a.lo if a.lo == _NEG_INF else math.floor(a.lo)
+                hi = a.hi if a.hi == _POS_INF else math.ceil(a.hi)
+                return [Interval(lo, hi)]
+            return [a]
+        if name == "reduce_sum":
+            n = _reduced_size(eqn.invars[0].aval, out_aval)
+            return [Interval(_mul_bound(n, a.lo), _mul_bound(n, a.hi))]
+        if name in ("reduce_max", "reduce_min", "reduce_and", "reduce_or",
+                    "argmax", "argmin", "cumsum", "cumlogsumexp"):
+            if name == "cumsum":
+                n = int(np.prod(eqn.invars[0].aval.shape) or 1)
+                return [Interval(_mul_bound(n, min(a.lo, 0)),
+                                 _mul_bound(n, max(a.hi, 0)))]
+            if name in ("argmax", "argmin"):
+                return [Interval(0, max(int(np.prod(eqn.invars[0].aval.shape)) - 1, 0))]
+            return [a]
+        if name == "psum":
+            total = 1
+            for ax in eqn.params.get("axes", ()):
+                size = self.axis_sizes.get(ax)
+                if size is None:
+                    # Unknown participant count: a prover must not default
+                    # to the identity (a silent under-approximation) —
+                    # unbounded is the sound answer, and the note tells
+                    # the caller which axis to declare.
+                    self.notes.append(
+                        f"psum over axis {ax!r} with undeclared size: "
+                        "outputs unbounded (pass axis_sizes)"
+                    )
+                    return [TOP for _ in ins]
+                total *= int(size)
+            return [Interval(_mul_bound(total, iv.lo), _mul_bound(total, iv.hi))
+                    for iv in ins]
+        if name in ("pmax", "pmin", "all_gather", "ppermute"):
+            return [iv for iv in ins]
+        if name in ("broadcast_in_dim", "reshape", "squeeze", "transpose",
+                    "slice", "rev", "expand_dims", "copy", "stop_gradient",
+                    "reduce_precision", "device_put", "sharding_constraint",
+                    "dynamic_slice", "gather", "pad", "sort"):
+            if name == "pad":
+                return [a.union(ins[1])]
+            if name == "dynamic_slice":
+                return [a]
+            return [a]
+        if name == "concatenate":
+            out = ins[0]
+            for iv in ins[1:]:
+                out = out.union(iv)
+            return [out]
+        if name == "iota":
+            dim = int(eqn.params["shape"][eqn.params["dimension"]])
+            return [Interval(0, max(dim - 1, 0))]
+        if name in ("eq", "ne", "lt", "le", "gt", "ge", "is_finite"):
+            return [BOOL]
+        if name in ("pjit", "closed_call", "custom_jvp_call",
+                    "custom_vjp_call", "remat", "checkpoint", "shard_map",
+                    "core_call"):
+            sub = _sub_jaxpr(eqn.params)
+            if sub is not None:
+                if name == "shard_map":
+                    mesh = eqn.params.get("mesh")
+                    if mesh is not None:
+                        try:
+                            for ax, size in dict(mesh.shape).items():
+                                # setdefault: a caller-declared WORST-CASE
+                                # axis size (prove 32 participants on a
+                                # 1-device trace mesh) must win over the
+                                # traced mesh's.
+                                self.axis_sizes.setdefault(ax, int(size))
+                        except Exception:  # abstract mesh without .shape
+                            pass
+                return self._eval_jaxpr(sub, ins)
+            self.notes.append(f"opaque call `{name}`: outputs unbounded")
+            return [TOP for _ in eqn.outvars]
+
+        self.notes.append(f"unsupported primitive `{name}`: output unbounded")
+        return [TOP for _ in eqn.outvars]
+
+    # -- a whole (closed) jaxpr -------------------------------------------
+    def _eval_jaxpr(self, closed, in_intervals: list[Interval]):
+        jaxpr = closed.jaxpr
+        env: dict = {}
+        for v, c in zip(jaxpr.constvars, closed.consts):
+            env[v] = _array_interval(c)
+        n_in = len(jaxpr.invars)
+        ins = list(in_intervals[:n_in])
+        # call-like eqns may pass consts as leading args; pad conservatively
+        while len(ins) < n_in:
+            ins.append(TOP)
+        for v, iv in zip(jaxpr.invars, ins):
+            env[v] = iv
+        for eqn in jaxpr.eqns:
+            eins = [self._read(env, v) for v in eqn.invars]
+            try:
+                outs = self._eval_eqn(eqn, eins)
+            except Exception as e:  # a handler hole must not kill analysis
+                self.notes.append(
+                    f"`{eqn.primitive.name}`: interval evaluation failed "
+                    f"({type(e).__name__}: {e}); output unbounded"
+                )
+                outs = [TOP for _ in eqn.outvars]
+            if len(outs) != len(eqn.outvars):
+                outs = [TOP for _ in eqn.outvars]
+            for v, out in zip(eqn.outvars, outs):
+                self._check(eqn, out, v.aval)
+                env[v] = out
+            self.counter += 1
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+
+def eval_jaxpr_ranges(
+    closed_jaxpr,
+    in_intervals: list[Interval],
+    *,
+    ceiling: Interval | None = None,
+    check_dtype: bool = True,
+    axis_sizes: dict | None = None,
+) -> RangeResult:
+    """Propagate intervals through `closed_jaxpr` (recursing into pjit /
+    shard_map / custom-vjp sub-jaxprs).
+
+    `ceiling` declares the exact-integer carrier bound every integer-dtype
+    op must respect (e.g. the packed pipeline's min(q/2, 2**62)); without
+    it, integer ops are checked against their own dtype range
+    (`check_dtype`). Violations are recorded as findings citing the eqn —
+    analysis continues with the mathematical interval so the FIRST
+    offending op is the root cause, not a cascade.
+    """
+    interp = _RangeInterpreter(ceiling, check_dtype, axis_sizes)
+    outs = interp._eval_jaxpr(closed_jaxpr, in_intervals)
+    return RangeResult(outs, interp.findings, interp.notes)
+
+
+# ---------------------------------------------------------------------------
+# Packing-headroom certification (the ISSUE-8 tentpole proof).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PackingCertificate:
+    """Static proof (or refutation) of one packed-aggregation geometry."""
+
+    ok: bool
+    modulus_bits: int   # bit length of q
+    bits: int           # quantizer width b
+    k: int              # interleave factor
+    fbits: int          # field width b + ceil(log2 C)
+    guard: int          # effective guard guard_bits + ceil(log2 C)
+    clients: int
+    ceiling_bits: int   # log2 of the binding wall: min(q/2, 2**62)
+    findings: tuple     # RangeFinding tuple, empty when ok
+    checks: tuple       # human-readable proven facts
+
+    def summary(self) -> str:
+        head = (
+            f"packing b={self.bits} k={self.k} C={self.clients} "
+            f"(field {self.fbits}b, guard {self.guard}b, "
+            f"wall 2**{self.ceiling_bits})"
+        )
+        if self.ok:
+            return f"{head}: CERTIFIED — " + "; ".join(self.checks)
+        return f"{head}: UNSAFE — " + "; ".join(
+            str(f) for f in self.findings
+        )
+
+
+@functools.lru_cache(maxsize=256)
+def certify_packing(
+    modulus: int, bits: int, k: int, clients: int, guard_bits: int
+) -> PackingCertificate:
+    """Prove (or refute) the carry-free headroom of one packing geometry
+    by interval analysis of the real integer-pipeline jaxpr.
+
+    Traces `ckks.quantize.packing_sum_probe` — the plaintext integer math
+    the homomorphic path (encode_packed → encrypt → psum_mod /
+    OnlineAccumulator fold → decode_int_center) computes under encryption —
+    and checks every op's range against the exact-integer ceiling
+    min(q/2, 2**62) plus the probe's declared output bounds:
+
+      field_sums ≤ 2**fbits - 1          (the C-client sum never carries)
+      |noise_sum| < 2**(guard_eff - 1)   (decrypt noise stays in the guard)
+      packed total < min(q/2, 2**62)     (centered decode + int64 exactness)
+
+    A failed check names the offending op. Cached: PackedSpec.for_params
+    and max_interleave certify on every build.
+    """
+    import jax
+
+    from hefl_tpu.ckks import quantize
+
+    fbits = quantize.field_bits(bits, clients)
+    guard_eff = guard_bits + max(int(clients) - 1, 0).bit_length()
+    ceiling_val = min(modulus // 2, 1 << quantize.MAX_PACKED_BITS)
+    ceiling = Interval(-(ceiling_val - 1), ceiling_val - 1)
+
+    probe, args = quantize.packing_sum_probe(bits, k, fbits, guard_eff, clients)
+    # x64 only for TRACING: the probe's avals must be able to NAME an
+    # int64 carrier; the analysis itself computes in unbounded ints.
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(probe)(*args)
+
+    qm = quantize.qmax(bits)
+    noise_per_client = (1 << max(guard_bits - 1, 0)) - 1
+    in_ivs = [
+        TOP,                                         # raw float updates
+        Interval(-noise_per_client, noise_per_client),  # per-client noise
+    ]
+    res = eval_jaxpr_ranges(closed, in_ivs, ceiling=ceiling)
+    findings = list(res.findings)
+    checks: list[str] = []
+
+    def out_check(idx: int, bound: Interval, what: str):
+        iv = res.out_intervals[idx]
+        if iv.lo < bound.lo or iv.hi > bound.hi:
+            # Name the op that PRODUCES this output.
+            outvar = closed.jaxpr.outvars[idx]
+            op = "input"
+            for eqn in closed.jaxpr.eqns:
+                if outvar in eqn.outvars:
+                    op = eqn.primitive.name
+            findings.append(RangeFinding(
+                kind="output-bound", op=op, eqn_index=-1,
+                interval=iv, bound=bound,
+                message=f"{what}: `{op}` yields {iv}, outside {bound}",
+            ))
+        else:
+            checks.append(f"{what} in {iv} ⊆ {bound}")
+
+    # probe outputs: (field_sums, noise_sum, packed_total)
+    out_check(0, Interval(0, (1 << fbits) - 1),
+              f"per-field {clients}-client sum (carry-free)")
+    half_guard = 1 << max(guard_eff - 1, 0)
+    out_check(1, Interval(-(half_guard - 1), half_guard - 1),
+              "accumulated decrypt noise (guard band)")
+    out_check(2, ceiling, "packed client-sum (q/2 & 2**62 wall)")
+
+    return PackingCertificate(
+        ok=not findings,
+        modulus_bits=modulus.bit_length(),
+        bits=bits, k=k, fbits=fbits, guard=guard_eff, clients=int(clients),
+        ceiling_bits=ceiling_val.bit_length() - 1,
+        findings=tuple(findings),
+        checks=tuple(checks),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregationCertificate:
+    """Static no-wrap proof of the aggregation hot path at one prime size."""
+
+    ok: bool
+    prime_bits: int
+    chunk: int          # lazy-sum participants proven per reduction
+    findings: tuple
+    checks: tuple
+
+    def summary(self) -> str:
+        head = f"aggregation p<2**{self.prime_bits} chunk={self.chunk}"
+        if self.ok:
+            return f"{head}: CERTIFIED — " + "; ".join(self.checks)
+        return f"{head}: UNSAFE — " + "; ".join(str(f) for f in self.findings)
+
+
+@functools.lru_cache(maxsize=32)
+def certify_aggregation(prime: int) -> AggregationCertificate:
+    """Prove the three aggregation folds never wrap their carriers for a
+    given RNS prime size, over ALL inputs:
+
+      1. `fl.secure._lazy_sum_mod`'s uint32 chunk accumulation of
+         MAX_PSUM_CLIENTS canonical residues (< p each);
+      2. `parallel.collectives.psum_mod`'s fused lazy all-reduce at
+         MAX_PSUM_CLIENTS participants per mesh axis (analyzed at the
+         declared worst-case axis size, whatever mesh traced it);
+      3. `fl.stream.OnlineAccumulator`'s int64 online fold.
+
+    These are the invariants the MAX_PSUM_CLIENTS constant encodes; a
+    prime-size bump that silently breaks them fails here, statically.
+    """
+    import jax
+
+    from hefl_tpu.fl import secure, stream
+    from hefl_tpu.parallel import collectives
+    from hefl_tpu.parallel.collectives import MAX_PSUM_CLIENTS
+
+    prime = int(prime)
+    canonical = Interval(0, prime - 1)
+    findings: list[RangeFinding] = []
+    checks: list[str] = []
+
+    def run(name, closed, in_ivs, axis_sizes=None):
+        res = eval_jaxpr_ranges(closed, in_ivs, axis_sizes=axis_sizes)
+        if res.findings:
+            for f in res.findings:
+                findings.append(dataclasses.replace(
+                    f, message=f"{name}: {f.message}"
+                ))
+        else:
+            checks.append(
+                f"{name} stays in {res.out_intervals[0]}"
+            )
+
+    # 1. lazy chunk sum (uint32, no reduction until the chunk boundary)
+    fn, args = secure.lazy_sum_chunk_probe(MAX_PSUM_CLIENTS)
+    run("lazy_sum_mod chunk", jax.make_jaxpr(fn)(*args), [canonical])
+
+    # 2. psum_mod's lazy accumulation at the worst-case participant count
+    fn, args = collectives.psum_range_probe(prime)
+    run(
+        f"psum_mod[{MAX_PSUM_CLIENTS} participants]",
+        jax.make_jaxpr(fn)(*args),
+        [canonical],
+        axis_sizes={"clients": MAX_PSUM_CLIENTS},
+    )
+
+    # 3. the streaming engine's int64 online fold
+    fn, args = stream.fold_range_probe(prime)
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(fn)(*args)
+    run("OnlineAccumulator fold", closed, [canonical, canonical])
+
+    return AggregationCertificate(
+        ok=not findings,
+        prime_bits=prime.bit_length(),
+        chunk=MAX_PSUM_CLIENTS,
+        findings=tuple(findings),
+        checks=tuple(checks),
+    )
+
+
+def certified_max_interleave(
+    modulus: int, bits: int, clients: int, guard_bits: int
+) -> int:
+    """The largest k this analyzer can certify (search upward from 1).
+
+    The cross-check target for the closed-form headroom formula: the two
+    derivations MUST agree on every supported config (quantize.
+    max_interleave raises loudly when they don't)."""
+    k = 0
+    while certify_packing(modulus, bits, k + 1, clients, guard_bits).ok:
+        k += 1
+        if k > 64:  # one packed slot cannot hold more than 64 one-bit fields
+            break
+    return k
+
+
+__all__ = [
+    "Interval",
+    "TOP",
+    "RangeFinding",
+    "RangeResult",
+    "eval_jaxpr_ranges",
+    "PackingCertificate",
+    "certify_packing",
+    "certified_max_interleave",
+]

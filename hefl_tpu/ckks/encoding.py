@@ -244,6 +244,38 @@ def decode_exact(
 
 
 # ---------------------------------------------------------------------------
+# Shaped jaxpr probes (ISSUE 8): the exact-integer encode/decode regions,
+# exported for analysis.lint — no rem/div (barrett_mu's [L, 1]
+# constant-table divide is the one allowlisted exception), no float
+# contamination (a single f32 round-trip would shear packed bit fields).
+# ---------------------------------------------------------------------------
+
+
+def exact_int_probes() -> dict:
+    import functools
+
+    @functools.lru_cache(maxsize=1)
+    def _ntt():
+        from hefl_tpu.ckks.keys import CkksContext
+
+        return CkksContext.create(n=256).ntt
+
+    ntt = _ntt()
+    num_l = int(np.asarray(ntt.p).shape[0])
+    hi = jnp.zeros((2, ntt.n), jnp.uint32)
+    lo = jnp.zeros((2, ntt.n), jnp.uint32)
+    res = jnp.zeros((2, num_l, ntt.n), jnp.uint32)
+    return {
+        "ckks.encoding.encode_packed": (
+            lambda h, l: encode_packed(ntt, h, l), (hi, lo)
+        ),
+        "ckks.encoding.mixed_radix_digits": (
+            lambda r: tuple(_mixed_radix_digits(ntt, r)), (res,)
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Slot (canonical-embedding) packing — host-side float64.
 #
 # Coefficient packing (above) is the FedAvg wire format: ct+ct and ct x

@@ -107,14 +107,21 @@ class PackedSpec:
             ctx.modulus, cfg.bits, num_clients, cfg.guard_bits
         )
         guard = cfg.guard_bits + max(int(num_clients) - 1, 0).bit_length()
-        if guard + k * fb > min(
-            ctx.modulus.bit_length() - 2, quantize.MAX_PACKED_BITS
-        ):
+        # Config-build-time headroom proof (ISSUE 8): the interval range
+        # analysis certifies this exact (b, k, C, guard, q) point over ALL
+        # inputs, or rejects it naming the op that overflows — stronger
+        # than the historical closed-form inequality, which it subsumes.
+        from hefl_tpu.analysis import ranges as _ranges
+
+        cert = _ranges.certify_packing(
+            int(ctx.modulus), cfg.bits, k, int(num_clients), cfg.guard_bits
+        )
+        if not cert.ok:
             raise ValueError(
-                f"PackedSpec: k={k} at bits={cfg.bits}, clients={num_clients} "
-                f"needs {guard + k * fb} bits but the ring allows "
-                f"{min(ctx.modulus.bit_length() - 2, quantize.MAX_PACKED_BITS)}"
-                " — lower interleave/bits/guard or add RNS primes"
+                f"PackedSpec: k={k} at bits={cfg.bits}, "
+                f"clients={num_clients} rejected by static range analysis "
+                f"— {cert.summary()} — lower interleave/bits/guard or add "
+                "RNS primes"
             )
         return cls(
             base=base,
@@ -191,6 +198,51 @@ def bytes_on_wire_record(spec: PackedSpec, num_limbs: int) -> dict:
         "expansion_unpacked": round(unpacked / plain, 2),
         "expansion_packed": round(packed / plain, 2),
     }
+
+
+def probe_spec(bits: int = 8, k: int = 2, clients: int = 2) -> PackedSpec:
+    """A tiny hand-built PackedSpec for shaped jaxpr probes and lint
+    fixtures (ISSUE 8): no model template or CKKS context required, small
+    enough that tracing `pack_quantized_flat` takes milliseconds."""
+    from hefl_tpu.ckks import quantize
+
+    n = 8
+    base = PackSpec(n=n, total=2 * k * n, n_ct=2 * k, unravel=lambda f: f)
+    fb = quantize.field_bits(bits, clients)
+    return PackedSpec(
+        base=base,
+        bits=bits,
+        k=k,
+        field_bits=fb,
+        guard=6 + max(clients - 1, 0).bit_length(),
+        step=0.5 / quantize.qmax(bits),
+        clip=0.5,
+        clients=clients,
+        n_ct=2,
+        error_budget=0.1,
+    )
+
+
+def exact_int_probes() -> dict:
+    """Declared exact-integer regions of the packed wire format, as shaped
+    jaxpr probes for analysis.lint. `pack_quantized_flat` itself starts in
+    float (the quantizer), so the declared region here is its integer
+    tail: offset + interleave on already-quantized codes."""
+    import jax.numpy as jnp
+
+    from hefl_tpu.ckks import quantize
+
+    spec = probe_spec()
+
+    def interleave_tail(q):
+        u = (q + spec.offset).astype(jnp.uint32)
+        u = u.reshape(spec.n_ct, spec.k, spec.n)
+        return quantize.interleave_fields(
+            u, spec.k, spec.field_bits, spec.guard
+        )
+
+    q = jnp.zeros((spec.total,), jnp.int32)
+    return {"ckks.packing.interleave_tail": (interleave_tail, (q,))}
 
 
 def pack_quantized_flat(

@@ -143,7 +143,13 @@ def payload_bits(modulus: int, guard: int) -> int:
 
 def max_interleave(modulus: int, bits: int, clients: int, guard_bits: int) -> int:
     """The headroom-formula packing factor:
-    k = floor(log2(q_headroom) / (b + ceil(log2 C)))."""
+    k = floor(log2(q_headroom) / (b + ceil(log2 C))).
+
+    The closed-form k is cross-checked against the jaxpr range analysis
+    (`analysis.ranges.certify_packing`, ISSUE 8) on every call: two
+    independent derivations of the same carry-free invariant that can
+    never disagree silently. A divergence is a BUG in one of them, not a
+    configuration error, and raises RuntimeError loudly."""
     guard_eff = guard_bits + max(int(clients) - 1, 0).bit_length()
     avail = payload_bits(modulus, guard_eff)
     k = avail // field_bits(bits, clients)
@@ -153,6 +159,17 @@ def max_interleave(modulus: int, bits: int, clients: int, guard_bits: int) -> in
             f"{field_bits(bits, clients)}-bit field (bits={bits}, "
             f"clients={clients}, guard={guard_bits}); lower bits/guard or "
             "add RNS primes"
+        )
+    from hefl_tpu.analysis import ranges as _ranges
+
+    cert = _ranges.certify_packing(
+        int(modulus), bits, k, int(clients), guard_bits
+    )
+    if not cert.ok:
+        raise RuntimeError(
+            "headroom formula and range analysis disagree: the formula's "
+            f"k={k} failed static certification — {cert.summary()} — this "
+            "is a bug in one of the two derivations, not a config error"
         )
     return k
 
@@ -271,6 +288,66 @@ def decode_field_sums(
     return (q_sum * (float(step) / surviving)).astype(np.float32)
 
 
+# ---------------------------------------------------------------------------
+# Shaped jaxpr probes (ISSUE 8): the static-analysis subsystem
+# (hefl_tpu.analysis) proves this module's integer invariants by interval
+# abstract interpretation of REAL jaxprs, not of a hand-written model — so
+# the probes below must trace the same math the pipeline runs.
+# ---------------------------------------------------------------------------
+
+
+def packing_sum_probe(
+    bits: int, k: int, fbits: int, guard: int, clients: int
+):
+    """The packed-aggregation integer pipeline as one traceable function.
+
+    Mirrors, in plaintext integers, exactly what the homomorphic path
+    computes: quantize (clip to ±qmax) → offset to non-negative codes →
+    shift each of the k fields to its bit offset (`interleave_fields`'s
+    math on the recombined value hi·2**31+lo) → sum over C clients
+    (`psum_mod` / `OnlineAccumulator.fold`) → add the accumulated decrypt
+    noise → outputs the analyzer bounds:
+
+        (field_sums [k, m], noise_sum [m], packed_total [m])
+
+    Shift offsets may exceed 63 for unsafe configs — that is the point:
+    tracing still succeeds (shift amounts are small constants) and the
+    range analyzer reports the shift as the offending op. Trace under
+    `jax.experimental.enable_x64()` so the int64 carrier is nameable.
+    -> (fn, example_args).
+    """
+    import jax.numpy as _jnp
+
+    qm = qmax(bits)
+    m = 2  # coefficients per probe slab; ranges are per-element anyway
+
+    def probe(x, noise):
+        q = quantize(x, 1.0, bits)                     # int32 in [-qm, qm]
+        u = (q + qm).astype(_jnp.int64)                # [C, k, m] >= 0
+        field_sums = _jnp.sum(u, axis=0)               # [k, m] client sums
+        packed = _jnp.zeros((x.shape[0], m), _jnp.int64)
+        for j in range(k):
+            packed = packed + (u[:, j, :] << (guard + j * fbits))
+        noise_sum = _jnp.sum(noise, axis=0)            # [m]
+        packed_total = _jnp.sum(packed, axis=0) + noise_sum
+        return field_sums, noise_sum, packed_total
+
+    x = jnp.zeros((int(clients), k, m), jnp.float32)
+    noise = np.zeros((int(clients), m), np.int64)
+    return probe, (x, noise)
+
+
+def exact_int_probes() -> dict:
+    """This module's declared exact-integer regions as shaped jaxpr probes
+    (analysis.lint walks them: no rem/div, no float contamination)."""
+    u = jnp.zeros((2, 4), jnp.uint32)
+    return {
+        "ckks.quantize.interleave_fields": (
+            lambda v: interleave_fields(v, 2, 9, 5), (u,)
+        ),
+    }
+
+
 def quant_error_budget(cfg: PackingConfig) -> float:
     """The declared per-coefficient |packed - unpacked| budget: the
     configured override, else step/2 (the quantizer's worst case, which
@@ -311,6 +388,8 @@ __all__ = [
     "field_bits",
     "payload_bits",
     "max_interleave",
+    "packing_sum_probe",
+    "exact_int_probes",
     "quantize",
     "dequantize",
     "saturation_count",

@@ -367,6 +367,17 @@ def main(argv: list[str] | None = None) -> int:
         cfg = PRESETS[args.preset]
     else:
         cfg = config_from_args(args)
+    # Pre-flight static analysis (ISSUE 8): reject a statically-unsafe
+    # config (packing headroom, aggregation bounds) BEFORE dataset and
+    # compile work, with the offending op named. run_experiment re-checks
+    # (cached certificates make that free) so programmatic callers get
+    # the same guarantee.
+    from hefl_tpu import analysis
+
+    try:
+        analysis.check_experiment(cfg)
+    except analysis.AnalysisError as e:
+        raise SystemExit(f"hefl-lint: {e}")
     out = run_experiment(cfg, resume=args.resume, verbose=not args.json)
     if args.json:
         for rec in out["history"]:

@@ -431,6 +431,14 @@ def run_experiment(
     ctx = sk = pk = spec = pspec = None
     if cfg.encrypted:
         ctx = cfg.he.build()
+        # Pre-flight static analysis (ISSUE 8): certify the aggregation
+        # no-wrap bounds and the packed headroom for THIS config before
+        # any training work — fails loudly with the offending op named,
+        # and publishes the analysis.violations counter (0 here) into the
+        # run's metrics snapshot.
+        from hefl_tpu import analysis
+
+        analysis.check_experiment(cfg, ctx=ctx, say=say)
         key, k_he = jax.random.split(key)
         sk, pk = keygen(ctx, k_he)
         spec = PackSpec.for_params(params, ctx.n)

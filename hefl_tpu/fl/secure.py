@@ -125,6 +125,29 @@ def _lazy_sum_mod(x: jax.Array, p: jax.Array) -> jax.Array:
     return acc
 
 
+def exact_int_probes() -> dict:
+    """Shaped jaxpr probes of this module's declared exact-integer regions
+    (ISSUE 8, analysis.lint): the lazy modular sum must stay rem/div- and
+    float-free — it runs per ciphertext limb on the hot aggregation path."""
+    p = jnp.full((3, 1), jnp.uint32(2**27 - 39))
+    x = jnp.zeros((4, 3, 8), jnp.uint32)
+    return {
+        "fl.secure.lazy_sum_mod": (lambda v: _lazy_sum_mod(v, p), (x,)),
+    }
+
+
+def lazy_sum_chunk_probe(chunk: int = MAX_PSUM_CLIENTS):
+    """Range probe (analysis.ranges.certify_aggregation): the lazy uint32
+    accumulation inside `_lazy_sum_mod` — up to MAX_PSUM_CLIENTS canonical
+    residues are summed WITHOUT reduction, so the no-wrap proof is
+    sum < 2**32, statically, for the configured prime size."""
+
+    def probe(x):
+        return jnp.sum(x, axis=0, dtype=jnp.uint32)
+
+    return probe, (jnp.zeros((int(chunk), 8), jnp.uint32),)
+
+
 def encrypt_stack(ctx: CkksContext, pk: PublicKey, p_out, enc_keys) -> Ciphertext:
     """Encrypt stacked per-client weight trees (leaves [C, ...]) into one
     [C, n_ct, L, N]-batched Ciphertext — the encrypt half of the round for

@@ -180,6 +180,38 @@ class OnlineAccumulator:
         return self._c0, self._c1
 
 
+def exact_int_probes() -> dict:
+    """Shaped jaxpr probe of the online fold's declared exact-integer
+    region (ISSUE 8, analysis.lint). `OnlineAccumulator._add` runs
+    host-side in numpy; this jax mirror traces the same arithmetic (the
+    `%` is the allowlisted host-side modulo — see analysis.lint.ALLOWLIST)
+    so the no-float / no-stray-div rules still watch the fold's math. The
+    int32 carrier is sound here for the same reason the fold is exact:
+    two canonical residues < 2**27 sum below 2**28."""
+    p = jnp.asarray([[2**27 - 39]], jnp.int32)
+
+    def probe(acc, row):
+        t = (acc.astype(jnp.int32) + row.astype(jnp.int32)) % p
+        return t.astype(jnp.uint32)
+
+    z = jnp.zeros((1, 8), jnp.uint32)
+    return {"fl.stream.accumulator_fold": (probe, (z, z))}
+
+
+def fold_range_probe(prime: int):
+    """Range probe (analysis.ranges.certify_aggregation): the faithful
+    int64 mirror of `OnlineAccumulator._add` — proves the canonical fold
+    never wraps its int64 carrier for the configured prime size. Trace
+    under `jax.experimental.enable_x64()`."""
+    p = np.asarray([[int(prime)]], np.int64)
+
+    def probe(acc, row):
+        return (acc + row) % p
+
+    z = np.zeros((1, 8), np.int64)
+    return probe, (z, z)
+
+
 def ct_hash(c0, c1) -> str:
     """Pipeline hash of a ciphertext's residues — the bitwise-equality
     currency of the streaming-vs-batched gates."""

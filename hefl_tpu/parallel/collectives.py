@@ -42,10 +42,65 @@ def psum_mod(residues: jax.Array, p: jax.Array, axis_name: str) -> jax.Array:
     canonicalization is division-free Barrett, bitwise-equal to the
     historical `lax.rem`.
     """
-    from hefl_tpu.ckks.modular import barrett_mod
+    from hefl_tpu.ckks.modular import barrett_mod, barrett_mu
 
     total = jax.lax.psum(residues, axis_name)
-    return barrett_mod(total, jnp.broadcast_to(p, total.shape))
+    # Compute the Barrett constant at the [L, 1] table shape BEFORE
+    # broadcasting (hefl-lint forbidden-primitive): the divide inside
+    # barrett_mu must stay a constant-table op, not balloon to the full
+    # ciphertext shape and rely on XLA to fold it away.
+    mu = barrett_mu(p)
+    return barrett_mod(
+        total,
+        jnp.broadcast_to(p, total.shape),
+        jnp.broadcast_to(mu, total.shape),
+    )
+
+
+def exact_int_probes() -> dict:
+    """Shaped jaxpr probe of the modular all-reduce (ISSUE 8,
+    analysis.lint): the whole collective — psum plus the Barrett
+    canonicalization — must stay rem/div- and float-free."""
+    import numpy as np
+
+    from hefl_tpu.parallel import make_mesh, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    p = jnp.asarray(np.full((1, 1), 2**27 - 39, np.uint32))
+    mesh = make_mesh(1)
+    fn = shard_map(
+        lambda x: psum_mod(x, p, "clients"),
+        mesh=mesh,
+        in_specs=P("clients"),
+        out_specs=P(),
+        check_vma=False,
+    )
+    x = jnp.zeros((1, 1, 8), jnp.uint32)
+    return {"parallel.collectives.psum_mod": (fn, (x,))}
+
+
+def psum_range_probe(prime: int):
+    """Range probe (analysis.ranges.certify_aggregation): the LAZY psum
+    accumulation inside `psum_mod` — the sum of canonical residues across
+    the client axis runs unreduced, so the no-wrap invariant is
+    participants * (p-1) < 2**32. Analyzed at the declared worst-case
+    axis size (MAX_PSUM_CLIENTS), whatever mesh traced the probe. The
+    Barrett canonicalization that follows wraps uint32 BY DESIGN
+    (mul32_wide's carry arithmetic) and is covered by the lint rules +
+    bitwise parity tests instead of interval analysis."""
+    from hefl_tpu.parallel import make_mesh, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(1)
+    fn = shard_map(
+        lambda x: jax.lax.psum(x, "clients"),
+        mesh=mesh,
+        in_specs=P("clients"),
+        out_specs=P(),
+        check_vma=False,
+    )
+    x = jnp.zeros((1, 1, 8), jnp.uint32)
+    return fn, (x,)
 
 
 def pmean_tree(tree, axis_name: str | tuple[str, ...]):

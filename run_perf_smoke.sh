@@ -32,7 +32,11 @@
 #       bytes_on_wire rows must be present and non-null, the packed
 #       uplink/ciphertext count must shrink ~k-fold, and the measured
 #       speedups must clear the floors — standalone encrypt and decrypt
-#       core >= 1.5x at k=4, he_in_round speedup >= 1.5x.
+#       core >= 1.5x at k=4, he_in_round speedup >= 1.5x;
+#   (j) static analysis (ISSUE 8): the fast hefl-lint gate exits clean,
+#       and the CLI run's experiment_end metrics embed
+#       analysis.violations = 0 plus an analysis_check event (proof the
+#       pre-flight range/lint certification ran on this tree).
 # Wired into run_tpu_suite.sh as stage 0 (cheap pre-stage, no backend
 # probe needed — both harnesses pin themselves to CPU in smoke mode).
 set -euo pipefail
@@ -62,6 +66,18 @@ JAX_PLATFORMS=cpu HEFL_EVENTS=1 python -m hefl_tpu.cli \
   --dataset mnist --model smallcnn --num-clients 2 --rounds 1 --epochs 1 \
   --batch-size 8 --n-train 64 --n-test 32 --he-n 256 --no-save-model \
   --events "$workdir/events.jsonl" --json > "$workdir/events_run.out"
+
+# (j) static analysis (ISSUE 8): the fast hefl-lint gate must come back
+# clean — source sweep, exact-integer region lint, range certification of
+# the packing grid, hot-path rem/div/f64/callback lint, donation check.
+# Any violation fails the smoke here, before TPU evidence is spent on a
+# tree that breaks its own invariants.
+JAX_PLATFORMS=cpu python -m hefl_tpu.analysis --fast --json \
+  > "$workdir/hefl_lint.jsonl" || {
+  echo "PERF SMOKE FAILED: hefl-lint violations:"
+  cat "$workdir/hefl_lint.jsonl"
+  exit 1
+}
 
 python - "$workdir/mfu_probe.json" "$workdir/profile_smoke.out" \
   "$workdir/events.jsonl" <<'PY'
@@ -312,6 +328,22 @@ if evs:
     end = [e for e in evs if e["event"] == "experiment_end"]
     if end and not isinstance(end[-1].get("metrics"), dict):
         fail.append("events.jsonl: experiment_end carries no metrics snapshot")
+    # (j) the analysis.violations counter must be EMBEDDED in the run's
+    # metrics snapshot (proof the pre-flight static analysis ran) and be 0.
+    if end and isinstance(end[-1].get("metrics"), dict):
+        av = end[-1]["metrics"].get("analysis.violations")
+        if av is None:
+            fail.append(
+                "events.jsonl: experiment_end metrics missing "
+                "analysis.violations (pre-flight static analysis not run?)"
+            )
+        elif av != 0:
+            fail.append(
+                f"events.jsonl: analysis.violations = {av} (static "
+                "invariant violations on the smoke config)"
+            )
+    if "analysis_check" not in kinds:
+        fail.append("events.jsonl: missing 'analysis_check' event")
 
 if fail:
     print("PERF SMOKE FAILED:")
@@ -324,6 +356,7 @@ print(
     "trace_attribution from one program agrees with the traced wall "
     "clock, no unflagged utilization > 1, events.jsonl schema valid, "
     "packing + bytes_on_wire rows present with the k-fold reduction and "
-    ">=1.5x HE speedups"
+    ">=1.5x HE speedups, hefl-lint clean with analysis.violations=0 "
+    "embedded in the run metrics"
 )
 PY

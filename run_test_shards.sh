@@ -30,6 +30,22 @@ if [ -n "$1" ]; then
   run "slow shard $1/$N" -m slow --shard "$1/$N"
   exit 0
 fi
+# Static-analysis pre-shard (ISSUE 8): source sweep, exact-integer region
+# lint, range certification of the full packing grid, and the hot-path
+# rem/div/f64/callback lint of the real round programs — the cheapest
+# whole-tree gate, so a reintroduced `lax.rem` or an unsafe packing
+# geometry fails in seconds, before any test compiles. The compile-heavy
+# scope-coverage stages run in the full-gate shard below.
+t0=$SECONDS
+python -m hefl_tpu.analysis --fast
+echo "== hefl-lint pre-shard (--fast): $((SECONDS - t0))s"
+if command -v ruff >/dev/null 2>&1; then
+  t0=$SECONDS
+  ruff check .
+  echo "== ruff: $((SECONDS - t0))s"
+else
+  echo "== ruff not installed; skipping the style pre-shard"
+fi
 run "fast tier" -m "not slow"
 # NTT-backend shard (ISSUE 4): re-run ONLY the CKKS-layer tests with every
 # supported ring routed through the Pallas kernel family (interpreted on
@@ -49,7 +65,15 @@ t0=$SECONDS
 HEFL_NTT=pallas-interpret python -m pytest -q -m "not slow" \
   tests/test_packing.py
 echo "== packing shard (pallas-interpret): $((SECONDS - t0))s"
+# Analysis shard (ISSUE 8): the FULL static-analysis gate — everything the
+# pre-shard ran plus the scope-coverage stages, which compile the real
+# round programs (both fusion backends + the secure round) and require
+# every provenance-carrying leaf compute op to resolve to a hefl.* phase
+# scope.
+t0=$SECONDS
+python -m hefl_tpu.analysis
+echo "== hefl-lint full gate: $((SECONDS - t0))s"
 for k in $(seq 1 "$N"); do
   run "slow shard $k/$N" -m slow --shard "$k/$N"
 done
-echo "== full suite green (fast + NTT-backend shard + $N slow shards)"
+echo "== full suite green (hefl-lint + fast + NTT-backend shard + $N slow shards)"

@@ -87,6 +87,12 @@ _SLOW_PATTERNS = (
     "test_entry.py::test_entry_compiles",
     "test_dp.py::test_secure_dp_round",
     "test_experiment.py::test_cli_dp_experiment",
+    # ISSUE 8: the compile-bearing static-analysis gates (round-program
+    # coverage compiles tiny real rounds; the secure variant also traces
+    # the encrypted program). The fast tier keeps the trace-only lint and
+    # every certification/fixture test.
+    "test_analysis.py::test_round_coverage_clean",
+    "test_analysis.py::test_secure_round_lint_and_coverage_clean",
 )
 
 

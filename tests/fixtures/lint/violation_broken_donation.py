@@ -1,0 +1,26 @@
+"""Golden violation: a declared donation that silently degrades to a copy.
+
+`donate_argnums` only aliases when some output matches the donated
+input's shape+dtype; here the donated f32 buffer can never alias the i32
+output, JAX emits only a warning, and the "donated" buffer is copied —
+doubling the resident footprint the donation was declared to halve. The
+fixture must make `hefl-lint --fixture` exit nonzero with a
+broken-donation finding.
+"""
+
+import jax
+import jax.numpy as jnp
+
+RULE = "broken-donation"
+
+
+def build():
+    @lambda f: jax.jit(f, donate_argnums=(0,))
+    def broken(state, x):
+        del state  # "consumed", but nothing of its shape/dtype is returned
+        return (x * 2).astype(jnp.int32)
+
+    return broken, (
+        jnp.zeros((16,), jnp.float32),
+        jnp.zeros((4,), jnp.float32),
+    )

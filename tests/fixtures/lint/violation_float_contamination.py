@@ -1,0 +1,21 @@
+"""Golden violation: a float32 round-trip inside an exact-integer region.
+
+A packed 62-bit field value pushed through float32 loses every bit past
+the 24-bit mantissa — exactly the corruption `encode_packed` exists to
+avoid. The fixture must make `hefl-lint --fixture` exit nonzero with a
+float-contamination finding.
+"""
+
+import jax.numpy as jnp
+
+RULE = "float-contamination"
+
+
+def build():
+    def bad_roundtrip(hi, lo):
+        # "Recombine then re-split via float" — shears bits 24..62.
+        v = hi.astype(jnp.float32) * (2.0**31) + lo.astype(jnp.float32)
+        return (v / (2.0**31)).astype(jnp.uint32)
+
+    z = jnp.zeros((8,), jnp.uint32)
+    return bad_roundtrip, (z, z)

@@ -1,0 +1,22 @@
+"""Golden violation: `lax.rem` inside a declared exact-integer region.
+
+The exact class of regression hefl-lint exists for — a refactor swapping
+the division-free Barrett reduction back to a hardware remainder. The
+fixture must make `hefl-lint --fixture` exit nonzero with a
+forbidden-primitive finding.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+RULE = "forbidden-primitive"
+
+
+def build():
+    p = jnp.uint32(2**27 - 39)
+
+    def bad_mod(x):
+        # The historical pre-PR-4 spelling: one hardware divide per element.
+        return lax.rem(x, jnp.broadcast_to(p, x.shape))
+
+    return bad_mod, (jnp.zeros((8,), jnp.uint32),)

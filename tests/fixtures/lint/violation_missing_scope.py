@@ -1,0 +1,23 @@
+"""Golden violation: a leaf compute op with no hefl.* phase scope.
+
+A GEMM traced outside every `jax.named_scope` block is invisible to
+trace attribution — its device time lands in the unattributed bucket.
+The fixture must make `hefl-lint --fixture` exit nonzero with a
+missing-scope finding (jaxpr layer AND compiled-HLO layer).
+"""
+
+import jax
+import jax.numpy as jnp
+
+RULE = "missing-scope"
+
+
+def build():
+    @jax.jit
+    def unscoped_gemm(x, w):
+        return jnp.tanh(x @ w)
+
+    return unscoped_gemm, (
+        jnp.zeros((4, 16), jnp.float32),
+        jnp.zeros((16, 8), jnp.float32),
+    )

@@ -1,0 +1,383 @@
+"""Static-analysis subsystem (ISSUE 8): interval ranges, lint, coverage.
+
+Covers the acceptance criteria directly: the range analyzer certifies
+every (b, k, C) the PR-6 grid tests exercise and the full supported
+PackingConfig grid, rejects a deliberately unsafe (b=16, k=4, C=1024)
+config with the offending op named; each seeded-violation fixture makes
+`hefl-lint` exit nonzero; the current tree lints clean; and the headroom
+formula's promotion to the range analysis fails loudly on divergence.
+"""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hefl_tpu.analysis import (
+    Allow,
+    AnalysisError,
+    Interval,
+    check_experiment,
+    certified_max_interleave,
+    certify_aggregation,
+    certify_packing,
+    coverage,
+    eval_jaxpr_ranges,
+    lint,
+)
+from hefl_tpu.analysis.cli import GRID_BITS, GRID_CLIENTS, GRID_GUARD
+from hefl_tpu.analysis.cli import main as lint_main
+from hefl_tpu.analysis.cli import run_fixture
+from hefl_tpu.ckks import quantize
+from hefl_tpu.ckks.keys import CkksContext
+from hefl_tpu.ckks.packing import PackedSpec
+from hefl_tpu.ckks.quantize import PackingConfig
+
+FIXTURES = os.path.join(
+    os.path.dirname(__file__), "fixtures", "lint"
+)
+
+
+@pytest.fixture(scope="module")
+def ring():
+    return CkksContext.create(n=256)
+
+
+# ------------------------------------------------ interval interpreter
+
+
+def test_interval_arithmetic_through_jaxpr():
+    def f(x):
+        y = jnp.clip(x * 3, -10, 50)          # [-10, 50]
+        z = (y.astype(jnp.int32) + 7) << 2    # [-12, 228]
+        return jnp.sum(z)                     # 4 elements: [-48, 912]
+
+    closed = jax.make_jaxpr(f)(jnp.zeros((4,), jnp.float32))
+    res = eval_jaxpr_ranges(closed, [Interval(-1000.0, 1000.0)])
+    assert not res.findings
+    out = res.out_intervals[0]
+    assert out.lo == -48 and out.hi == 912
+
+
+def test_dtype_overflow_cites_the_op():
+    def f(x):
+        return x * x                           # int32 square can wrap
+
+    closed = jax.make_jaxpr(f)(jnp.zeros((4,), jnp.int32))
+    res = eval_jaxpr_ranges(closed, [Interval(0, 2**20)])
+    assert len(res.findings) == 1
+    assert res.findings[0].op == "mul"
+    assert res.findings[0].kind == "dtype-overflow"
+
+
+def test_ceiling_check_fires_before_dtype():
+    def f(x):
+        return x << 10
+
+    closed = jax.make_jaxpr(f)(jnp.zeros((2,), jnp.int32))
+    res = eval_jaxpr_ranges(
+        closed, [Interval(0, 2**10)],
+        ceiling=Interval(0, 2**15),
+    )
+    assert [f.kind for f in res.findings] == ["ceiling"]
+    assert res.findings[0].op == "shift_left"
+
+
+def test_unknown_primitive_is_conservative_not_fatal():
+    def f(x):
+        return jax.lax.cumsum(jnp.sort(x), axis=0)
+
+    closed = jax.make_jaxpr(f)(jnp.zeros((4,), jnp.int32))
+    res = eval_jaxpr_ranges(closed, [Interval(0, 10)])
+    # sort passes through, cumsum multiplies; no crash either way.
+    assert res.out_intervals[0].hi >= 10
+
+
+# ------------------------------------------------ packing certification
+
+
+def test_certifies_every_pr6_grid_point(ring):
+    """Every (b, C) the PR-6 packing tests run must be statically
+    certified at the formula's k — the sampled tests become proofs."""
+    q = ring.modulus
+    for bits, clients in [(4, 2), (8, 2), (8, 16), (16, 2)]:
+        k = quantize.max_interleave(q, bits, clients, 16)
+        cert = certify_packing(q, bits, k, clients, 16)
+        assert cert.ok, cert.summary()
+
+
+def test_certifies_full_supported_grid(ring):
+    """The acceptance sweep: the whole supported PackingConfig grid
+    certifies at auto-k (and the divergence tripwire inside
+    max_interleave stayed silent for every point)."""
+    q = ring.modulus
+    points = 0
+    for bits in GRID_BITS:
+        for clients in GRID_CLIENTS:
+            try:
+                k = quantize.max_interleave(q, bits, clients, GRID_GUARD)
+            except ValueError:
+                continue
+            assert certify_packing(q, bits, k, clients, GRID_GUARD).ok
+            points += 1
+    assert points >= 15
+
+
+def test_rejects_unsafe_config_naming_the_op(ring):
+    cert = certify_packing(ring.modulus, 16, 4, 1024, 16)
+    assert not cert.ok
+    ops = {f.op for f in cert.findings}
+    assert "shift_left" in ops, cert.summary()
+    assert "shift_left" in cert.summary()
+
+
+def test_rejects_formula_k_plus_one(ring):
+    """On the default ring the 2**62 wall binds exactly, so the analyzer
+    and the closed form agree on BOTH sides of the boundary."""
+    q = ring.modulus
+    for bits, clients in [(8, 2), (4, 8), (16, 2)]:
+        k = quantize.max_interleave(q, bits, clients, 16)
+        assert certify_packing(q, bits, k, clients, 16).ok
+        assert not certify_packing(q, bits, k + 1, clients, 16).ok
+        assert certified_max_interleave(q, bits, clients, 16) == k
+
+
+def test_formula_divergence_raises_loudly(ring, monkeypatch):
+    import dataclasses
+
+    from hefl_tpu.analysis import ranges as ranges_mod
+
+    good = certify_packing(ring.modulus, 8, 1, 2, 16)
+    broken = dataclasses.replace(
+        good, ok=False,
+        findings=(ranges_mod.RangeFinding(
+            kind="ceiling", op="shift_left", eqn_index=0,
+            interval=Interval(0, 1), bound=Interval(0, 0),
+            message="synthetic divergence",
+        ),),
+    )
+    monkeypatch.setattr(
+        ranges_mod, "certify_packing", lambda *a, **k: broken
+    )
+    with pytest.raises(RuntimeError, match="disagree"):
+        quantize.max_interleave(ring.modulus, 8, 2, 16)
+
+
+def test_packedspec_rejects_unsafe_build_citing_op(ring):
+    tmpl = {"w": jnp.zeros((64,))}
+    with pytest.raises(ValueError, match="shift_left"):
+        PackedSpec.for_params(
+            tmpl, ring, PackingConfig(bits=16, interleave=4),
+            num_clients=1024,
+        )
+
+
+# ------------------------------------------------ aggregation certification
+
+
+def test_aggregation_certified_at_production_prime():
+    cert = certify_aggregation(2**27 - 39)
+    assert cert.ok, cert.summary()
+    assert cert.chunk == 32
+
+
+def test_aggregation_rejects_oversized_prime():
+    """A 31-bit prime breaks the lazy uint32 bound (32 summands wrap):
+    the MAX_PSUM_CLIENTS invariant is a provable fact, not folklore."""
+    cert = certify_aggregation((1 << 31) - 1)
+    assert not cert.ok
+    assert any(f.kind == "dtype-overflow" for f in cert.findings)
+
+
+# ------------------------------------------------ lint rules
+
+
+def test_exact_int_regions_lint_clean():
+    assert lint.lint_exact_regions() == []
+
+
+def test_source_sweep_clean_on_tree():
+    assert lint.source_sweep() == []
+
+
+def test_source_sweep_catches_remainder(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "def f(x, p):\n"
+        "    return jnp.remainder(x, p)\n"
+    )
+    found = lint.source_sweep(str(tmp_path))
+    assert len(found) == 1 and found[0].rule == "source-forbidden"
+    assert "jnp.remainder" in found[0].message
+
+
+def test_docstring_mention_does_not_trip_sweep(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text('"""Replaces `jnp.remainder` and lax.rem."""\nX = 1\n')
+    assert lint.source_sweep(str(tmp_path)) == []
+
+
+def test_allowlist_scoping():
+    p = jnp.uint32(97)
+
+    def modfn(x):
+        return jax.lax.rem(x, jnp.broadcast_to(p, x.shape))
+
+    args = (jnp.zeros((8,), jnp.uint32),)
+    hit = lint.lint_fn(modfn, args, "my.region", exact_int=True, allow=())
+    assert any(f.rule == "forbidden-primitive" for f in hit)
+    allowed = lint.lint_fn(
+        modfn, args, "my.region", exact_int=True,
+        allow=(Allow("my.*", "forbidden-primitive", "rem", "test"),),
+    )
+    assert allowed == []
+    # max_size qualifier: an 8-element rem does NOT fit a size-1 entry.
+    still = lint.lint_fn(
+        modfn, args, "my.region", exact_int=True,
+        allow=(Allow("my.*", "forbidden-primitive", "rem", "t", max_size=1),),
+    )
+    assert any(f.rule == "forbidden-primitive" for f in still)
+
+
+def test_host_callback_rule():
+    def f(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct((4,), jnp.float32), x
+        )
+
+    found = lint.lint_fn(
+        f, (jnp.zeros((4,), jnp.float32),), "hot", exact_int=False
+    )
+    assert any(f_.rule == "host-callback" for f_ in found)
+
+
+def test_donation_good_and_broken():
+    good = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+    z = jnp.zeros((8,), jnp.float32)
+    assert lint.check_donation(good, (z, z), "good") == []
+    fn, args = run_fixture_build("violation_broken_donation.py")
+    assert lint.check_donation(fn, args, "broken") != []
+
+
+def run_fixture_build(name):
+    import importlib.util
+
+    path = os.path.join(FIXTURES, name)
+    spec = importlib.util.spec_from_file_location(name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.build()
+
+
+# ------------------------------------------------ fixtures drive the CLI
+
+
+@pytest.mark.parametrize(
+    "fixture", sorted(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(FIXTURES, "violation_*.py"))
+    )
+)
+def test_each_violation_fixture_fails_hefl_lint(fixture):
+    path = os.path.join(FIXTURES, fixture)
+    findings = run_fixture(path)
+    assert findings, f"{fixture} produced no findings"
+    declared = findings[0].rule
+    assert fixture.startswith(
+        "violation_" + declared.replace("-", "_")
+    ) or declared in fixture.replace("_", "-")
+    # and through the CLI: nonzero exit is the CI contract.
+    assert lint_main(["--fixture", path, "--json"]) == 1
+
+
+def test_fixture_count_covers_all_four_rules():
+    rules = set()
+    for p in glob.glob(os.path.join(FIXTURES, "violation_*.py")):
+        src = open(p).read()
+        for rule in ("forbidden-primitive", "float-contamination",
+                     "missing-scope", "broken-donation"):
+            if f'RULE = "{rule}"' in src:
+                rules.add(rule)
+    assert rules == {
+        "forbidden-primitive", "float-contamination",
+        "missing-scope", "broken-donation",
+    }
+
+
+# ------------------------------------------------ coverage
+
+
+def test_coverage_passes_scoped_and_flags_unscoped():
+    from hefl_tpu.obs import scopes as obs_scopes
+
+    @jax.jit
+    def scoped(x, w):
+        with jax.named_scope(obs_scopes.SGD_CORE):
+            return x @ w
+
+    args = (jnp.zeros((4, 8)), jnp.zeros((8, 4)))
+    assert coverage.check_fn_coverage(scoped, args, "scoped") == []
+    fn, fargs = run_fixture_build("violation_missing_scope.py")
+    found = coverage.check_fn_coverage(fn, fargs, "unscoped")
+    assert any(f.rule == "missing-scope" for f in found)
+
+
+def test_round_program_lint_clean_plaintext():
+    assert lint.lint_round_programs(fusion="vmap", secure=False) == []
+
+
+@pytest.mark.parametrize("fusion", ["vmap", "fused"])
+def test_round_coverage_clean(fusion):
+    assert coverage.check_round_coverage(fusion=fusion) == []
+
+
+def test_secure_round_lint_and_coverage_clean():
+    assert lint.lint_round_programs(fusion="vmap", secure=True) == []
+    assert coverage.check_round_coverage(fusion="vmap", secure=True) == []
+
+
+def test_tree_donations_hold():
+    assert lint.check_tree_donations() == []
+
+
+# ------------------------------------------------ check_experiment wiring
+
+
+def test_check_experiment_clean_and_counted():
+    from hefl_tpu.experiment import ExperimentConfig, HEConfig
+    from hefl_tpu.obs import metrics as obs_metrics
+
+    cfg = ExperimentConfig(
+        model="logreg", dataset="mnist", num_clients=2,
+        he=HEConfig(n=256), packing=PackingConfig(bits=8),
+    )
+    base = obs_metrics.snapshot().get("analysis.violations", 0)
+    report = check_experiment(cfg)
+    assert report["aggregation"].ok
+    assert report["packing"].ok and report["packing"].bits == 8
+    snap = obs_metrics.snapshot()
+    assert snap["analysis.violations"] == base  # clean: +0, but present
+
+
+def test_check_experiment_rejects_unsafe_packing():
+    from hefl_tpu.experiment import ExperimentConfig, HEConfig
+
+    cfg = ExperimentConfig(
+        model="logreg", dataset="mnist", num_clients=1024,
+        he=HEConfig(n=256),
+        packing=PackingConfig(bits=16, interleave=4),
+    )
+    with pytest.raises(AnalysisError, match="shift_left"):
+        check_experiment(cfg)
+
+
+def test_plaintext_experiment_skips_he_analysis():
+    from hefl_tpu.experiment import ExperimentConfig
+
+    cfg = ExperimentConfig(model="logreg", encrypted=False)
+    report = check_experiment(cfg)
+    assert report["aggregation"] is None and report["packing"] is None
