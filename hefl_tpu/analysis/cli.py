@@ -21,7 +21,8 @@ Stages of the full gate, each a CI failure on findings:
      secure included): integer rem/div, f64, host callbacks
   5. donation — declared `donate_argnums` sites actually alias
   6. scope coverage — every leaf compute op phase-attributed (jaxpr +
-     compiled HLO, both fusion backends, secure included)
+     compiled HLO, both fusion backends, secure included, plus the
+     streaming upload program the durable aggregation server dispatches)
 
 Fixture protocol (tests/fixtures/lint/*.py): the module defines `RULE`
 (one of forbidden-primitive | float-contamination | missing-scope |
@@ -144,6 +145,10 @@ def run_tree_gate(fast: bool = False, progress=print) -> list:
         stage(
             "scope coverage [secure]",
             lambda: coverage.check_round_coverage(fusion="vmap", secure=True),
+        )
+        stage(
+            "scope coverage [stream/server]",
+            lambda: coverage.check_stream_coverage(fusion="vmap"),
         )
     return findings
 

@@ -159,6 +159,36 @@ def check_round_coverage(
     )
 
 
+def check_stream_coverage(*, fusion: str = "vmap") -> list[LintFinding]:
+    """The aggregation SERVER's round program: the streaming upload
+    producer (fl.stream._build_upload_fn — train/sanitize/encrypt per
+    client, no psum tail), which is the compute the durable service
+    (fl.server) dispatches every round. Same scope rule as the batched
+    round programs: every leaf GEMM/conv phase-attributed."""
+    import jax
+    import jax.numpy as jnp
+
+    from hefl_tpu.analysis.lint import _tiny_round_inputs
+    from hefl_tpu.ckks.keys import CkksContext, keygen
+    from hefl_tpu.fl import TrainConfig
+    from hefl_tpu.fl.stream import _build_upload_fn
+
+    module, params, mesh, gp, xs, ys, keys = _tiny_round_inputs()
+    cfg = TrainConfig(
+        epochs=1, batch_size=4, num_classes=10, val_fraction=0.25,
+        client_fusion=fusion,
+    )
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(2))
+    fn = _build_upload_fn(module, cfg, mesh, ctx, None, 2, None)
+    part = jnp.ones((2,), jnp.int32)
+    pois = jnp.zeros((2,), jnp.int32)
+    return check_fn_coverage(
+        fn, (gp, pk, xs, ys, keys, keys, part, pois),
+        f"fl.stream.upload[{fusion}]",
+    )
+
+
 __all__ = [
     "LEAF_PRIMS",
     "LEAF_OPCODES",
@@ -166,4 +196,5 @@ __all__ = [
     "leaf_scope_findings",
     "check_fn_coverage",
     "check_round_coverage",
+    "check_stream_coverage",
 ]

@@ -14,6 +14,7 @@ import os
 
 from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
 from hefl_tpu.fl import (
+    CrashConfig,
     DpConfig,
     FaultConfig,
     PackingConfig,
@@ -182,6 +183,41 @@ def build_parser() -> argparse.ArgumentParser:
                    help="base backoff between delivery retries")
     p.add_argument("--stream-seed", type=int, default=0,
                    help="PRNG seed of cohort sampling and retry jitter")
+    # --- durable aggregation service (fl/journal.py + fl/server.py,
+    # README "Durable aggregation & crash recovery") ---
+    p.add_argument("--serve", action="store_true",
+                   help="recover-then-serve lifecycle: wrap the streaming "
+                        "engine in a write-ahead round journal (default "
+                        "path next to --checkpoint) and auto-resume from "
+                        "an existing checkpoint — re-running the same "
+                        "command after a crash recovers exactly")
+    p.add_argument("--journal-path", default=None, metavar="PATH",
+                   help="write-ahead round journal (fl.journal): every "
+                        "engine transition is durable and a restarted "
+                        "server replays it to the bitwise state of an "
+                        "uninterrupted run; requires a streaming knob")
+    p.add_argument("--fsync-policy", default=None,
+                   choices=["always", "commit", "never"],
+                   help="journal fsync policy: every append / transaction "
+                        "boundaries (commit, degrade, round_close) / "
+                        "OS-paced. Default: HEFL_JOURNAL_FSYNC, else "
+                        "'commit'")
+    p.add_argument("--crash-round", type=int, default=None, metavar="R",
+                   help="crash injection: simulate a server process crash "
+                        "during round R (requires the journal). Re-running "
+                        "WITHOUT the crash flags always recovers; an armed "
+                        "mid_append/pre_commit crash (whose record never "
+                        "landed) fires again on every run")
+    p.add_argument("--crash-at", default="post_fold",
+                   choices=["mid_append", "post_fold", "pre_commit",
+                            "post_commit", "post_close"],
+                   help="crash injection boundary: mid-journal-append "
+                        "(leaves a REAL torn record), after the Nth fold, "
+                        "before/after the commit record, or after the "
+                        "round seals (before its checkpoint)")
+    p.add_argument("--crash-after-folds", type=int, default=1, metavar="N",
+                   help="which fold (1-based) triggers "
+                        "mid_append/post_fold crashes")
     p.add_argument("--dp-min-surviving", type=int, default=0, metavar="K",
                    help="dp noise floor: calibrate each client's noise "
                         "share to K surviving clients (conservative "
@@ -277,6 +313,26 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             "--permanent-clients are consumed by the streaming engine; "
             "add --stream (or another streaming knob) to enable it"
         )
+    if (args.journal_path or args.serve) and not want_stream:
+        # The journal records streaming-engine transitions; without a
+        # streaming knob it would SILENTLY provide no durability — the
+        # worst failure mode for a flag named --serve.
+        raise SystemExit(
+            "--journal-path/--serve wrap the streaming engine; add "
+            "--stream (or another streaming knob) to enable it"
+        )
+    if args.crash_round is not None and not (args.journal_path or args.serve):
+        raise SystemExit(
+            "--crash-round without a write-ahead journal is just data "
+            "loss; add --journal-path PATH or --serve"
+        )
+    if args.crash_round is None and (
+        args.crash_at != "post_fold" or args.crash_after_folds != 1
+    ):
+        raise SystemExit(
+            "--crash-at/--crash-after-folds have no effect without "
+            "--crash-round R; add it to arm the crash injection"
+        )
     if args.dp_min_surviving > 0 and args.dp_noise <= 0:
         # Same silent-no-op guard: a declared noise floor without dp
         # enabled would be dropped without a word.
@@ -340,6 +396,18 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         ),
         faults=faults,
         stream=stream,
+        journal_path=args.journal_path,
+        fsync_policy=args.fsync_policy,
+        serve=args.serve,
+        crash=(
+            CrashConfig(
+                round=args.crash_round,
+                at=args.crash_at,
+                after_folds=args.crash_after_folds,
+            )
+            if args.crash_round is not None
+            else None
+        ),
         max_round_retries=args.max_round_retries,
         retry_backoff_s=args.retry_backoff,
         events_path=args.events,

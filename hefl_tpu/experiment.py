@@ -46,7 +46,12 @@ from hefl_tpu.fl import (
     secure_fedavg_round,
     train_centralized,
 )
-from hefl_tpu.fl.faults import POISON_HUGE, POISON_NAN, record_round_meta
+from hefl_tpu.fl.faults import (
+    POISON_HUGE,
+    POISON_NAN,
+    CrashConfig,
+    record_round_meta,
+)
 from hefl_tpu.fl.fedavg import masked_mode, pad_federated
 from hefl_tpu.models import count_params, create_model
 from hefl_tpu.obs import events as obs_events
@@ -141,6 +146,26 @@ class ExperimentConfig:
     # "" = disabled for this run. HEFL_EVENTS=0 disables globally without
     # code changes (the test suite sets it).
     events_path: str | None = None
+    # Durable aggregation service (fl.journal / fl.server): a write-ahead
+    # round journal recording every streaming-engine transition, with
+    # crash-anywhere recovery — on restart the server replays the journal,
+    # re-folds persisted uploads, and reaches the bitwise state of an
+    # uninterrupted run. Streaming runs only. None = the in-memory engine.
+    journal_path: str | None = None
+    # Journal fsync policy: "always" (every append), "commit" (transaction
+    # boundaries — commit/degrade/round_close), "never" (OS-paced).
+    # None defers to HEFL_JOURNAL_FSYNC, then "commit" — so the env
+    # override reaches driver/CLI runs that never set the knob.
+    fsync_policy: str | None = None
+    # Recover-then-serve lifecycle: implies a journal (defaulted next to
+    # the checkpoint when journal_path is unset) and auto-resumes from an
+    # existing round checkpoint — re-running the same command after a
+    # crash picks up exactly where the journal left off.
+    serve: bool = False
+    # Deterministic process-crash injection (fl.faults.CrashConfig): the
+    # journal session raises SimulatedCrash at the configured boundary.
+    # Requires the journal (a crash without a WAL is just data loss).
+    crash: "CrashConfig | None" = None
 
 
 def _train_roofline_inputs(module, params, train_cfg: TrainConfig,
@@ -248,6 +273,20 @@ def run_experiment(
             "streaming quorum aggregation runs on the encrypted federated "
             "path; remove --plaintext/--centralized or drop the stream "
             "config"
+        )
+    if (cfg.journal_path or cfg.serve) and cfg.stream is None:
+        # The journal records STREAMING-engine transitions; a synchronous
+        # run has none, and silently running without durability would be
+        # the worst failure mode for a flag named --serve.
+        raise ValueError(
+            "the durable aggregation journal/--serve wraps the streaming "
+            "engine; add a stream config (--stream) or drop "
+            "journal_path/serve"
+        )
+    if cfg.crash is not None and not (cfg.journal_path or cfg.serve):
+        raise ValueError(
+            "crash injection without a write-ahead journal is just data "
+            "loss; add journal_path (--journal-path) or serve (--serve)"
         )
     if (
         cfg.dp is not None
@@ -458,6 +497,20 @@ def run_experiment(
                 f"{pspec.error_budget:.2e}"
             )
 
+    if cfg.serve and not resume and cfg.checkpoint_path:
+        # Recover-then-serve: re-running the same command after a crash
+        # must pick up where the journal left off, so an existing round
+        # checkpoint auto-resumes (the journal replays the open round on
+        # top of the restored params/RNG).
+        ck_file = (
+            cfg.checkpoint_path
+            if cfg.checkpoint_path.endswith(".npz")
+            else cfg.checkpoint_path + ".npz"
+        )
+        if os.path.exists(ck_file):
+            resume = True
+            say(f"serve: auto-resuming from {cfg.checkpoint_path}")
+
     start_round = 0
     if resume:
         if not cfg.checkpoint_path:
@@ -494,10 +547,49 @@ def run_experiment(
     # RoundMeta, so they ride the robust unpack/record path.
     streaming = cfg.stream is not None
     engine = None
+    server = None
     if streaming:
-        from hefl_tpu.fl import StreamEngine
+        jp = cfg.journal_path
+        if cfg.serve and not jp:
+            # Serve mode defaults the journal next to the checkpoint —
+            # the "durable artifacts of this run" directory.
+            jp = os.path.join(
+                os.path.dirname(cfg.checkpoint_path) or "."
+                if cfg.checkpoint_path
+                else ".",
+                "journal.wal",
+            )
+        if jp:
+            # Durable aggregation service: the engine wrapped in the
+            # recover-then-serve write-ahead-journal lifecycle
+            # (fl.server). Construction IS recovery — a journal left by
+            # a crashed process is replayed here, torn tail truncated,
+            # carried uploads and the dedup window rebuilt.
+            from hefl_tpu.fl import AggregationServer
 
-        engine = StreamEngine(cfg.stream, cfg.faults)
+            engine = server = AggregationServer(
+                cfg.stream, cfg.faults, journal_path=jp,
+                fsync_policy=cfg.fsync_policy, crash=cfg.crash,
+            )
+            rec = server.recovered
+            if not rec.fresh_journal:
+                say(
+                    f"journal {jp}: recovered {rec.records} records "
+                    f"(sealed rounds {list(rec.sealed_rounds)}, open "
+                    f"round {rec.open_round}, {rec.carried_uploads} "
+                    f"carried uploads"
+                    + (
+                        f", torn tail of {rec.torn_bytes_truncated} bytes "
+                        "truncated"
+                        if rec.torn_bytes_truncated
+                        else ""
+                    )
+                    + ")"
+                )
+        else:
+            from hefl_tpu.fl import StreamEngine
+
+            engine = StreamEngine(cfg.stream, cfg.faults)
         robust = True
     dp_sample_rate = 1.0
     if streaming and 0 < cfg.stream.cohort_size < cfg.num_clients:
@@ -649,6 +741,20 @@ def run_experiment(
                 params = new_params
                 break
             except RuntimeError as e:
+                from hefl_tpu.fl.faults import SimulatedCrash
+                from hefl_tpu.fl.journal import JournalError
+
+                if isinstance(e, (SimulatedCrash, JournalError)):
+                    # Not retryable in-process: SimulatedCrash models the
+                    # PROCESS dying (its journal writer is already closed;
+                    # recovery is a fresh run's job), and a JournalError
+                    # is the fail-loud verdict — retrying would append
+                    # fresh records over divergent/damaged history.
+                    obs_events.emit(
+                        "round_failed", round=r, error=type(e).__name__,
+                        attempts=attempt + 1,
+                    )
+                    raise
                 if attempt >= cfg.max_round_retries:
                     obs_events.emit(
                         "round_failed", round=r, error=type(e).__name__,
@@ -824,6 +930,11 @@ def run_experiment(
             obs_events.emit(
                 "checkpoint_save", round=r, path=cfg.checkpoint_path
             )
+            if server is not None:
+                # The checkpoint now covers everything before round r+1:
+                # compact the journal down to the records recovery can
+                # still need (round r's carries/close + open work).
+                server.compact_to(r + 1)
 
     if cfg.save_model_path:
         # The aggregated-model artifact the reference always writes
@@ -835,11 +946,16 @@ def run_experiment(
     from hefl_tpu.data.augment import backend_report
     from hefl_tpu.fl.fusion import fusion_report
 
+    if server is not None:
+        server.close()
     obs_record = _finish_run_obs(metrics_base, rounds=len(history))
     return {
         "history": history,
         "final_metrics": history[-1] if history else None,
         "params": params,
+        # Durable-aggregation record (None = in-memory engine): journal
+        # path, fsync policy, and what recovery found on startup.
+        "journal": server.report() if server is not None else None,
         # Which augment row-shift backend the round programs traced with
         # (incl. auto-selection micro-timings when in "auto" mode).
         "augment_backend": backend_report(),

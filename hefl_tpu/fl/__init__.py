@@ -24,10 +24,12 @@ from hefl_tpu.fl.dp import (
 )
 from hefl_tpu.fl.faults import (
     ArrivalFaults,
+    CrashConfig,
     DeviceLost,
     FaultConfig,
     RoundFaults,
     RoundMeta,
+    SimulatedCrash,
     schedule_arrivals,
     schedule_for_round,
 )
@@ -42,7 +44,9 @@ from hefl_tpu.fl.secure import (
     encrypt_stack_packed,
     secure_fedavg_round,
 )
+from hefl_tpu.fl.server import AggregationServer
 from hefl_tpu.fl.stream import (
+    DedupWindow,
     OnlineAccumulator,
     StreamEngine,
     StreamRoundMeta,
@@ -56,7 +60,11 @@ __all__ = [
     "StreamConfig",
     "TrainConfig",
     "DpConfig",
+    "AggregationServer",
+    "CrashConfig",
+    "DedupWindow",
     "DeviceLost",
+    "SimulatedCrash",
     "ArrivalFaults",
     "FaultConfig",
     "RoundFaults",

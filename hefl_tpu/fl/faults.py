@@ -76,6 +76,56 @@ class DeviceLost(RuntimeError):
     path without real hardware failure."""
 
 
+class SimulatedCrash(RuntimeError):
+    """Deterministic process-crash injection (CrashConfig): raised by the
+    journal session (fl.journal.RoundSession) at the configured boundary,
+    after any configured torn-frame prefix has been written — the
+    in-memory server state is then abandoned exactly as a SIGKILL would
+    abandon it, and only the write-ahead journal survives."""
+
+
+# The injectable crash boundaries, in round-lifecycle order. "mid_append"
+# kills the process MID-write of the Nth fold's journal frame, leaving a
+# REAL torn record on disk (the recovery path must truncate it);
+# "post_fold" kills after that frame landed; "pre_commit"/"post_commit"
+# bracket the round's commit record; "post_close" lands between the
+# sealed round and its checkpoint.
+CRASH_POINTS = (
+    "mid_append", "post_fold", "pre_commit", "post_commit", "post_close"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CrashConfig:
+    """Deterministic process-crash injection for the durable aggregation
+    server (fl.server / fl.journal). One crash per process: the journal
+    session raises SimulatedCrash at the configured boundary of the
+    configured round; a recovering process runs with crash=None (or a
+    later boundary) and must reach the bitwise state of an uninterrupted
+    run — the kill-at-every-boundary matrix in tests/test_journal.py.
+
+    round:        round index whose lifecycle hosts the crash.
+    at:           one of CRASH_POINTS (see above).
+    after_folds:  which fold (1-based) triggers mid_append/post_fold.
+    torn_bytes:   prefix length of the torn frame mid_append leaves.
+    """
+
+    round: int = 0
+    at: str = "post_fold"
+    after_folds: int = 1
+    torn_bytes: int = 24
+
+    def __post_init__(self):
+        if self.at not in CRASH_POINTS:
+            raise ValueError(
+                f"CrashConfig.at={self.at!r}: must be one of {CRASH_POINTS}"
+            )
+        if self.after_folds < 1:
+            raise ValueError("CrashConfig.after_folds must be >= 1")
+        if self.torn_bytes < 1:
+            raise ValueError("CrashConfig.torn_bytes must be >= 1")
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultConfig:
     """Deterministic fault-injection schedule (frozen => hashable, can ride

@@ -223,6 +223,59 @@ def ct_hash(c0, c1) -> str:
     return h.hexdigest()
 
 
+class DedupWindow:
+    """Bounded dedup nonce window: the engine's idempotence memory.
+
+    A (client, round) nonce must stay live exactly as long as a duplicate
+    delivery of it could still arrive: its upload can trail at most tau
+    rounds behind its origin (the bounded-staleness budget) plus the
+    commit round itself, so `advanced(r, tau)` keeps a nonce iff
+    `r - origin_round <= tau + 1` and drops the rest. Size is therefore
+    bounded by (tau + 2) x cohort uploads however long the service runs —
+    the unbounded-set growth a multi-day run must not have — and the
+    conservation property (no LIVE nonce is ever evicted early) is pinned
+    by tests/test_stream.py::test_dedup_window_conservation.
+
+    `advanced` returns a NEW window (the engine's transactional
+    cross-round state: a failed round must leave the previous window
+    untouched for the retry). Serialization for the journal's round_close
+    record is plain iteration (sorted nonce pairs).
+    """
+
+    __slots__ = ("_nonces",)
+
+    def __init__(self, nonces=()):
+        self._nonces = {tuple(n) for n in nonces}
+
+    def advanced(self, round_index: int, tau: int) -> "DedupWindow":
+        """The window as round `round_index` sees it: expired nonces
+        (older than the duplicate-reachability horizon tau + 1) evicted,
+        live ones all kept. A new instance — transactional."""
+        return DedupWindow(
+            n for n in self._nonces
+            if int(round_index) - int(n[1]) <= int(tau) + 1
+        )
+
+    def add(self, nonce) -> None:
+        self._nonces.add(tuple(nonce))
+
+    def __contains__(self, nonce) -> bool:
+        return tuple(nonce) in self._nonces
+
+    def __iter__(self):
+        return iter(self._nonces)
+
+    def __len__(self) -> int:
+        return len(self._nonces)
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, DedupWindow):
+            return self._nonces == other._nonces
+        if isinstance(other, (set, frozenset)):
+            return self._nonces == {tuple(n) for n in other}
+        return NotImplemented
+
+
 # ---------------------------------------------------------------------------
 # Upload producer: one SPMD program -> per-client encrypted uploads + bits.
 # ---------------------------------------------------------------------------
@@ -442,7 +495,9 @@ class StreamEngine:
         self.stream = stream
         self.faults = faults
         self._pending: list[PendingUpload] = []   # land next round
-        self._seen: set = set()                   # dedup nonce window
+        # Dedup nonce window, bounded to the duplicate-reachability
+        # horizon (tau + 1 rounds past a nonce's origin) — see DedupWindow.
+        self._seen: DedupWindow = DedupWindow()
 
     # -- deterministic retry timeline --------------------------------------
 
@@ -479,11 +534,18 @@ class StreamEngine:
         dp=None,
         packing=None,
         num_real_clients: int | None = None,
+        session=None,
     ):
         """-> (Ciphertext sum, metrics [C, E, 4], overflow [C],
         StreamRoundMeta). meta.meta.surviving is the decode denominator;
         0 (or committed=False) means nothing was released this round and
-        the driver keeps the global model."""
+        the driver keeps the global model.
+
+        `session` (fl.journal.RoundSession, optional) is the durability
+        hook: every engine transition is journaled through it (live mode)
+        or VERIFIED against the journal and — for folds — re-fed the
+        persisted upload bytes (replay mode, the server's crash
+        recovery). None keeps the historical in-memory-only engine."""
         s = self.stream
         if dp is not None and s.staleness_rounds > 0:
             # A carried upload lets one client contribute to a release
@@ -508,6 +570,17 @@ class StreamEngine:
         in_cohort[cohort] = True
         qcount = quorum_count(s, len(cohort))
         tau = int(s.staleness_rounds)
+        if session is not None:
+            # WAL discipline: the round's identity (index, PRNG key,
+            # cohort, quorum geometry) is durable before any work — a
+            # recovering process re-derives the identical round and the
+            # session verifies it against this record.
+            session.round_open(
+                round_index,
+                np.asarray(jax.random.key_data(key)).reshape(-1).tolist(),
+                cohort, qcount, tau, num_clients,
+                int(packing.clients) if packing is not None else None,
+            )
 
         if self.faults is not None:
             sched = schedule_for_round(self.faults, round_index, num_clients)
@@ -550,7 +623,7 @@ class StreamEngine:
         # retry, not half-consumed.
         # Dedup window: nonces stay live while a duplicate could still
         # arrive (the staleness budget bounds how far one can trail).
-        seen = {n for n in self._seen if round_index - n[1] <= tau + 1}
+        seen = self._seen.advanced(round_index, tau)
         pending_next: list[PendingUpload] = []
 
         # ---- build this round's delivery timeline ------------------------
@@ -573,7 +646,11 @@ class StreamEngine:
             transient = bool(arr is not None and arr.transient[c])
             if permanent:
                 # Every delivery fails; the engine still pays the retries.
-                retries_made += len(self._retry_times(round_index, c, t0))
+                times = self._retry_times(round_index, c, t0)
+                retries_made += len(times)
+                if session is not None:
+                    for i, rt in enumerate(times):
+                        session.retry(round_index, c, nonce, i + 1, rt)
                 bits[c] |= EXCLUDED_UNREACHABLE
                 unreachable += 1
                 continue
@@ -584,6 +661,8 @@ class StreamEngine:
                     unreachable += 1
                     continue
                 retries_made += 1
+                if session is not None:
+                    session.retry(round_index, c, nonce, 1, retry_at[0])
                 events.append(_Delivery(
                     t=float(retry_at[0]), seq=seq, kind="fresh", client=int(c),
                     nonce=nonce, retried=True,
@@ -626,6 +705,14 @@ class StreamEngine:
             if ev.kind == "stale":
                 up = ev.pending
                 if committed_at is None and headroom_ok:
+                    if session is not None:
+                        # Content-hash only: the bytes are already durable
+                        # in the origin round's carry record.
+                        session.fold(
+                            round_index, ev.seq, "stale", up.client,
+                            up.nonce, up.lateness, ev.t, up.c0, up.c1,
+                            persist=False,
+                        )
                     acc.fold(("stale",) + up.nonce, up.c0, up.c1)
                     stale_folded += 1
                     folded_clients.append(up.client)
@@ -639,6 +726,11 @@ class StreamEngine:
                 else:
                     if committed_at is None and not headroom_ok:
                         headroom_blocked += 1
+                    if session is not None:
+                        session.miss(
+                            round_index, ev.seq, "stale", up.client,
+                            up.nonce, ev.t, up.lateness,
+                        )
                     missed.append((
                         "stale", up.client, ev.t, up.lateness,
                         up.c0, up.c1, up.nonce,
@@ -646,11 +738,15 @@ class StreamEngine:
                 continue
             arrivals += 1
             if ev.nonce in seen:
+                if session is not None:
+                    session.dedup(round_index, ev.seq, ev.client, ev.nonce)
                 acc.duplicates += 1
                 continue
             seen.add(ev.nonce)
             c = ev.client
             if prog_bits[c] & _REJECT_MASK:
+                if session is not None:
+                    session.reject(round_index, ev.seq, c, ev.nonce)
                 rejected += 1
                 continue
             if (
@@ -658,7 +754,17 @@ class StreamEngine:
                 and (ev.t <= deadline or ev.retried)
                 and headroom_ok
             ):
-                acc.fold(ev.nonce, c0[c], c1[c])
+                fc0, fc1 = c0[c], c1[c]
+                if session is not None:
+                    # Persist the arrived upload; on replay the session
+                    # hands back the JOURNAL's bytes (content-hash
+                    # verified against this re-derived upload) and the
+                    # accumulator re-folds exactly what was journaled.
+                    fc0, fc1 = session.fold(
+                        round_index, ev.seq, "fresh", c, ev.nonce, 0,
+                        ev.t, c0[c], c1[c], persist=True,
+                    )
+                acc.fold(ev.nonce, fc0, fc1)
                 fresh += 1
                 folded_clients.append(c)
                 fresh_used.append((c, ev.t))
@@ -668,6 +774,10 @@ class StreamEngine:
             else:
                 if committed_at is None and not headroom_ok:
                     headroom_blocked += 1
+                if session is not None:
+                    session.miss(
+                        round_index, ev.seq, "fresh", c, ev.nonce, ev.t, 0
+                    )
                 missed.append((
                     "fresh", c, ev.t, 0, c0[c], c1[c], ev.nonce,
                 ))
@@ -692,6 +802,19 @@ class StreamEngine:
                 committed = False
                 degraded_reason = "dp_floor"
                 obs_metrics.counter("stream.dp_floor_degraded").inc()
+        if session is not None:
+            # The transaction's verdict record. On replay the re-derived
+            # canonical-sum sha256 must MATCH the journaled one — the
+            # recovered-equals-uninterrupted bitwise gate, enforced at
+            # every recovery, not just in tests.
+            if committed:
+                sc0, sc1 = acc.value(like_shape=row_shape)
+                session.commit(
+                    round_index, ct_hash(sc0, sc1), acc.folded, fresh,
+                    stale_folded, commit_s,
+                )
+            else:
+                session.degrade(round_index, degraded_reason, fresh, qcount)
 
         # ---- misses: carry under the staleness budget, or drop -----------
         carried = 0
@@ -806,6 +929,22 @@ class StreamEngine:
             # synchronous driver's straggler sleep.
             with jax.profiler.TraceAnnotation(obs_scopes.QUORUM_WAIT):
                 time.sleep(float(commit_s) * s.time_scale)
+
+        if session is not None:
+            # Stale carries (payload-bearing: a carried upload must
+            # survive a crash even though its origin round's producer key
+            # is gone) and the round_close seal — the durable half of the
+            # transactional state commit below. The close record carries
+            # the post-round dedup window so a compacted journal can
+            # rebuild it without the dropped rounds' fold records.
+            for up in pending_next:
+                session.carry(
+                    round_index, up.client, up.origin_round, up.nonce,
+                    up.lands_at, up.lateness, up.c0, up.c1,
+                )
+            session.close(
+                round_index, committed, surviving, meta.excluded, seen
+            )
 
         # Commit the transactional cross-round state — only a round that
         # ran to completion updates it; a raise anywhere above leaves the

@@ -34,7 +34,11 @@ The writer is process-global (`configure` + module-level `emit`) so deep
 producers (fl.faults, utils.autoselect, the compile listener) need no
 plumbing; `HEFL_EVENTS=0` disables every write without code changes (the
 test suite and short CLI runs set it). Appending is line-buffered append
-— a crashed run keeps every line emitted before the crash.
+— a crashed run keeps every line emitted before the crash, and a crash
+MID-append (a torn final line with no trailing newline) is repaired on
+reopen: the torn line is truncated and a `torn_tail_recovered` event
+records the removal, so `read_events(strict=True)` stays loud about real
+corruption without being poisoned forever by one killed write.
 
 The file is SIZE-CAPPED: when an emit would push it past
 `HEFL_EVENTS_MAX_BYTES` (default 64 MiB; 0 disables the cap) the current
@@ -132,9 +136,45 @@ def _jsonable(obj: Any):
     return str(obj)
 
 
+def _repair_torn_tail(path: str) -> int:
+    """Truncate a torn final line (no trailing newline) left by a crashed
+    writer mid-append. Every complete emit is one `\\n`-terminated line,
+    so a file not ending in `\\n` can only be a torn write; truncating
+    back to the last newline restores a strictly-parseable log instead of
+    poisoning `read_events(strict=True)` forever. -> bytes removed."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return 0
+    if size == 0:
+        return 0
+    with open(path, "rb") as f:
+        f.seek(size - 1)
+        if f.read(1) == b"\n":
+            return 0
+        # Scan backwards for the last newline (a torn line can exceed any
+        # fixed tail-chunk size, so walk in blocks).
+        keep = 0
+        pos = size - 1
+        block = 65536
+        while pos > 0:
+            start = max(0, pos - block)
+            f.seek(start)
+            chunk = f.read(pos - start)
+            nl = chunk.rfind(b"\n")
+            if nl >= 0:
+                keep = start + nl + 1
+                break
+            pos = start
+    os.truncate(path, keep)
+    return size - keep
+
+
 class EventLog:
     """Append-only JSONL writer. Opens lazily on first emit; one instance
-    per run file (use `configure` for the process-global log)."""
+    per run file (use `configure` for the process-global log). Reopening a
+    file a crashed process left mid-append truncates the torn final line
+    and records a `torn_tail_recovered` event."""
 
     def __init__(self, path: str):
         self.path = path
@@ -145,6 +185,7 @@ class EventLog:
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
+        torn = _repair_torn_tail(self.path)
         self._f = open(self.path, "a", buffering=1)
         self._bytes = os.path.getsize(self.path)
         if self._bytes == 0:
@@ -157,6 +198,14 @@ class EventLog:
             if rotated_from:
                 header["rotated_from"] = rotated_from
             line = json.dumps(header) + "\n"
+            self._f.write(line)
+            self._bytes += len(line)
+        if torn:
+            line = json.dumps({
+                "ts": round(time.time(), 6),
+                "event": "torn_tail_recovered",
+                "truncated_bytes": torn,
+            }) + "\n"
             self._f.write(line)
             self._bytes += len(line)
 

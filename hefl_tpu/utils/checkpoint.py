@@ -56,6 +56,25 @@ def _read_npz(path: str) -> dict[str, np.ndarray]:
         ) from e
 
 
+def _content_sha256(arrays: dict[str, np.ndarray]) -> str:
+    """Deterministic content digest of a checkpoint's arrays: every array
+    hashed as (name, dtype, shape, bytes) in sorted-name order. The zip
+    container's own CRCs only catch STRUCTURAL damage; this digest, stored
+    in the header at save time, catches a payload that decompresses
+    cleanly but is not what was written (bit rot below the zip layer, a
+    partial overwrite, a tampered file)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
 def _flatten_named(params) -> dict[str, np.ndarray]:
     flat, _ = jax.tree_util.tree_flatten_with_path(params)
     out = {}
@@ -144,15 +163,23 @@ def _parse_header(path: str, arrays: dict[str, np.ndarray]) -> dict:
 def save_checkpoint(
     path: str, params, round_index: int, rng_key: jax.Array, meta: dict | None = None
 ) -> None:
-    """Full resumable FL state: (global params, round, RNG key, metadata)."""
-    header = json.dumps(
-        {"round": int(round_index), "meta": meta or {}, "version": 1}
-    )
+    """Full resumable FL state: (global params, round, RNG key, metadata).
+    The header carries a content sha256 over every array so `load_checkpoint`
+    catches payload damage the zip container's structure checks miss."""
+    arrays = {
+        "rng_key": np.asarray(jax.random.key_data(rng_key)),
+        **{f"param:{k}": v for k, v in _flatten_named(params).items()},
+    }
+    header = json.dumps({
+        "round": int(round_index),
+        "meta": meta or {},
+        "version": 1,
+        "sha256": _content_sha256(arrays),
+    })
     _atomic_savez(
         path,
         header=np.frombuffer(header.encode(), dtype=np.uint8),
-        rng_key=np.asarray(jax.random.key_data(rng_key)),
-        **{f"param:{k}": v for k, v in _flatten_named(params).items()},
+        **arrays,
     )
 
 
@@ -162,6 +189,11 @@ def load_checkpoint(path: str, template):
     Raises CheckpointError (loudly, never a silent partial restore) when
     the file is corrupt/truncated — the atomic writer guarantees a file
     that exists is complete, so damage means the resume must not proceed.
+    Integrity is verified END TO END: the header's content sha256 (written
+    by `save_checkpoint`) must match a fresh digest of the arrays, so a
+    payload that decompresses cleanly but was altered is rejected too.
+    Checkpoints from before the digest existed (no `sha256` header field)
+    still load on their structural checks alone.
     """
     import jax.numpy as jnp
 
@@ -173,6 +205,18 @@ def load_checkpoint(path: str, template):
             f"checkpoint {_npz_path(path)!r} is missing its rng_key/round "
             "record — not a round checkpoint (or damaged)"
         )
+    want_sha = header.get("sha256")
+    if want_sha is not None:
+        got_sha = _content_sha256(
+            {k: v for k, v in z.items() if k != "header"}
+        )
+        if got_sha != want_sha:
+            raise CheckpointError(
+                f"checkpoint {_npz_path(path)!r} content hash mismatch "
+                f"(header {want_sha[:12]}..., arrays {got_sha[:12]}...) — "
+                "the payload was altered after the write; resume must not "
+                "proceed from it"
+            )
     rng_key = jax.random.wrap_key_data(jnp.asarray(z["rng_key"]))
     params = _restore_into(template, named)
     return params, int(header["round"]), rng_key, header.get("meta", {})

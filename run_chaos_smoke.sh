@@ -31,8 +31,18 @@
 #       experiment_end stream.* counters must equal the per-round sums,
 #       and the final accuracy must land within tolerance of the
 #       synchronous faulted twin.
+#   (h) durable aggregation / crash recovery (ISSUE 9): the streaming
+#       schedule re-run under the write-ahead journal with a deterministic
+#       mid-journal-append process crash (a REAL torn record on disk).
+#       Re-running the config must recover — torn tail truncated, sealed
+#       round replayed, persisted uploads re-folded — and the recovered
+#       run's per-round canonical-sum sha256 chain must be BITWISE equal
+#       to an uninterrupted journaled twin's, its final params bitwise
+#       equal, and its recovery.* counters equal to the injected schedule
+#       exactly.
 # Artifact: CHAOS_SMOKE.json (accuracy curves + per-round exclusions
-# + the events.jsonl cross-checks, streaming twin included).
+# + the events.jsonl cross-checks, streaming + crash-recovery twins
+# included).
 # Wired into run_tpu_suite.sh as stage 0b (CPU-only, no TPU probe needed).
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -392,6 +402,116 @@ for leaf in _jax_s.tree_util.tree_leaves(streamed["params"]):
         fail.append("streaming twin's final params contain non-finite values")
         break
 
+# (h) crash-recovery twin (ISSUE 9): the streaming schedule under the
+# write-ahead journal, killed mid-journal-append in round 1 (leaving a
+# REAL torn record), then recovered by simply re-running the config. No
+# checkpoint on purpose: the journal alone must carry the recovery (and
+# without checkpoint compaction every round's commit record survives for
+# the hash-chain comparison below).
+from hefl_tpu.fl import CrashConfig, SimulatedCrash
+from hefl_tpu.fl import journal as jr
+
+CRASH_ROUND, CRASH_FOLDS = 1, 2
+recovery_faults = dataclasses.replace(stream_faults, fail_rounds=())
+crash_cfg = dataclasses.replace(
+    stream_cfg, faults=recovery_faults, events_path="",
+    max_round_retries=0, checkpoint_path=None,
+    journal_path=os.path.join(os.path.dirname(events_path), "crash.wal"),
+    crash=CrashConfig(round=CRASH_ROUND, at="mid_append",
+                      after_folds=CRASH_FOLDS),
+)
+twin_wal = os.path.join(os.path.dirname(events_path), "twin.wal")
+twin_cfg = dataclasses.replace(crash_cfg, crash=None, journal_path=twin_wal)
+print("chaos smoke: journaled uninterrupted twin ...", flush=True)
+jtwin = run_experiment(twin_cfg, verbose=False)
+print(f"chaos smoke: crash-recovery twin (mid-append kill, round "
+      f"{CRASH_ROUND}) ...", flush=True)
+try:
+    run_experiment(crash_cfg, verbose=False)
+    fail.append("crash injection never fired (SimulatedCrash not raised)")
+    recovered = None
+except SimulatedCrash:
+    print("chaos smoke: server crashed as injected; recovering ...",
+          flush=True)
+    recovered = run_experiment(
+        dataclasses.replace(crash_cfg, crash=None), verbose=False
+    )
+
+recovery_summary = {}
+if recovered is not None:
+    rj = recovered.get("journal") or {}
+    rec = rj.get("recovered") or {}
+    rmetrics = recovered["obs"]["metrics"]
+    twin_records = jr.read_journal(twin_wal)
+    crash_records = jr.read_journal(crash_cfg.journal_path)
+    twin_commits = {
+        e["round"]: e["sum_sha"] for e in twin_records
+        if e["kind"] == "commit"
+    }
+    got_commits = {
+        e["round"]: e["sum_sha"] for e in crash_records
+        if e["kind"] == "commit"
+    }
+    if got_commits != twin_commits:
+        fail.append(
+            f"recovered journal commit hashes {got_commits} != "
+            f"uninterrupted twin {twin_commits}"
+        )
+    # recovery.* counters == the injected schedule, exactly: the torn
+    # record is truncated once; the re-folded uploads are every fold the
+    # journal held at the kill — all of sealed round 0's plus the
+    # (after_folds - 1) that completed before the torn append.
+    r0_folds = sum(
+        1 for e in twin_records
+        if e["kind"] == "fold" and e["round"] < CRASH_ROUND
+    )
+    want_refolded = r0_folds + CRASH_FOLDS - 1
+    checks = {
+        "journal.torn_tail_truncated": 1,
+        "recovery.refolded_uploads": want_refolded,
+        "recovery.resumed_rounds": 1,
+        "recovery.count": 1,
+    }
+    for name, want in checks.items():
+        if rmetrics.get(name, 0) != want:
+            fail.append(
+                f"recovery counters: {name} {rmetrics.get(name)} != "
+                f"injected schedule {want}"
+            )
+    if rec.get("open_round") != CRASH_ROUND:
+        fail.append(
+            f"recovery report: open_round {rec.get('open_round')} != "
+            f"crash round {CRASH_ROUND}"
+        )
+    # bitwise equality of the recovered model vs the uninterrupted twin
+    for a, b in zip(
+        _jax_s.tree_util.tree_leaves(jtwin["params"]),
+        _jax_s.tree_util.tree_leaves(recovered["params"]),
+    ):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            fail.append(
+                "recovered params differ bitwise from the uninterrupted "
+                "journaled twin"
+            )
+            break
+    acc_jtwin = jtwin["history"][-1]["accuracy"]
+    acc_rec = recovered["history"][-1]["accuracy"]
+    if acc_rec != acc_jtwin:
+        fail.append(
+            f"recovered accuracy {acc_rec} != uninterrupted twin "
+            f"{acc_jtwin} (must be exact: replay is bitwise)"
+        )
+    recovery_summary = {
+        "crash_round": CRASH_ROUND,
+        "crash_at": "mid_append",
+        "commit_sha_by_round": got_commits,
+        "refolded_uploads": rmetrics.get("recovery.refolded_uploads"),
+        "torn_tail_truncated": rmetrics.get("journal.torn_tail_truncated"),
+        "acc_recovered": acc_rec,
+        "acc_uninterrupted": acc_jtwin,
+        "recovered_report": rec,
+    }
+
 artifact = {
     "preset": "chaos-smoke",
     "acc_clean_by_round": [h["accuracy"] for h in clean["history"]],
@@ -406,6 +526,9 @@ artifact = {
     "events_check": events_summary,
     # The streaming twin's cross-check (stream events vs arrival schedule).
     "stream_check": stream_summary,
+    # The crash-recovery twin's cross-check (recovered journal vs the
+    # uninterrupted journaled twin + recovery.* counters vs the schedule).
+    "recovery_check": recovery_summary,
     "passed": not fail,
     "failures": fail,
 }
@@ -423,6 +546,9 @@ print(
     f"{streamed['history'][-1]['accuracy']:.4f}, exclusions match the "
     "schedule exactly (packed + streaming twins included), no unflagged "
     "NaNs, device-loss retry exercised, events.jsonl counters match the "
-    "fault schedule, streaming rounds all committed at quorum"
+    "fault schedule, streaming rounds all committed at quorum, and the "
+    "mid-append-killed server recovered to the bitwise state of its "
+    "uninterrupted twin (commit sha chain + params identical, recovery "
+    "counters == injected schedule)"
 )
 PY

@@ -65,6 +65,15 @@ t0=$SECONDS
 HEFL_NTT=pallas-interpret python -m pytest -q -m "not slow" \
   tests/test_packing.py
 echo "== packing shard (pallas-interpret): $((SECONDS - t0))s"
+# Journal/durability shard (ISSUE 9): the write-ahead-journal suite —
+# frame codec, torn-tail/chain-break handling, the kill-at-every-boundary
+# recovery matrix — re-run under fsync policy "always", so the maximum-
+# durability path (every append synced) gets CI coverage alongside the
+# fast default the fast tier exercises.
+t0=$SECONDS
+HEFL_JOURNAL_FSYNC=always python -m pytest -q -m "not slow" \
+  tests/test_journal.py
+echo "== journal shard (fsync=always): $((SECONDS - t0))s"
 # Analysis shard (ISSUE 8): the FULL static-analysis gate — everything the
 # pre-shard ran plus the scope-coverage stages, which compile the real
 # round programs (both fusion backends + the secure round) and require

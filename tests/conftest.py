@@ -93,6 +93,12 @@ _SLOW_PATTERNS = (
     # every certification/fixture test.
     "test_analysis.py::test_round_coverage_clean",
     "test_analysis.py::test_secure_round_lint_and_coverage_clean",
+    # ISSUE 9: compile-bearing durability gates — the streaming upload
+    # program's scope coverage and the run_experiment-level crash/recover
+    # twins (each runs three tiny encrypted experiments).
+    "test_analysis.py::test_stream_upload_coverage_clean",
+    "test_journal.py::test_experiment_serve_crash_recover_resume",
+    "test_journal.py::test_experiment_dp_accounting_identical_pre_post_recovery",
 )
 
 

@@ -340,6 +340,13 @@ def test_secure_round_lint_and_coverage_clean():
     assert coverage.check_round_coverage(fusion="vmap", secure=True) == []
 
 
+def test_stream_upload_coverage_clean():
+    # ISSUE 9: the durable aggregation SERVER's round program — the
+    # streaming upload producer every journaled round dispatches — keeps
+    # full phase-scope coverage (jaxpr + compiled HLO).
+    assert coverage.check_stream_coverage(fusion="vmap") == []
+
+
 def test_tree_donations_hold():
     assert lint.check_tree_donations() == []
 

@@ -198,6 +198,38 @@ def test_event_log_roundtrip(tmp_path):
     assert all("ts" in e for e in evs)
 
 
+def test_event_log_reopen_truncates_torn_tail(tmp_path):
+    # ISSUE 9 satellite: a crash mid-append leaves a torn final line (no
+    # trailing newline). Reopening must truncate it and record a
+    # torn_tail_recovered event — the log stays strictly parseable
+    # forever instead of poisoning read_events(strict=True).
+    path = str(tmp_path / "events.jsonl")
+    log = obs_events.EventLog(path)
+    log.emit("round_end", round=0)
+    log.close()
+    with open(path, "a") as f:
+        f.write('{"ts": 1.0, "event": "round_e')   # the torn write
+    with pytest.raises(ValueError, match="malformed"):
+        obs_events.read_events(path)               # poisoned as-is
+    log2 = obs_events.EventLog(path)
+    log2.emit("round_end", round=1)
+    log2.close()
+    evs = obs_events.read_events(path)             # strict: must be clean
+    kinds = [e["event"] for e in evs]
+    assert kinds == [
+        "log_open", "round_end", "torn_tail_recovered", "round_end"
+    ]
+    torn = next(e for e in evs if e["event"] == "torn_tail_recovered")
+    assert torn["truncated_bytes"] == len('{"ts": 1.0, "event": "round_e')
+    # a healthy reopen adds nothing
+    log3 = obs_events.EventLog(path)
+    log3.emit("round_end", round=2)
+    log3.close()
+    assert [e["event"] for e in obs_events.read_events(path)] == kinds + [
+        "round_end"
+    ]
+
+
 def test_event_log_rotates_at_size_cap(tmp_path, monkeypatch):
     # HEFL_EVENTS_MAX_BYTES: the append-only log must rotate to <path>.1
     # instead of growing unbounded; both generations stay strictly

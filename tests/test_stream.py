@@ -42,7 +42,7 @@ from hefl_tpu.fl.faults import (
     EXCLUDED_UNREACHABLE,
     EXCLUDED_UNSAMPLED,
 )
-from hefl_tpu.fl.stream import OnlineAccumulator, ct_hash
+from hefl_tpu.fl.stream import DedupWindow, OnlineAccumulator, ct_hash
 from hefl_tpu.models import SmallCNN
 from hefl_tpu.parallel import make_mesh
 
@@ -133,6 +133,39 @@ def test_arrival_schedule_deterministic_and_disjoint():
         FaultConfig(duplicate_clients=-1)
     with pytest.raises(ValueError, match="arrival_delay_s"):
         FaultConfig(arrival_delay_s=-0.5)
+
+
+def test_dedup_window_conservation_and_bound():
+    # ISSUE 9 satellite: the dedup nonce window is bounded to the
+    # duplicate-reachability horizon (tau + 1 rounds past a nonce's
+    # origin) AND conservative — no LIVE nonce is ever evicted early. A
+    # nonce (c, r0) is live at round r iff r - r0 <= tau + 1 (its upload
+    # can trail at most tau rounds, so a duplicate can still arrive in
+    # the round after its last possible fold).
+    tau = 2
+    per_round = 4
+    w = DedupWindow()
+    for r in range(12):
+        w = w.advanced(r, tau)
+        for c in range(per_round):
+            w.add((c, r))
+        # conservation: every nonce within the horizon is still rejected
+        for r0 in range(max(0, r - tau - 1), r + 1):
+            for c in range(per_round):
+                assert (c, r0) in w, f"live nonce ({c},{r0}) evicted at {r}"
+        # bound: nothing older than the horizon survives
+        assert all(r - n[1] <= tau + 1 for n in w)
+        assert len(w) <= per_round * (tau + 2)
+    # advanced() is transactional: the source window is untouched
+    w2 = w.advanced(100, tau)
+    assert len(w2) == 0 and len(w) > 0
+    # equality accepts plain sets (the engine's transactionality test
+    # snapshots the window as a set)
+    assert DedupWindow([(0, 1)]) == {(0, 1)}
+    # the engine's window IS bounded across rounds: after round r the
+    # retained nonces all sit within the horizon of round r + 1's trim
+    eng = StreamEngine(StreamConfig(staleness_rounds=tau), None)
+    assert isinstance(eng._seen, DedupWindow)
 
 
 # ------------------------------------------- streaming vs batched, bitwise

@@ -1,5 +1,7 @@
 """Serialization, checkpoint, and timer tests (SURVEY.md §5 subsystems)."""
 
+import json
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -9,6 +11,7 @@ from hefl_tpu.ckks import ops
 from hefl_tpu.ckks.encoding import encode
 from hefl_tpu.ckks.keys import CkksContext, keygen
 from hefl_tpu.utils import (
+    CheckpointError,
     PhaseTimer,
     load_checkpoint,
     load_ciphertext,
@@ -136,6 +139,29 @@ def test_checkpoint_roundtrip(tmp_path):
         jax.random.key_data(key2), jax.random.key_data(key)
     )
     np.testing.assert_array_equal(np.asarray(p2["b"]), np.asarray(params["b"]))
+
+
+def test_checkpoint_content_hash_rejects_tamper(tmp_path):
+    # ISSUE 9 satellite: the zip container only catches STRUCTURAL
+    # damage; the header's content sha256 must reject a payload that
+    # decompresses cleanly but was altered after the write.
+    params = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones(4)}
+    path = str(tmp_path / "ck.npz")
+    save_checkpoint(path, params, 2, jax.random.key(1))
+    z = dict(np.load(path))
+    assert "sha256" in json.loads(bytes(z["header"]).decode())
+    z["param:w"] = z["param:w"] + 1.0   # valid zip, wrong content
+    np.savez(path, **z)
+    with pytest.raises(CheckpointError, match="content hash"):
+        load_checkpoint(path, params)
+    # a checkpoint without the digest field (pre-ISSUE-9) still loads
+    z["param:w"] = z["param:w"] - 1.0
+    header = json.loads(bytes(z["header"]).decode())
+    header.pop("sha256")
+    z["header"] = np.frombuffer(json.dumps(header).encode(), dtype=np.uint8)
+    np.savez(path, **z)
+    _, rnd, _, _ = load_checkpoint(path, params)
+    assert rnd == 2
 
 
 def test_phase_timer_accumulates():
