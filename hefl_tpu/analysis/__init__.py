@@ -29,9 +29,11 @@ from hefl_tpu.analysis.ranges import (
     Interval,
     PackingCertificate,
     RangeFinding,
+    TranscipherCertificate,
     certified_max_interleave,
     certify_aggregation,
     certify_packing,
+    certify_transciphering,
     eval_jaxpr_ranges,
 )
 
@@ -63,7 +65,9 @@ def check_experiment(cfg, ctx=None, say=None):
     from hefl_tpu.obs import events as obs_events
     from hefl_tpu.obs import metrics as obs_metrics
 
-    report: dict = {"aggregation": None, "packing": None}
+    report: dict = {
+        "aggregation": None, "packing": None, "transciphering": None,
+    }
     certs = []
     if getattr(cfg, "encrypted", True) and not getattr(
         cfg, "centralized", False
@@ -102,6 +106,20 @@ def check_experiment(cfg, ctx=None, say=None):
             )
             report["packing"] = pk_cert
             certs.append(pk_cert)
+            stream = getattr(cfg, "stream", None)
+            if stream is not None and getattr(
+                stream, "upload_kind", "ckks"
+            ) == "hhe":
+                # Hybrid-HE uplink (ISSUE 11): prove the transciphering
+                # invariants — keystream-subtract carry-free in the guard
+                # band, q/2 wall, mod-2**62 recovery window — before any
+                # round runs.
+                tc_cert = certify_transciphering(
+                    modulus, packing.bits, k, int(cfg.num_clients),
+                    packing.guard_bits,
+                )
+                report["transciphering"] = tc_cert
+                certs.append(tc_cert)
 
     violations = sum(len(c.findings) for c in certs)
     # inc(0) REGISTERS the counter: a clean run's artifacts still carry
@@ -132,8 +150,10 @@ __all__ = [
     "RangeFinding",
     "PackingCertificate",
     "AggregationCertificate",
+    "TranscipherCertificate",
     "certify_packing",
     "certify_aggregation",
+    "certify_transciphering",
     "certified_max_interleave",
     "eval_jaxpr_ranges",
     "LintFinding",

@@ -16,13 +16,16 @@ Stages of the full gate, each a CI failure on findings:
      prime size, plus the full supported PackingConfig grid (b × C at
      auto-k; every point certified by interval analysis, with the
      formula-vs-analysis divergence tripwire armed inside
-     `max_interleave`)
+     `max_interleave`), each point paired with its HHE transciphering
+     twin (`certify_transciphering`: keystream-subtract carry-free,
+     q/2 wall, mod-2**62 recovery window)
   4. hot-path lint — the real round programs (both fusion backends,
      secure included): integer rem/div, f64, host callbacks
   5. donation — declared `donate_argnums` sites actually alias
   6. scope coverage — every leaf compute op phase-attributed (jaxpr +
      compiled HLO, both fusion backends, secure included, plus the
-     streaming upload program the durable aggregation server dispatches)
+     streaming upload program the durable aggregation server dispatches
+     and the hybrid-HE upload/transcipher programs)
 
 Fixture protocol (tests/fixtures/lint/*.py): the module defines `RULE`
 (one of forbidden-primitive | float-contamination | missing-scope |
@@ -119,8 +122,19 @@ def run_tree_gate(fast: bool = False, progress=print) -> list:
                     q, bits, k, clients, GRID_GUARD
                 )
                 got.extend(cert.findings)
+                # Hybrid-HE transciphering (ISSUE 11) rides the same
+                # grid: every packing point the gate certifies must also
+                # survive the keystream-subtract / q/2-wall / mod-2**62
+                # recovery proof, so an HHE run can never select an
+                # uncertified geometry.
+                got.extend(ranges.certify_transciphering(
+                    q, bits, k, clients, GRID_GUARD
+                ).findings)
                 points += 1
-        progress(f"    packing grid: {points} (b, C) points certified")
+        progress(
+            f"    packing grid: {points} (b, C) points certified "
+            "(+ transciphering twin each)"
+        )
         return got
 
     stage("range certification", certs)
@@ -149,6 +163,10 @@ def run_tree_gate(fast: bool = False, progress=print) -> list:
         stage(
             "scope coverage [stream/server]",
             lambda: coverage.check_stream_coverage(fusion="vmap"),
+        )
+        stage(
+            "scope coverage [hhe]",
+            coverage.check_hhe_coverage,
         )
     return findings
 

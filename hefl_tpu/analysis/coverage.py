@@ -189,6 +189,59 @@ def check_stream_coverage(*, fusion: str = "vmap") -> list[LintFinding]:
     )
 
 
+def check_hhe_coverage() -> list[LintFinding]:
+    """The hybrid-HE round programs (ISSUE 11), same scope rule:
+
+      * the HHE upload producer (fl.stream._build_upload_fn with the
+        symmetric-cipher leg) — train/sanitize/stream-encrypt per client;
+      * the server-side transcipher dispatch (hhe.transcipher) — pad
+        provisioning + trivial-embed + keystream subtract, one batch.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from hefl_tpu.analysis.lint import _tiny_round_inputs
+    from hefl_tpu.ckks.keys import CkksContext, keygen
+    from hefl_tpu.ckks.packing import PackedSpec
+    from hefl_tpu.ckks.quantize import PackingConfig
+    from hefl_tpu.fl import TrainConfig
+    from hefl_tpu.fl.stream import _build_upload_fn
+    from hefl_tpu.hhe import cipher as hhe_cipher
+    from hefl_tpu.hhe import transcipher as hhe_transcipher
+
+    module, params, mesh, gp, xs, ys, keys = _tiny_round_inputs()
+    cfg = TrainConfig(
+        epochs=1, batch_size=4, num_classes=10, val_fraction=0.25
+    )
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(2))
+    spec = PackedSpec.for_params(
+        params, ctx, PackingConfig(bits=8, interleave=2, clip=0.5), 2
+    )
+    fn = _build_upload_fn(module, cfg, mesh, ctx, None, 2, spec, True)
+    part = jnp.ones((2,), jnp.int32)
+    pois = jnp.zeros((2,), jnp.int32)
+    hk = jnp.asarray(hhe_cipher.derive_client_keys(0, 2))
+    findings = check_fn_coverage(
+        fn, (gp, pk, xs, ys, keys, keys, part, pois, hk, jnp.uint32(0)),
+        "fl.stream.upload[hhe]",
+    )
+
+    @jax.jit
+    def tc(w_hi, w_lo, r, ek):
+        pad = hhe_transcipher.provision_pads(ctx, pk, hk, r, ek, spec.n_ct)
+        return hhe_transcipher.transcipher_core(
+            ctx, w_hi, w_lo, pad.c0, pad.c1
+        )
+
+    w = jnp.zeros((2, spec.n_ct, ctx.n), jnp.uint32)
+    ek = jax.random.split(jax.random.key(3), 2)
+    findings.extend(check_fn_coverage(
+        tc, (w, w, jnp.uint32(0), ek), "hhe.transcipher[batch]"
+    ))
+    return findings
+
+
 __all__ = [
     "LEAF_PRIMS",
     "LEAF_OPCODES",
@@ -197,4 +250,5 @@ __all__ = [
     "check_fn_coverage",
     "check_round_coverage",
     "check_stream_coverage",
+    "check_hhe_coverage",
 ]

@@ -312,10 +312,13 @@ def exact_int_regions() -> dict[str, tuple[Callable, tuple]]:
     jaxpr probes their home modules export."""
     from hefl_tpu.ckks import encoding, packing, quantize
     from hefl_tpu.fl import secure, stream
+    from hefl_tpu.hhe import cipher as hhe_cipher
+    from hefl_tpu.hhe import transcipher as hhe_transcipher
     from hefl_tpu.parallel import collectives
 
     regions: dict[str, tuple[Callable, tuple]] = {}
-    for mod in (quantize, packing, encoding, secure, stream, collectives):
+    for mod in (quantize, packing, encoding, secure, stream, collectives,
+                hhe_cipher, hhe_transcipher):
         regions.update(mod.exact_int_probes())
     return regions
 

@@ -645,6 +645,129 @@ def certify_aggregation(prime: int) -> AggregationCertificate:
     )
 
 
+@dataclasses.dataclass(frozen=True)
+class TranscipherCertificate:
+    """Static proof (or refutation) of one HHE transciphering geometry."""
+
+    ok: bool
+    modulus_bits: int
+    bits: int
+    k: int
+    fbits: int
+    guard: int          # effective guard guard_bits + ceil(log2 C)
+    clients: int
+    findings: tuple     # RangeFinding tuple, empty when ok
+    checks: tuple       # human-readable proven facts
+
+    def summary(self) -> str:
+        head = (
+            f"transciphering b={self.bits} k={self.k} C={self.clients} "
+            f"(field {self.fbits}b, guard {self.guard}b, "
+            f"q/2 wall 2**{self.modulus_bits - 1})"
+        )
+        if self.ok:
+            return f"{head}: CERTIFIED — " + "; ".join(self.checks)
+        return f"{head}: UNSAFE — " + "; ".join(
+            str(f) for f in self.findings
+        )
+
+
+@functools.lru_cache(maxsize=256)
+def certify_transciphering(
+    modulus: int, bits: int, k: int, clients: int, guard_bits: int
+) -> TranscipherCertificate:
+    """Prove (or refute) the hybrid-HE transciphering invariants (ISSUE 11)
+    for one (q, bits, k, clients, guard) point, over ALL inputs.
+
+    Traces `hhe.cipher.transcipher_sum_probe` — the plaintext integer math
+    the transciphered aggregation (trivial-embed → pad subtract → fold →
+    decode_int_center → hhe_center_mod) computes under encryption, with
+    the cipher's per-client wrap carry gamma ∈ {0, 1} abstracted as an
+    input (its VALUE depends on the secret keystream; its range does not)
+    — and checks:
+
+      field_sums ≤ 2**fbits - 1       (the C-client sum never carries —
+                                       keystream-subtract is carry-free
+                                       inside the packed guard band)
+      |noise_sum| < 2**(guard_eff-1)  (decrypt noise stays in the guard)
+      |transciphered total| < q/2     (the centered CRT decode represents
+                                       sum(v) - 2**62·Γ + E exactly)
+      recovered+2**(g-1) ∈ [0, 2**62) (hhe_center_mod's shifted mod-2**62
+                                       window recovers sum(v) + E exactly)
+
+    The analysis runs with `check_dtype=False`: the probe's int64 is a
+    TRACING carrier only — the real pipeline's decode reads the centered
+    value through uint64 two's-complement, whose mod-2**64 wraparound is
+    benign for the mod-2**62 recovery because 2**62 divides 2**64. The
+    q/2 wall (the `ceiling`) is the mathematically binding bound, and a
+    violated check names the offending op. Cached: the streaming engine
+    certifies on every HHE round setup.
+    """
+    import jax
+
+    from hefl_tpu.ckks import quantize
+    from hefl_tpu.hhe import cipher as hhe_cipher
+
+    fbits = quantize.field_bits(bits, clients)
+    guard_eff = guard_bits + max(int(clients) - 1, 0).bit_length()
+    half_q = modulus // 2
+    ceiling = Interval(-(half_q - 1), half_q - 1)
+    domain = 1 << hhe_cipher.HHE_DOMAIN_BITS
+
+    probe, args = hhe_cipher.transcipher_sum_probe(
+        bits, k, fbits, guard_eff, clients
+    )
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(probe)(*args)
+
+    noise_per_client = (1 << max(guard_bits - 1, 0)) - 1
+    in_ivs = [
+        TOP,                                            # raw float updates
+        Interval(0, 1),                                 # wrap carry gamma
+        Interval(-noise_per_client, noise_per_client),  # per-client noise
+    ]
+    res = eval_jaxpr_ranges(
+        closed, in_ivs, ceiling=ceiling, check_dtype=False
+    )
+    findings = list(res.findings)
+    checks: list[str] = []
+
+    def out_check(idx: int, bound: Interval, what: str):
+        iv = res.out_intervals[idx]
+        if iv.lo < bound.lo or iv.hi > bound.hi:
+            outvar = closed.jaxpr.outvars[idx]
+            op = "input"
+            for eqn in closed.jaxpr.eqns:
+                if outvar in eqn.outvars:
+                    op = eqn.primitive.name
+            findings.append(RangeFinding(
+                kind="output-bound", op=op, eqn_index=-1,
+                interval=iv, bound=bound,
+                message=f"{what}: `{op}` yields {iv}, outside {bound}",
+            ))
+        else:
+            checks.append(f"{what} in {iv} ⊆ {bound}")
+
+    # probe outputs:
+    # (field_sums, noise_sum, transciphered_total, recovered_shifted)
+    out_check(0, Interval(0, (1 << fbits) - 1),
+              f"per-field {clients}-client sum (carry-free)")
+    half_guard = 1 << max(guard_eff - 1, 0)
+    out_check(1, Interval(-(half_guard - 1), half_guard - 1),
+              "accumulated decrypt noise (guard band)")
+    out_check(2, ceiling, "transciphered total (q/2 wall)")
+    out_check(3, Interval(0, domain - 1),
+              "shifted recovery (mod-2**62 window)")
+
+    return TranscipherCertificate(
+        ok=not findings,
+        modulus_bits=modulus.bit_length(),
+        bits=bits, k=k, fbits=fbits, guard=guard_eff, clients=int(clients),
+        findings=tuple(findings),
+        checks=tuple(checks),
+    )
+
+
 def certified_max_interleave(
     modulus: int, bits: int, clients: int, guard_bits: int
 ) -> int:
@@ -668,6 +791,8 @@ __all__ = [
     "RangeResult",
     "eval_jaxpr_ranges",
     "PackingCertificate",
+    "TranscipherCertificate",
     "certify_packing",
+    "certify_transciphering",
     "certified_max_interleave",
 ]

@@ -84,11 +84,21 @@ class PackedSpec:
     k: int                    # interleave factor (blocks per packed row)
     field_bits: int           # b + ceil(log2(clients)): carry-free field width
     guard: int                # noise guard bits below the payload
-    step: float               # quantization step (scalar; clip / qmax)
-    clip: float               # symmetric clip bound on updates
+    step: float               # quantization step (scalar; clip / qmax). With
+                              # a per-tensor schedule this is the COARSEST
+                              # step (the error-budget bound); the real
+                              # per-coefficient grid lives in clips/spans.
+    clip: float               # symmetric clip bound on updates (max of the
+                              # schedule when per-tensor)
     clients: int              # max clients a field sum must hold carry-free
     n_ct: int                 # PACKED ciphertext rows = ceil(base.n_ct / k)
     error_budget: float       # declared |packed - unpacked| per-coeff budget
+    # Per-tensor clip schedule (ROADMAP carried item): one clip per
+    # parameter-tree leaf in ravel order, with the matching leaf sizes, so
+    # pack/unpack can broadcast each tensor's step over its span of the
+    # flat vector. None = the historical scalar grid, bit-for-bit.
+    clips: "tuple[float, ...] | None" = None
+    spans: "tuple[int, ...] | None" = None
 
     @classmethod
     def for_params(
@@ -102,6 +112,19 @@ class PackedSpec:
         if not cfg.enabled:
             raise ValueError("PackedSpec.for_params: PackingConfig is disabled")
         base = PackSpec.for_params(template_params, ctx.n)
+        clips = spans = None
+        if cfg.per_tensor:
+            import jax as _jax
+
+            leaves = _jax.tree_util.tree_leaves(template_params)
+            if len(cfg.clip) != len(leaves):
+                raise ValueError(
+                    f"PackingConfig.clip schedule has {len(cfg.clip)} "
+                    f"entries but the model template has {len(leaves)} "
+                    "parameter tensors — one clip per leaf, ravel order"
+                )
+            clips = tuple(float(c) for c in cfg.clip)
+            spans = tuple(int(leaf.size) for leaf in leaves)
         fb = quantize.field_bits(cfg.bits, num_clients)
         k = cfg.interleave or quantize.max_interleave(
             ctx.modulus, cfg.bits, num_clients, cfg.guard_bits
@@ -123,17 +146,22 @@ class PackedSpec:
                 f"— {cert.summary()} — lower interleave/bits/guard or add "
                 "RNS primes"
             )
+        step = cfg.step
         return cls(
             base=base,
             bits=cfg.bits,
             k=k,
             field_bits=fb,
             guard=guard,
-            step=cfg.step,
-            clip=float(cfg.clip),
+            step=max(step) if isinstance(step, tuple) else float(step),
+            clip=(
+                max(cfg.clip) if cfg.per_tensor else float(cfg.clip)
+            ),
             clients=int(num_clients),
             n_ct=-(-base.n_ct // k),
             error_budget=quantize.quant_error_budget(cfg),
+            clips=clips,
+            spans=spans,
         )
 
     @property
@@ -172,10 +200,36 @@ class PackedSpec:
             "field_bits": self.field_bits,
             "guard_bits": self.guard,
             "clip": self.clip,
+            "clips": list(self.clips) if self.clips is not None else None,
             "n_ct": self.n_ct,
             "n_ct_unpacked": self.base.n_ct,
             "error_budget": self.error_budget,
         }
+
+
+def step_vector(spec: PackedSpec) -> "np.ndarray | None":
+    """The per-coefficient quantization steps float32[total] of a
+    per-tensor clip schedule (each leaf's step broadcast over its span of
+    the raveled flat vector), or None for the scalar grid. Built at trace
+    time (a compile-time constant of the round program)."""
+    import numpy as np
+
+    from hefl_tpu.ckks import quantize
+
+    if spec.clips is None:
+        return None
+    steps = np.concatenate([
+        np.full(
+            span, quantize.symmetric_step(c, spec.bits), dtype=np.float32
+        )
+        for c, span in zip(spec.clips, spec.spans)
+    ])
+    if steps.shape[0] != spec.total:
+        raise ValueError(
+            f"per-tensor spans sum to {steps.shape[0]} but the template "
+            f"ravels to {spec.total} coefficients — stale PackedSpec?"
+        )
+    return steps
 
 
 def ciphertext_bytes(n_ct: int, num_limbs: int, n: int) -> int:
@@ -260,8 +314,10 @@ def pack_quantized_flat(
     from hefl_tpu.ckks import quantize
 
     flat = flat.astype(jnp.float32)
-    sat = quantize.saturation_count(flat, spec.step, spec.bits)
-    u = (quantize.quantize(flat, spec.step, spec.bits) + spec.offset).astype(
+    steps = step_vector(spec)
+    step = spec.step if steps is None else jnp.asarray(steps)
+    sat = quantize.saturation_count(flat, step, spec.bits)
+    u = (quantize.quantize(flat, step, spec.bits) + spec.offset).astype(
         jnp.uint32
     )
     pad = spec.n_ct * spec.k * spec.n - spec.total
@@ -301,6 +357,20 @@ def unpack_quantized(
     fields = quantize.deinterleave_fields(
         np.asarray(v), spec.k, spec.field_bits, spec.guard
     )                                               # [n_ct, k, n]
+    steps = step_vector(spec)
+    if steps is not None:
+        # Per-tensor grids: the same offset/average math as
+        # decode_field_sums, but with each coefficient's own step
+        # (fields flatten in exactly pack_quantized_flat's block order;
+        # padding coefficients decode to 0 regardless of their step).
+        if surviving <= 0:
+            raise ValueError("unpack_quantized: surviving must be positive")
+        q_sum = fields.astype(np.int64).reshape(-1)[: spec.total] - (
+            np.int64(surviving) * np.int64(spec.offset)
+        )
+        return (
+            q_sum.astype(np.float64) * (steps.astype(np.float64) / surviving)
+        ).astype(np.float32)
     avg = quantize.decode_field_sums(
         fields, spec.step, spec.offset, surviving
     )

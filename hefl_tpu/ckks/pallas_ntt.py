@@ -49,7 +49,13 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from hefl_tpu.ckks.modular import add_mod, mont_mul, shoup_mul, sub_mod
+from hefl_tpu.ckks.modular import (
+    add_mod,
+    barrett_mod,
+    mont_mul,
+    shoup_mul,
+    sub_mod,
+)
 from hefl_tpu.ckks.ntt import NTTContext, shoup_tables
 
 LANES = 128
@@ -205,6 +211,32 @@ def _enc_kernel(
     c1_ref[0, 0] = add_mod(mont_mul(u, a_key, p, pinv), e1, p)
 
 
+def _transcipher_kernel(
+    p_ref, pinv_ref, mu_ref, sh31_ref, hi_ref, lo_ref, pc0_ref, pc1_ref,
+    twp_ref, tws_ref, c0_ref, c1_ref, *, logn: int,
+):
+    """Fused HHE transcipher row (ISSUE 11): trivial-embed + pad subtract.
+
+    One Mosaic dispatch per (prime, upload) row: Barrett-reduce the
+    symmetric ciphertext's (hi, lo) uint32 words mod p, shift-combine into
+    the exact integer residues (the encode_packed math, never touching
+    floats), run the forward NTT in-register, and subtract the provisioned
+    keystream pad — c0 = NTT(encode(w)) - pad_c0, c1 = -pad_c1.
+    """
+    l = pl.program_id(0)
+    p = p_ref[l, 0]
+    pinv = pinv_ref[l, 0]
+    mu = mu_ref[l, 0]
+    sh31 = sh31_ref[l, 0]
+    hi_res = barrett_mod(hi_ref[0], p, mu)
+    lo_res = barrett_mod(lo_ref[0], p, mu)
+    m = add_mod(mont_mul(hi_res, sh31, p, pinv), lo_res, p)
+    m_eval = _fwd_stages(m, twp_ref, tws_ref, p, logn)
+    c0_ref[0, 0] = sub_mod(m_eval, pc0_ref[0, 0], p)
+    c1 = pc1_ref[0, 0]
+    c1_ref[0, 0] = jnp.where(c1 == 0, c1, p - c1)
+
+
 def _dec_kernel(
     p_ref, pinv_ref, ninv_ref, ninvs_ref, c0_ref, c1_ref, s_ref,
     twp_ref, tws_ref, o_ref, *, logn: int,
@@ -303,6 +335,63 @@ def ntt_forward_pallas(ctx: NTTContext, a: jnp.ndarray, *, interpret: bool | Non
 def ntt_inverse_pallas(ctx: NTTContext, a: jnp.ndarray, *, interpret: bool | None = None) -> jnp.ndarray:
     """Evaluation -> coefficient domain incl. N^-1; bit-exact vs `ntt.ntt_inverse`."""
     return _run(ctx, a, inverse=True, interpret=interpret)
+
+
+def transcipher_fused_pallas(
+    ctx: NTTContext,
+    w_hi: jnp.ndarray,
+    w_lo: jnp.ndarray,
+    pad_c0: jnp.ndarray,
+    pad_c1: jnp.ndarray,
+    *,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The HHE transcipher as ONE fused kernel dispatch (ISSUE 11).
+
+    `w_hi`/`w_lo` are the symmetric ciphertext's uint32 word pairs
+    [..., B', N] (no limb axis — the cipher lives in the packed integer
+    domain); `pad_c0`/`pad_c1` the provisioned keystream ciphertext's
+    eval-domain residues [..., B', L, N]. Returns eval-domain (c0, c1) =
+    trivial(w) - pad, bit-exact vs `hhe.transcipher._transcipher_core_xla`.
+    """
+    from hefl_tpu.ckks.primes import host_to_mont
+
+    _check_supported(ctx)
+    interpret = _resolve_interpret(interpret)
+    tabs = _tables(ctx)
+    rows, batch, num_l, b, s_rows = _row_layout(ctx, [pad_c0, pad_c1])
+    smem, row_spec, _key_spec, tw_spec = _specs(ctx, num_l, s_rows)
+    word_spec = pl.BlockSpec(
+        (1, s_rows, LANES), lambda l, i: (i, 0, 0), memory_space=pltpu.VMEM
+    )
+    words = [w.reshape(b, s_rows, LANES) for w in (w_hi, w_lo)]
+    p_col = np.asarray(tabs.p)[:, 0]
+    mu = (0xFFFFFFFF // p_col.astype(np.uint64)).astype(np.uint32)[:, None]
+    sh31 = np.array(
+        [[host_to_mont((1 << 31) % int(pi), int(pi))] for pi in p_col],
+        dtype=np.uint32,
+    )
+    scalars = [
+        jnp.asarray(tabs.p), jnp.asarray(tabs.pinv_neg),
+        jnp.asarray(mu), jnp.asarray(sh31),
+    ]
+    out_shape = jax.ShapeDtypeStruct(rows[0].shape, jnp.uint32)
+    c0, c1 = pl.pallas_call(
+        functools.partial(_transcipher_kernel, logn=ctx.logn),
+        grid=(num_l, b),
+        in_specs=[smem() for _ in scalars]
+        + [word_spec] * 2 + [row_spec] * 2 + [tw_spec] * 2,
+        out_specs=(row_spec, row_spec),
+        out_shape=(out_shape, out_shape),
+        interpret=interpret,
+    )(
+        *scalars, *words, *rows,
+        jnp.asarray(tabs.tw_fwd), jnp.asarray(tabs.tw_fwd_shoup),
+    )
+    unrow = lambda o: jnp.moveaxis(  # noqa: E731
+        o.reshape(num_l, b, ctx.n), 0, 1
+    ).reshape(*batch, num_l, ctx.n)
+    return unrow(c0), unrow(c1)
 
 
 def encrypt_fused_pallas(

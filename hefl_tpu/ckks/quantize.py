@@ -84,6 +84,13 @@ class PackingConfig:
                   (the packed analog of encode_overflow). Updates, not
                   weights: deltas are small and near-zero-centered, so a
                   b-bit grid spends its levels where the signal is.
+                  A SCALAR applies one grid to every coefficient (the
+                  historical path, bit-for-bit); a TUPLE is a per-tensor
+                  clip schedule — one bound per parameter-tree leaf, in
+                  ravel order, each tensor quantized on its own grid
+                  (`PackedSpec.for_params` validates the length against
+                  the model template and threads the per-coefficient
+                  steps through pack/unpack).
     guard_bits:   low bits reserved per slot for CKKS decrypt noise (the
                   effective guard adds ceil(log2(C)) for the client sum).
     error_budget: declared max |packed - unpacked| error per averaged
@@ -96,7 +103,7 @@ class PackingConfig:
 
     bits: int = 0
     interleave: int = 0
-    clip: float = 0.5
+    clip: "float | tuple[float, ...]" = 0.5
     guard_bits: int = 16
     error_budget: float = 0.0
 
@@ -109,7 +116,20 @@ class PackingConfig:
             )
         if self.interleave < 0:
             raise ValueError("PackingConfig.interleave must be >= 0 (0 = auto)")
-        if self.bits and self.clip <= 0:
+        if isinstance(self.clip, (list, tuple)):
+            # Coerce to a tuple so the config stays hashable (it rides in
+            # ExperimentConfig and the compile-once factory cache keys).
+            object.__setattr__(
+                self, "clip", tuple(float(c) for c in self.clip)
+            )
+            if self.bits and (
+                not self.clip or any(c <= 0 for c in self.clip)
+            ):
+                raise ValueError(
+                    "PackingConfig.clip: a per-tensor clip schedule needs "
+                    "at least one entry, every entry > 0"
+                )
+        elif self.bits and self.clip <= 0:
             raise ValueError("PackingConfig.clip must be > 0")
         if self.bits and not 4 <= self.guard_bits <= 30:
             raise ValueError(
@@ -123,7 +143,18 @@ class PackingConfig:
         return self.bits > 0
 
     @property
-    def step(self) -> float:
+    def per_tensor(self) -> bool:
+        """True when `clip` is a per-tensor schedule (tuple), not a scalar."""
+        return isinstance(self.clip, tuple)
+
+    @property
+    def step(self) -> "float | tuple[float, ...]":
+        """Quantization step(s): scalar clip -> one float (the historical
+        contract, bit-for-bit); per-tensor clips -> the matching tuple."""
+        if self.per_tensor:
+            return tuple(
+                float(symmetric_step(c, self.bits)) for c in self.clip
+            )
         return float(symmetric_step(self.clip, self.bits))
 
 
@@ -352,10 +383,13 @@ def quant_error_budget(cfg: PackingConfig) -> float:
     """The declared per-coefficient |packed - unpacked| budget: the
     configured override, else step/2 (the quantizer's worst case, which
     averaging over clients cannot exceed) + 1e-4 slack for the unpacked
-    reference's own CKKS decode error."""
+    reference's own CKKS decode error. A per-tensor clip schedule budgets
+    at its COARSEST grid (the worst per-coefficient case)."""
     if cfg.error_budget:
         return float(cfg.error_budget)
-    return 0.5 * cfg.step + 1e-4
+    step = cfg.step
+    worst = max(step) if isinstance(step, tuple) else step
+    return 0.5 * worst + 1e-4
 
 
 def describe(cfg: PackingConfig, modulus: int, clients: int) -> dict:

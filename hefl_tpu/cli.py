@@ -17,6 +17,7 @@ from hefl_tpu.fl import (
     CrashConfig,
     DpConfig,
     FaultConfig,
+    HheConfig,
     PackingConfig,
     StreamConfig,
     TrainConfig,
@@ -183,6 +184,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="base backoff between delivery retries")
     p.add_argument("--stream-seed", type=int, default=0,
                    help="PRNG seed of cohort sampling and retry jitter")
+    # --- hybrid-HE symmetric uplink (hefl_tpu/hhe, README "Hybrid HE
+    # uplink") ---
+    p.add_argument("--hhe", action="store_true",
+                   help="hybrid-HE uplink: clients encrypt their packed "
+                        "quantized update under a per-client symmetric "
+                        "stream cipher (~1x wire bytes, no client-side "
+                        "NTTs) and the server transciphers into CKKS "
+                        "before the quorum fold; requires --pack-bits and "
+                        "implies --stream")
+    p.add_argument("--hhe-key-seed", type=int, default=0, metavar="S",
+                   help="enrollment seed of the per-client symmetric "
+                        "master-key derivation (hhe.derive_client_keys)")
     # --- durable aggregation service (fl/journal.py + fl/server.py,
     # README "Durable aggregation & crash recovery") ---
     p.add_argument("--serve", action="store_true",
@@ -291,12 +304,27 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
     )
     want_stream = (
         args.stream
+        or args.hhe
         or args.cohort_size > 0
         or args.quorum < 1.0
         or args.deadline > 0
         or args.staleness > 0
         or args.stream_retries > 0
     )
+    if args.hhe and args.pack_bits <= 0:
+        # The symmetric cipher lives in the packed integer domain; without
+        # packing there is nothing for the keystream to add to. Fail at
+        # the flag layer (same pattern as the packing siblings) instead of
+        # deep inside run_experiment.
+        raise SystemExit(
+            "--hhe ships the PACKED quantized update under the stream "
+            "cipher; add --pack-bits B to enable packing"
+        )
+    if args.hhe_key_seed and not args.hhe:
+        raise SystemExit(
+            "--hhe-key-seed has no effect without --hhe; add --hhe to "
+            "enable the hybrid-HE uplink"
+        )
     arrival_faults = (
         args.arrival_delay > 0
         or args.duplicate_clients > 0
@@ -349,6 +377,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             retry_backoff_s=args.stream_backoff,
             staleness_rounds=args.staleness,
             seed=args.stream_seed,
+            upload_kind="hhe" if args.hhe else "ckks",
         )
         if want_stream
         else None
@@ -396,6 +425,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         ),
         faults=faults,
         stream=stream,
+        hhe=HheConfig(key_seed=args.hhe_key_seed) if args.hhe else None,
         journal_path=args.journal_path,
         fsync_policy=args.fsync_policy,
         serve=args.serve,

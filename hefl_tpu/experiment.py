@@ -36,6 +36,7 @@ from hefl_tpu.fl import (
     DeviceLost,
     DpConfig,
     FaultConfig,
+    HheConfig,
     StreamConfig,
     TrainConfig,
     decrypt_average,
@@ -166,6 +167,14 @@ class ExperimentConfig:
     # journal session raises SimulatedCrash at the configured boundary.
     # Requires the journal (a crash without a WAL is just data loss).
     crash: "CrashConfig | None" = None
+    # Hybrid-HE uplink key knobs (hhe.cipher.HheConfig): used when
+    # stream.upload_kind == "hhe" — clients encrypt packed quantized
+    # updates under a per-client symmetric stream cipher (~1x wire, no
+    # client NTTs) and the server transciphers into CKKS before the
+    # quorum fold. None with upload_kind=hhe uses the default key seed;
+    # set with upload_kind=ckks it is rejected loudly (a run the user
+    # believes is HHE but is not).
+    hhe: "HheConfig | None" = None
 
 
 def _train_roofline_inputs(module, params, train_cfg: TrainConfig,
@@ -195,6 +204,16 @@ def _train_roofline_inputs(module, params, train_cfg: TrainConfig,
         fwd, steps, train_cfg.epochs, num_clients
     )
     return flops, num_clients * train_cfg.epochs * steps * grp
+
+
+def _hhe_wire_record(pspec, ctx) -> dict:
+    """The result record's hybrid-HE wire story (hhe.cipher): symmetric
+    upload bytes vs the plain quantized baseline (`expansion_hhe`, the
+    <= 1.1x perf-smoke gate currency) and vs the packed CKKS ciphertext
+    the upload replaces."""
+    from hefl_tpu.hhe.cipher import hhe_bytes_on_wire_record
+
+    return hhe_bytes_on_wire_record(pspec, ctx.num_primes)
 
 
 def _record_round_obs(r: int, phases: dict, dev) -> None:
@@ -288,6 +307,25 @@ def run_experiment(
             "crash injection without a write-ahead journal is just data "
             "loss; add journal_path (--journal-path) or serve (--serve)"
         )
+    hhe_on = cfg.stream is not None and cfg.stream.upload_kind == "hhe"
+    if hhe_on and (cfg.packing is None or not cfg.packing.enabled):
+        # The symmetric cipher lives in the PACKED integer domain: without
+        # a quantized packing there is nothing for the keystream to add to
+        # and nothing for the server to transcipher.
+        raise ValueError(
+            "upload_kind=hhe ships the packed quantized update under the "
+            "stream cipher; add a PackingConfig (--pack-bits) or use "
+            "upload_kind=ckks"
+        )
+    if cfg.hhe is not None and not hhe_on:
+        # Same fail-loud rationale as dp/packing: silently ignoring an HHE
+        # key config would leave the user believing clients skip their
+        # CKKS work when they don't.
+        raise ValueError(
+            "an HheConfig is set but the stream upload_kind is not 'hhe'; "
+            "set StreamConfig(upload_kind='hhe') (--hhe) or drop the hhe "
+            "config"
+        )
     if (
         cfg.dp is not None
         and cfg.stream is not None
@@ -350,6 +388,7 @@ def run_experiment(
         centralized=cfg.centralized, faults=cfg.faults is not None,
         dp=cfg.dp is not None, seed=cfg.seed,
         stream=cfg.stream is not None,
+        hhe=hhe_on,
         # The event fires before the HE context exists, so it carries the
         # CONFIGURED interleave (0 = auto) under an unambiguous name; the
         # RESOLVED k lives in the result record's `packing.interleave`.
@@ -646,6 +685,7 @@ def run_experiment(
                                     params, xs_d, ys_d, k_round, r,
                                     dp=dp_cfg, packing=pspec,
                                     num_real_clients=num_real,
+                                    hhe=cfg.hhe,
                                 )
                             )
                             meta = smeta.meta
@@ -715,6 +755,7 @@ def run_experiment(
                                 ctx, sk, ct_sum, cfg.num_clients, spec,
                                 exact=exact, meta=meta,
                                 packing=pspec, base_params=params,
+                                hhe=hhe_on,
                             )
                             jax.block_until_ready(new_params)
                 else:
@@ -973,6 +1014,18 @@ def run_experiment(
         # synchronous round loop).
         "stream": (
             dataclasses.asdict(cfg.stream) if cfg.stream is not None else None
+        ),
+        # Hybrid-HE uplink record (None = direct CKKS uploads): key seed +
+        # the bytes_on_wire story — symmetric-upload bytes vs the plain
+        # quantized baseline (expansion_hhe, the <= 1.1x gate currency)
+        # and vs the packed CKKS ciphertext it replaces (reduction).
+        "hhe": (
+            {
+                "key_seed": (cfg.hhe or HheConfig()).key_seed,
+                **_hhe_wire_record(pspec, ctx),
+            }
+            if hhe_on and pspec is not None
+            else None
         ),
         # Observability record: where this run's events.jsonl went (None =
         # disabled) + THIS RUN's metrics (counters as deltas against the

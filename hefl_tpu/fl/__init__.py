@@ -13,7 +13,12 @@ local epochs run simultaneously (vmap within a device, shard_map across
 devices) and aggregation is a collective.
 """
 
-from hefl_tpu.fl.config import PackingConfig, StreamConfig, TrainConfig
+from hefl_tpu.fl.config import (
+    HheConfig,
+    PackingConfig,
+    StreamConfig,
+    TrainConfig,
+)
 from hefl_tpu.fl.client import local_train, train_centralized
 from hefl_tpu.fl.dp import (
     DpConfig,
@@ -56,6 +61,7 @@ from hefl_tpu.fl.stream import (
 )
 
 __all__ = [
+    "HheConfig",
     "PackingConfig",
     "StreamConfig",
     "TrainConfig",

@@ -17,7 +17,10 @@ import dataclasses
 # factor, clip, guard, error budget) is DEFINED next to the quantizer it
 # parameterizes (ckks.quantize) but threads through TrainConfig's siblings
 # into fl.secure's encrypt/psum/decrypt paths and ExperimentConfig.
+# HheConfig (the hybrid-HE symmetric-uplink key knobs, ISSUE 11) lives next
+# to its cipher (hhe.cipher) for the same reason.
 from hefl_tpu.ckks.quantize import PackingConfig  # noqa: F401
+from hefl_tpu.hhe.cipher import HheConfig  # noqa: F401
 
 
 @dataclasses.dataclass(frozen=True)
@@ -141,6 +144,16 @@ class StreamConfig:
                       TraceAnnotation). 0 = fully virtual clock: the
                       arrival timeline is simulated exactly but the driver
                       never sleeps — the CI/chaos default.
+    upload_kind:      what the clients put on the wire (ISSUE 11):
+                      "ckks" (the historical packed/float CKKS ciphertext)
+                      or "hhe" — a symmetric stream-cipher encryption of
+                      the PACKED quantized update (~1x wire expansion, no
+                      client-side NTTs; requires a PackingConfig), which
+                      the server transciphers into CKKS (hhe.transcipher)
+                      before the quorum fold so everything downstream —
+                      dedup, staleness, journal — is unchanged. Part of
+                      the journal's config echo, so recovering an HHE
+                      journal under a ckks config fails loudly.
     """
 
     cohort_size: int = 0
@@ -152,8 +165,14 @@ class StreamConfig:
     staleness_rounds: int = 0
     seed: int = 0
     time_scale: float = 0.0
+    upload_kind: str = "ckks"
 
     def __post_init__(self):
+        if self.upload_kind not in ("ckks", "hhe"):
+            raise ValueError(
+                f"StreamConfig.upload_kind={self.upload_kind!r}: must be "
+                "'ckks' or 'hhe'"
+            )
         if not 0.0 < self.quorum <= 1.0:
             raise ValueError(
                 f"StreamConfig.quorum={self.quorum}: must be in (0, 1]"

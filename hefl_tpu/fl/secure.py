@@ -210,6 +210,35 @@ def encrypt_stack_packed(
     )
 
 
+def hhe_encrypt_stack(
+    p_out,
+    base_params,
+    hhe_keys: jax.Array,
+    round_index,
+    spec: PackedSpec,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The hybrid-HE twin of `encrypt_stack_packed` (ISSUE 11): each
+    client's quantized bit-interleaved UPDATE is encrypted under its
+    symmetric stream cipher instead of CKKS — one counter-mode keystream
+    add per packed slot, NO NTTs, no RNS residues, ~1x wire expansion
+    (hhe.cipher). The server transciphers the result into CKKS
+    (hhe.transcipher) before the quorum fold, so everything downstream is
+    unchanged.
+
+    -> (w_hi, w_lo uint32[C, spec.n_ct, N], saturation int32[C]):
+    `saturation` reports through the same `encode_overflow` slot as the
+    packed path (the on_overflow machinery is cipher-agnostic).
+    """
+    from hefl_tpu.hhe import cipher as hhe_cipher
+
+    def enc_one(prm, key):
+        hi, lo, sat = pack_quantized_delta(prm, base_params, spec)
+        w_hi, w_lo = hhe_cipher.stream_encrypt(hi, lo, key, round_index)
+        return w_hi, w_lo, sat
+
+    return jax.vmap(enc_one)(p_out, hhe_keys)
+
+
 def _pad_rows(arr: jax.Array, mult: int) -> jax.Array:
     """Zero-pad axis 0 to a multiple of `mult` (ciphertext-shard padding)."""
     pad = (-arr.shape[0]) % mult
@@ -346,6 +375,7 @@ def decrypt_average(
     mesh=None,
     packing: PackedSpec | None = None,
     base_params=None,
+    hhe: bool = False,
 ):
     """Owner-side decrypt of the aggregated sum -> averaged parameter pytree.
 
@@ -412,6 +442,16 @@ def decrypt_average(
             res = ops.decrypt(ctx, sk, ct_sum)
         if packing is not None:
             v = encoding.decode_int_center(ctx.ntt, res)
+            if hhe:
+                # Transciphered aggregate: the decode carries the cipher's
+                # per-client wrap multiples (-2**62 * Gamma); one shifted
+                # mod-2**62 reduction recovers the exact packed sum —
+                # bitwise the direct path's decode input
+                # (hhe.cipher.hhe_center_mod; window proven by
+                # analysis.certify_transciphering).
+                from hefl_tpu.hhe.cipher import hhe_center_mod
+
+                v = hhe_center_mod(v, packing.guard)
             delta = unpack_quantized(v, packing, surviving)
             base_flat, unravel = ravel_pytree(base_params)
             return unravel(base_flat + jnp.asarray(delta))
@@ -593,6 +633,7 @@ def client_upload_body(
     module, cfg, backend, ctx, dp, dp_k, packing, want_bits,
     gp, pk, x_blk, y_blk, kt_blk, ke_blk,
     kd_blk=None, m_blk=None, po_blk=None,
+    hhe_keys_blk=None, hhe_round=None,
 ):
     """The per-client half of BOTH round programs: train -> dp sanitize
     (shares calibrated to dp_k) -> poison -> pack/encode/encrypt (+
@@ -606,6 +647,13 @@ def client_upload_body(
 
     `want_bits=False` (the unmasked legacy path) traces NO exclusion
     predicates — computing them would add ops to the historical program.
+    `hhe_keys_blk` (uint32[cpd, 4] per-client symmetric master keys, with
+    `hhe_round` the traced round counter) swaps the CKKS encrypt for the
+    hybrid-HE symmetric cipher (`hhe_encrypt_stack`, streaming-only;
+    requires `packing`): `cts` is then the (w_hi, w_lo) word-pair tuple
+    the server-side transcipher consumes, everything else — training, dp,
+    poison, saturation, exclusion bits — is traced identically, which is
+    what makes the HHE-vs-direct parity gate hold by construction.
     -> (cts, mets, overflow, bits | None, p_out).
     """
     p_out, mets = train_block(
@@ -630,7 +678,14 @@ def client_upload_body(
     # Phase scope (obs): pack/encode/overflow-count + the encrypt core
     # are one hefl.encrypt trace bucket.
     with jax.named_scope(obs_scopes.ENCRYPT):
-        if packing is not None:
+        if hhe_keys_blk is not None:
+            # Hybrid-HE symmetric upload: one PRF sweep + add per slot,
+            # no CKKS work on the client (the repo's cheapest upload).
+            w_hi, w_lo, overflow = hhe_encrypt_stack(
+                p_out, gp, hhe_keys_blk, hhe_round, packing
+            )
+            cts = (w_hi, w_lo)
+        elif packing is not None:
             # Quantized bit-interleaved upload: k-fold fewer ciphertext
             # rows; `overflow` carries the quantizer saturation count
             # (same slot, same on_overflow machinery).

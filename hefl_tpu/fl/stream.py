@@ -290,6 +290,7 @@ def _build_upload_fn(
     dp=None,
     num_clients: int = 0,
     packing=None,
+    hhe: bool = False,
 ):
     """Compile-once factory for the streaming upload program: EXACTLY the
     per-client body of fl.secure's masked round (`client_upload_body` —
@@ -297,7 +298,16 @@ def _build_upload_fn(
     drift), WITHOUT the mask-and-psum tail — the per-client ciphertexts
     leave the program (P(axes)-sharded) so the host-side engine can fold
     them as they "arrive". dp shares are calibrated to the declared
-    surviving floor (fl.dp.calibration_clients), like the batched path."""
+    surviving floor (fl.dp.calibration_clients), like the batched path.
+
+    `hhe=True` (ISSUE 11) appends two traced inputs — per-client symmetric
+    master keys uint32[C, 4] and the round counter — and swaps the CKKS
+    encrypt for the hybrid-HE stream cipher (`fl.secure.hhe_encrypt_stack`):
+    the program then emits (w_hi, w_lo) symmetric-ciphertext word pairs for
+    the server-side transcipher instead of ciphertext residues. The round
+    counter is TRACED, so every round of an experiment shares this one
+    executable (the no-new-compile guarantee, pinned in tests/test_hhe.py).
+    """
     from hefl_tpu.fl.fusion import resolve_fusion_backend
     from hefl_tpu.fl.secure import client_upload_body
 
@@ -311,10 +321,14 @@ def _build_upload_fn(
         if dp is not None:
             kd_blk, i = rest[0], 1
         m_blk, po_blk = rest[i], rest[i + 1]
+        hk_blk = hhe_round = None
+        if hhe:
+            hk_blk, hhe_round = rest[i + 2], rest[i + 3]
         cts, mets, overflow, bits, _ = client_upload_body(
             module, cfg, backend, ctx, dp, dp_k, packing, True,
             gp, pk, x_blk, y_blk, kt_blk, ke_blk,
             kd_blk=kd_blk, m_blk=m_blk, po_blk=po_blk,
+            hhe_keys_blk=hk_blk, hhe_round=hhe_round,
         )
         return cts, mets, overflow, bits
 
@@ -322,6 +336,10 @@ def _build_upload_fn(
     if dp is not None:
         in_specs = in_specs + (P(axes),)
     in_specs = in_specs + (P(axes), P(axes))
+    if hhe:
+        # Per-client keys shard with the client axis; the round counter is
+        # a replicated scalar.
+        in_specs = in_specs + (P(axes), P())
     fn = shard_map(
         body,
         mesh=mesh,
@@ -347,6 +365,8 @@ def produce_uploads(
     dp=None,
     num_real_clients: int | None = None,
     packing=None,
+    hhe=None,
+    round_index: int = 0,
 ):
     """Train every client and return its ENCRYPTED upload, per client.
 
@@ -356,6 +376,16 @@ def produce_uploads(
     secure_fedavg_round's (train/enc[/dp] streams), so a cohort's
     trainings match what the batched round would have computed for the
     same key.
+
+    `hhe` (an `fl.config.HheConfig`, ISSUE 11) switches the wire format to
+    upload_kind=hhe: each client's packed quantized update is encrypted
+    under its symmetric stream cipher instead of CKKS (requires `packing`),
+    and the first return value becomes the `(w_hi, w_lo)` uint32[C, n_ct,
+    N] word-pair tuple the server-side transcipher (hhe.transcipher)
+    consumes. Training/dp/poison/sanitization trace identically, which is
+    what makes the HHE-vs-direct parity gate hold by construction.
+    `round_index` keys the keystream counter (traced — no recompile per
+    round).
     """
     n_dev = client_mesh_size(mesh)
     num_clients, pad_idx, prepadded = _round_geometry(
@@ -367,6 +397,12 @@ def produce_uploads(
             f"a carry-free sum over {num_clients} — rebuild "
             "PackedSpec.for_params with the experiment's count"
         )
+    if hhe is not None and packing is None:
+        raise ValueError(
+            "upload_kind=hhe ships the PACKED quantized update under the "
+            "stream cipher; add a PackingConfig (the symmetric cipher "
+            "lives in the packed integer domain)"
+        )
     if dp is None:
         k_train, k_enc = jax.random.split(key)
         dp_keys = None
@@ -377,19 +413,39 @@ def produce_uploads(
     enc_keys = jax.random.split(k_enc, num_clients)
     gp = replicate_on(mesh, global_params)
     part, pois = _mask_inputs(num_clients, participation, poison, pad_idx)
+    hhe_keys = None
+    if hhe is not None:
+        from hefl_tpu.hhe.cipher import derive_client_keys
+
+        hhe_keys = jnp.asarray(
+            derive_client_keys(hhe.key_seed, num_clients)
+        )
     if pad_idx is not None:
         train_keys, enc_keys = train_keys[pad_idx], enc_keys[pad_idx]
         if dp_keys is not None:
             dp_keys = dp_keys[pad_idx]
+        if hhe_keys is not None:
+            hhe_keys = hhe_keys[pad_idx]
         if not prepadded:
             xs, ys = xs[pad_idx], ys[pad_idx]
     fn = _build_upload_fn(
-        module, cfg, mesh, ctx, dp, num_clients, packing
+        module, cfg, mesh, ctx, dp, num_clients, packing, hhe is not None
     )
     args = (gp, pk, xs, ys, train_keys, enc_keys)
     if dp is not None:
         args = args + (dp_keys,)
-    cts, mets, overflow, bits = fn(*args + (part, pois))
+    args = args + (part, pois)
+    if hhe is not None:
+        args = args + (hhe_keys, jnp.uint32(round_index))
+    cts, mets, overflow, bits = fn(*args)
+    if hhe is not None:
+        w_hi, w_lo = cts
+        return (
+            (w_hi[:num_clients], w_lo[:num_clients]),
+            mets[:num_clients],
+            overflow[:num_clients],
+            bits[:num_clients],
+        )
     return (
         Ciphertext(
             c0=cts.c0[:num_clients], c1=cts.c1[:num_clients], scale=cts.scale
@@ -416,6 +472,30 @@ class PendingUpload:
     c1: np.ndarray
     lands_at: float      # arrival offset within its landing round
     lateness: int        # rounds behind its origin when it lands
+
+
+@dataclasses.dataclass
+class _HheRound:
+    """Server-side hybrid-HE state of one round (ISSUE 11): the arrived
+    symmetric ciphertexts, their transciphered CKKS residues (what the
+    accumulator folds), and the provisioned keystream pads — kept so
+    journal REPLAY can re-transcipher persisted symmetric bytes against
+    the re-derived pads and land on bitwise the live fold's residues."""
+
+    w_hi: np.ndarray      # uint32[C, n_ct, N] symmetric ciphertext words
+    w_lo: np.ndarray
+    pad_c0: np.ndarray    # uint32[C, n_ct, L, N] provisioned pad residues
+    pad_c1: np.ndarray
+    ctx: Any
+
+    def retranscipher(self, c: int, w_hi, w_lo):
+        """Transcipher one (journal-sourced) symmetric upload against
+        client c's pad — the replay half of `fold`'s HHE leg."""
+        from hefl_tpu.hhe.transcipher import retranscipher_decode
+
+        return retranscipher_decode(
+            self.ctx, w_hi, w_lo, self.pad_c0[c], self.pad_c1[c]
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -517,6 +597,54 @@ class StreamEngine:
             out.append(t)
         return out
 
+    # -- hybrid-HE transciphering (ISSUE 11) -------------------------------
+
+    def _transcipher_round(
+        self, ctx, pk, packing, uploads, key, round_index, num_clients,
+        dp, hhe, journaled: bool,
+    ):
+        """Provision pads + transcipher the round's symmetric uploads.
+
+        -> (_HheRound | None, Ciphertext [C, n_ct, L, N]). The pad-
+        encryption randomness derives from the round key with the SAME
+        split convention `produce_uploads` uses (train/enc[/dp]) so a
+        replayed round re-derives identical pads — the property that makes
+        journaled symmetric bodies re-transcipher to bitwise the live
+        residues. The _HheRound host copies (symmetric words + pad
+        residues, a full round-sized transfer) exist only for the journal;
+        `journaled=False` skips them and returns None. Runs under the
+        public key only: the authority wraps client master keys, the
+        server sees ciphertexts of keystreams, and nobody outside the
+        client holds its key in the clear (README "Hybrid HE uplink")."""
+        from hefl_tpu.hhe import cipher as hhe_cipher
+        from hefl_tpu.hhe import transcipher as hhe_transcipher
+
+        w_hi_dev, w_lo_dev = uploads
+        keys = hhe_cipher.derive_client_keys(hhe.key_seed, num_clients)
+        if dp is None:
+            _, k_enc = jax.random.split(key)
+        else:
+            _, k_enc, _ = jax.random.split(key, 3)
+        enc_keys = jax.random.split(k_enc, num_clients)
+        tc, pad = hhe_transcipher.transcipher_batch(
+            ctx, packing, pk, jnp.asarray(w_hi_dev), jnp.asarray(w_lo_dev),
+            keys, round_index, enc_keys,
+        )
+        rd = None
+        if journaled:
+            rd = _HheRound(
+                w_hi=np.asarray(w_hi_dev), w_lo=np.asarray(w_lo_dev),
+                pad_c0=np.asarray(pad.c0), pad_c1=np.asarray(pad.c1),
+                ctx=ctx,
+            )
+        obs_metrics.counter("hhe.uploads_transciphered").inc(
+            int(num_clients)
+        )
+        obs_metrics.gauge("hhe.upload_bytes").set(
+            hhe_cipher.sym_wire_bytes(packing)
+        )
+        return rd, tc
+
     # -- one round ---------------------------------------------------------
 
     def run_round(
@@ -535,6 +663,7 @@ class StreamEngine:
         packing=None,
         num_real_clients: int | None = None,
         session=None,
+        hhe=None,
     ):
         """-> (Ciphertext sum, metrics [C, E, 4], overflow [C],
         StreamRoundMeta). meta.meta.surviving is the decode denominator;
@@ -545,8 +674,51 @@ class StreamEngine:
         hook: every engine transition is journaled through it (live mode)
         or VERIFIED against the journal and — for folds — re-fed the
         persisted upload bytes (replay mode, the server's crash
-        recovery). None keeps the historical in-memory-only engine."""
+        recovery). None keeps the historical in-memory-only engine.
+
+        With `StreamConfig.upload_kind == "hhe"` (ISSUE 11) the cohort
+        uploads symmetric-cipher word pairs (~1x wire) and the server
+        TRANSCIPHERS them into CKKS — one batched dispatch against pads
+        the key authority provisioned under the public key — before the
+        fold; everything from the fold on (dedup, staleness, journal,
+        commit hash) carries the transciphered ciphertexts unchanged,
+        except that journaled FRESH-fold bodies persist the symmetric
+        ciphertext bytes (the wire artifact) and replay re-transciphers
+        them. `hhe` (fl.config.HheConfig) supplies the key-derivation
+        knobs; omitted = defaults."""
         s = self.stream
+        hhe_mode = s.upload_kind == "hhe"
+        if hhe_mode and packing is None:
+            raise ValueError(
+                "upload_kind=hhe ships the PACKED quantized update under "
+                "the stream cipher; add a PackingConfig (the symmetric "
+                "cipher lives in the packed integer domain)"
+            )
+        if hhe_mode and hhe is None:
+            from hefl_tpu.fl.config import HheConfig
+
+            hhe = HheConfig()
+        if hhe_mode:
+            # Round-setup range proof (ISSUE 8 gate, extended to HHE):
+            # the keystream subtract must stay carry-free inside the
+            # packed guard band, the transciphered total inside the q/2
+            # wall, and the mod-2**62 recovery window exact — certified
+            # for ALL inputs (lru_cached: one proof per geometry), or the
+            # round refuses to run, naming the overflowing op.
+            from hefl_tpu.analysis.ranges import certify_transciphering
+
+            guard_bits = packing.guard - max(
+                packing.clients - 1, 0
+            ).bit_length()
+            cert = certify_transciphering(
+                int(ctx.modulus), packing.bits, packing.k,
+                packing.clients, guard_bits,
+            )
+            if not cert.ok:
+                raise ValueError(
+                    "upload_kind=hhe rejected by static range analysis — "
+                    f"{cert.summary()}"
+                )
         if dp is not None and s.staleness_rounds > 0:
             # A carried upload lets one client contribute to a release
             # TWICE (its stale + fresh uploads: sensitivity 2C while
@@ -601,7 +773,18 @@ class StreamEngine:
             module, cfg, mesh, ctx, pk, global_params, xs, ys, key,
             participation=part, poison=pois, dp=dp,
             num_real_clients=num_real_clients, packing=packing,
+            hhe=hhe if hhe_mode else None, round_index=round_index,
         )
+        hhe_rd = None
+        if hhe_mode:
+            # Server-side transciphering (hhe.transcipher): the arrived
+            # symmetric word pairs become REAL CKKS ciphertexts in one
+            # batched dispatch, and the rest of the round never knows the
+            # clients skipped their NTTs.
+            hhe_rd, cts = self._transcipher_round(
+                ctx, pk, packing, cts, key, round_index, num_clients, dp,
+                hhe, journaled=session is not None,
+            )
         bits = np.asarray(bits_dev).astype(np.int64).copy()
         # The program's sanitizer verdict, immutable: the arrival-time
         # reject predicate must read THIS, not the attribution copy below
@@ -760,10 +943,26 @@ class StreamEngine:
                     # hands back the JOURNAL's bytes (content-hash
                     # verified against this re-derived upload) and the
                     # accumulator re-folds exactly what was journaled.
-                    fc0, fc1 = session.fold(
-                        round_index, ev.seq, "fresh", c, ev.nonce, 0,
-                        ev.t, c0[c], c1[c], persist=True,
-                    )
+                    if hhe_rd is not None:
+                        # HHE uploads persist the SYMMETRIC ciphertext
+                        # bytes — the actual ~1x wire artifact, its sha256
+                        # the upload's content hash. Replay hands the
+                        # journal's words back and they re-transcipher
+                        # against the re-derived pad: bitwise the live
+                        # fold's residues (deterministic pads + the
+                        # backend parity gate).
+                        wh, wl = hhe_rd.w_hi[c], hhe_rd.w_lo[c]
+                        rh, rl = session.fold(
+                            round_index, ev.seq, "fresh", c, ev.nonce, 0,
+                            ev.t, wh, wl, persist=True,
+                        )
+                        if rh is not wh:
+                            fc0, fc1 = hhe_rd.retranscipher(c, rh, rl)
+                    else:
+                        fc0, fc1 = session.fold(
+                            round_index, ev.seq, "fresh", c, ev.nonce, 0,
+                            ev.t, c0[c], c1[c], persist=True,
+                        )
                 acc.fold(ev.nonce, fc0, fc1)
                 fresh += 1
                 folded_clients.append(c)

@@ -1,0 +1,64 @@
+"""Hybrid homomorphic encryption (HHE) client uplink (ISSUE 11).
+
+Packing (ISSUE 6) cut the uplink 6x -> 1.5x, but every client still pays
+the CKKS encrypt NTTs and ~1.5x wire overhead. The HHE pattern (PAPERS.md:
+"Federated Learning: An approach with Hybrid Homomorphic Encryption",
+"Towards Privacy-Preserving Federated Learning using Hybrid Homomorphic
+Encryption") moves both to the server:
+
+  * :mod:`hefl_tpu.hhe.cipher` — the client half: an additive stream
+    cipher over the packed 62-bit integer domain. The keystream comes from
+    a counter-mode PRF built on the division-free uint32 primitives
+    (ckks.modular's 16-bit schoolbook multiplies), the ciphertext is one
+    carry-propagating add per slot, and the wire format is the SAME
+    (hi, lo) uint32 pair the packed plaintext occupies — ~1x expansion,
+    zero NTTs, zero RNS work on the client.
+  * :mod:`hefl_tpu.hhe.transcipher` — the server half: the symmetric
+    ciphertext is trivially embedded into CKKS (exact integer encode +
+    forward NTT, ZERO c1 component) and the client's keystream — which the
+    key authority provisioned to the server as a CKKS ciphertext, never in
+    the clear — is homomorphically subtracted, yielding a REAL CKKS
+    encryption of the packed update that the streaming quorum engine,
+    dedup window, and write-ahead journal carry unchanged. One batched
+    dispatch over all arrived clients; XLA reference graph + a fused
+    Pallas kernel behind the PR-4 `ckks.backend` dispatch, bitwise
+    parity-gated like encrypt/decrypt.
+
+The decrypted aggregate is bitwise-equal (integer field sums) to the
+direct packed-CKKS path in any arrival order — hefl_tpu.analysis's
+`certify_transciphering` proves the supporting integer invariants (the
+mod-2**62 recovery stays exact, the q/2 wall holds) for ALL inputs, or
+rejects the configuration naming the overflowing op.
+"""
+
+from __future__ import annotations
+
+from hefl_tpu.hhe.cipher import (
+    HHE_DOMAIN_BITS,
+    HheConfig,
+    derive_client_keys,
+    hhe_bytes_on_wire_record,
+    hhe_center_mod,
+    keystream_pair,
+    stream_decrypt,
+    stream_encrypt,
+    sym_wire_bytes,
+)
+# NOTE: re-exporting transcipher.transcipher here would SHADOW the
+# submodule attribute (`hefl_tpu.hhe.transcipher` would resolve to the
+# function) — import the single-upload entry point from the submodule.
+from hefl_tpu.hhe.transcipher import provision_pads, transcipher_batch
+
+__all__ = [
+    "HHE_DOMAIN_BITS",
+    "HheConfig",
+    "derive_client_keys",
+    "hhe_bytes_on_wire_record",
+    "hhe_center_mod",
+    "keystream_pair",
+    "stream_decrypt",
+    "stream_encrypt",
+    "sym_wire_bytes",
+    "provision_pads",
+    "transcipher_batch",
+]

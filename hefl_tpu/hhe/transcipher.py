@@ -1,0 +1,222 @@
+"""Server-side transciphering: symmetric HHE uploads -> CKKS ciphertexts.
+
+The counterpart of :mod:`hefl_tpu.hhe.cipher`: the server receives, per
+arrived client, the symmetric ciphertext w = (v + z) mod 2**62 (one
+(hi, lo) uint32 pair per packed slot) and holds — provisioned by the key
+authority, never the keys themselves — a CKKS encryption of that client's
+round keystream pad, Enc(z). Transciphering is then EXACT homomorphic
+arithmetic, one batched dispatch over every arrived client:
+
+    trivial(w)   = (NTT(encode_packed(w)), 0)     # decryptable by anyone
+    transcipher  = trivial(w) - Enc(z)
+                 = Enc(v - 2**62 * gamma)          # gamma in {0,1}: the
+                                                   # cipher's wrap carry
+
+a REAL CKKS ciphertext of the packed update (up to the 2**62*gamma
+multiple the owner's mod-2**62 decode removes exactly — see
+`cipher.hhe_center_mod` and `analysis.ranges.certify_transciphering`).
+Downstream — the streaming quorum fold, dedup window, write-ahead journal,
+owner decrypt — carries it exactly like a client-encrypted upload.
+
+Kernel structure (ISSUE 4 lineage): the XLA graph path is the bit-exact
+semantics reference; `ckks.pallas_ntt.transcipher_fused_pallas` runs the
+whole per-(prime, row) pipeline — Barrett-reduce the (hi, lo) words,
+shift-combine into residues, forward NTT, subtract the pad — as ONE Mosaic
+dispatch, selected through the same `ckks.backend` dispatch (HEFL_HE) that
+routes encrypt/decrypt, and bitwise-parity-gated the same way
+(tests/test_hhe.py; the pallas-interpret shard).
+
+Trust split: the key authority (the enrollment service holding key-wrapped
+client master keys; in-process runs simulate it with the same PRF) derives
+each cohort client's round pad and encrypts it under the PUBLIC key — so
+provisioning needs no secret material beyond the wrapped masters, and the
+server's entire view is symmetric ciphertexts plus CKKS ciphertexts.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hefl_tpu.ckks import encoding, modular, ops
+from hefl_tpu.ckks.keys import CkksContext, PublicKey
+from hefl_tpu.ckks.ntt import ntt_forward
+from hefl_tpu.ckks.ops import Ciphertext
+from hefl_tpu.hhe import cipher
+from hefl_tpu.obs import scopes as obs_scopes
+
+
+def _transcipher_core_xla(ntt, w_hi, w_lo, pad_c0, pad_c1):
+    """The bit-exact XLA reference: trivial embed + keystream subtract.
+
+    encode_packed is the exact integer encode (never touches floats — a
+    float round-trip would shear the cipher's bit fields), ntt_forward
+    lifts the trivial embedding into the eval domain where ciphertexts
+    live, and the subtract/negate completes trivial(w) - Enc(z).
+    """
+    p = jnp.asarray(ntt.p)
+    m_res = encoding.encode_packed(ntt, w_hi, w_lo)
+    c0 = modular.sub_mod(ntt_forward(ntt, m_res), pad_c0, p)
+    c1 = modular.neg_mod(pad_c1, p)
+    return c0, c1
+
+
+def transcipher_core(
+    ctx: CkksContext, w_hi, w_lo, pad_c0, pad_c1, backend: str | None = None
+):
+    """Backend-dispatched transcipher of a symmetric-upload batch.
+
+    w_hi/w_lo: uint32[..., n_ct, N] word pairs; pad_c0/pad_c1: the
+    provisioned keystream ciphertext's residues uint32[..., n_ct, L, N].
+    -> (c0, c1) eval-domain residues. Dispatch mirrors `ops.encrypt_core`:
+    explicit `backend` > HEFL_HE > auto; rings the kernel cannot tile fall
+    back to XLA inside `resolve_he_backend`.
+    """
+    from hefl_tpu.ckks.backend import resolve_he_backend
+
+    with jax.named_scope(obs_scopes.TRANSCIPHER):
+        if resolve_he_backend(ctx, backend) == "pallas":
+            from hefl_tpu.ckks import pallas_ntt
+
+            return pallas_ntt.transcipher_fused_pallas(
+                ctx.ntt, w_hi, w_lo, pad_c0, pad_c1
+            )
+        return _transcipher_core_xla(ctx.ntt, w_hi, w_lo, pad_c0, pad_c1)
+
+
+def transcipher(
+    ctx: CkksContext, w_hi, w_lo, pad: Ciphertext, backend: str | None = None
+) -> Ciphertext:
+    """Transcipher one symmetric upload against its provisioned pad."""
+    c0, c1 = transcipher_core(ctx, w_hi, w_lo, pad.c0, pad.c1, backend)
+    return Ciphertext(c0=c0, c1=c1, scale=pad.scale)
+
+
+def provision_pads(
+    ctx: CkksContext,
+    pk: PublicKey,
+    keys: jnp.ndarray,
+    round_index,
+    enc_keys: jnp.ndarray,
+    n_ct: int,
+) -> Ciphertext:
+    """The key authority's round step: Enc_pk(keystream) per cohort client.
+
+    `keys` uint32[C, 4] are the (authority-side) client master keys;
+    `enc_keys` are per-client PRNG keys for the encryption randomness —
+    the SAME split convention as the direct path's `encrypt_stack_packed`,
+    so a round's provisioning is deterministic given the round key (which
+    is what makes journal replay re-derive identical pads). Runs under
+    the public key only.
+    """
+    n = ctx.n
+
+    def one(key, ek):
+        z_hi, z_lo = cipher.keystream_pair(key, round_index, (n_ct, n))
+        m_z = encoding.encode_packed(ctx.ntt, z_hi, z_lo)
+        u, e0, e1 = ops.encrypt_samples(ctx, ek, (n_ct,))
+        return m_z, u, e0, e1
+
+    m_z, u, e0, e1 = jax.vmap(one)(keys, enc_keys)
+    return ops.encrypt_core(ctx, pk, m_z, u, e0, e1)
+
+
+@functools.lru_cache(maxsize=8)
+def _build_hhe_server_fn(ctx: CkksContext, n_ct: int, scale_guard: float):
+    """Compile-once factory for the whole server-side round step: pad
+    provisioning (vmapped over clients, ONE fused encrypt-core dispatch)
+    plus the batched transcipher. `round_index` and all key material are
+    traced, so every round of an experiment shares this one executable
+    (the no-new-compile guarantee, tested)."""
+
+    def fn(pk, w_hi, w_lo, keys, round_index, enc_keys):
+        pad = provision_pads(ctx, pk, keys, round_index, enc_keys, n_ct)
+        c0, c1 = transcipher_core(ctx, w_hi, w_lo, pad.c0, pad.c1)
+        return c0, c1, pad.c0, pad.c1
+
+    return jax.jit(fn)
+
+
+def transcipher_batch(
+    ctx: CkksContext,
+    spec,
+    pk: PublicKey,
+    w_hi,
+    w_lo,
+    keys,
+    round_index,
+    enc_keys,
+) -> tuple[Ciphertext, Ciphertext]:
+    """Provision + transcipher a whole arrived batch as one dispatch.
+
+    -> (transciphered Ciphertext [C, n_ct, L, N] at the packed guard
+    scale, pad Ciphertext) — the pads ride along because journal replay
+    re-transciphers persisted symmetric bodies against them.
+    """
+    fn = _build_hhe_server_fn(ctx, int(spec.n_ct), float(spec.guard_scale))
+    c0, c1, p0, p1 = fn(
+        pk, w_hi, w_lo,
+        jnp.asarray(keys), jnp.asarray(round_index, jnp.uint32),
+        enc_keys,
+    )
+    return (
+        Ciphertext(c0=c0, c1=c1, scale=spec.guard_scale),
+        Ciphertext(c0=p0, c1=p1, scale=spec.guard_scale),
+    )
+
+
+@functools.lru_cache(maxsize=4)
+def _retranscipher(ctx: CkksContext):
+    """Jitted single-upload transcipher core (journal-replay decode: the
+    persisted symmetric body re-transciphers against the re-derived pad;
+    bitwise-identical residues to the live fold by the backend parity
+    gate)."""
+    return jax.jit(
+        lambda w_hi, w_lo, p0, p1: transcipher_core(ctx, w_hi, w_lo, p0, p1)
+    )
+
+
+def retranscipher_decode(ctx: CkksContext, w_hi, w_lo, pad_c0, pad_c1):
+    """Host-facing replay decode: symmetric words + pad residues ->
+    (c0, c1) numpy residues."""
+    c0, c1 = _retranscipher(ctx)(
+        jnp.asarray(w_hi), jnp.asarray(w_lo),
+        jnp.asarray(pad_c0), jnp.asarray(pad_c1),
+    )
+    return np.asarray(c0), np.asarray(c1)
+
+
+@functools.lru_cache(maxsize=1)
+def _probe_ctx() -> CkksContext:
+    return CkksContext.create(n=256)
+
+
+def exact_int_probes() -> dict:
+    """The transcipher core as a declared exact-integer region for
+    analysis.lint: trivial embed + NTT + subtract must stay rem/div- and
+    float-free end to end (it runs per arrived upload on the server hot
+    path)."""
+    ctx = _probe_ctx()
+    num_l = ctx.num_primes
+    hi = jnp.zeros((2, ctx.n), jnp.uint32)
+    lo = jnp.zeros((2, ctx.n), jnp.uint32)
+    pad = jnp.zeros((2, num_l, ctx.n), jnp.uint32)
+    return {
+        "hhe.transcipher.core": (
+            lambda h, l, p0, p1: _transcipher_core_xla(ctx.ntt, h, l, p0, p1),
+            (hi, lo, pad, pad),
+        ),
+    }
+
+
+__all__ = [
+    "transcipher",
+    "transcipher_core",
+    "transcipher_batch",
+    "provision_pads",
+    "retranscipher_decode",
+    "exact_int_probes",
+]

@@ -34,6 +34,7 @@ SGD_CORE = "hefl.sgd_core"            # fwd/bwd/Adam + batch gather/shuffles
 VAL = "hefl.val"                      # per-epoch validation + callbacks
 SANITIZE = "hefl.sanitize"            # poison injection + exclusion predicates
 ENCRYPT = "hefl.encrypt"              # pack/encode + CKKS encrypt core
+TRANSCIPHER = "hefl.transcipher"      # HHE trivial-embed + keystream subtract
 PSUM_AGGREGATE = "hefl.psum_aggregate"  # ciphertext masking + lazy sum + psum
 AGGREGATE = "hefl.aggregate"          # plaintext (masked) FedAvg mean + pmean
 DECRYPT = "hefl.decrypt"              # c0 + c1*s, iNTT, decode, unpack
@@ -54,6 +55,7 @@ PHASES = (
     VAL,
     SANITIZE,
     ENCRYPT,
+    TRANSCIPHER,
     PSUM_AGGREGATE,
     AGGREGATE,
     DECRYPT,

@@ -20,7 +20,13 @@ north-star metric — is a min over two post-cold samples.
 from __future__ import annotations
 
 from hefl_tpu.experiment import ExperimentConfig, HEConfig
-from hefl_tpu.fl import FaultConfig, TrainConfig
+from hefl_tpu.fl import (
+    FaultConfig,
+    HheConfig,
+    PackingConfig,
+    StreamConfig,
+    TrainConfig,
+)
 
 # The five reference-derived benchmark configurations (BASELINE.json);
 # results.py and test_presets iterate THIS list, not every preset.
@@ -88,5 +94,24 @@ PRESETS: dict[str, ExperimentConfig] = {
             num_classes=10, epochs=2, batch_size=8, val_fraction=0.25,
             client_fusion="fused",
         ),
+    ),
+    # Hybrid-HE uplink smoke (README "Hybrid HE uplink"; run_perf_smoke.sh
+    # stage): a CPU-sized streaming run with upload_kind=hhe — clients
+    # ship symmetric-cipher word pairs (~1x wire) and the server
+    # transciphers into CKKS before the quorum fold. The artifact's
+    # `hhe.expansion_hhe` is the <= 1.1x wire gate and its history must
+    # be bitwise-derivable from the direct packed path (tests/test_hhe.py
+    # pins the parity; this preset makes it observable end to end).
+    "hhe-smoke": ExperimentConfig(
+        model="smallcnn", dataset="mnist", num_clients=8, rounds=2,
+        encrypted=True, he=HEConfig(n=256), seed=0,
+        n_train=512, n_test=128,
+        train=TrainConfig(
+            num_classes=10, epochs=1, batch_size=8, augment=False,
+            val_fraction=0.25,
+        ),
+        packing=PackingConfig(bits=8, clip=0.5),
+        stream=StreamConfig(quorum=1.0, upload_kind="hhe"),
+        hhe=HheConfig(key_seed=0),
     ),
 }

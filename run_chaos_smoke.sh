@@ -40,9 +40,18 @@
 #       to an uninterrupted journaled twin's, its final params bitwise
 #       equal, and its recovery.* counters equal to the injected schedule
 #       exactly.
+#   (i) hybrid-HE uplink twin (ISSUE 11): the SAME streaming fault
+#       schedule re-run with upload_kind=hhe — clients ship symmetric
+#       stream-cipher word pairs and the server transciphers into CKKS
+#       before the fold. Every round must still commit at quorum, the
+#       stream.* counters must equal the direct streaming twin's schedule
+#       totals exactly (the arrival machinery is cipher-agnostic), the
+#       hhe wire record must show <= 1.1x expansion, final params must be
+#       finite and the accuracy within tolerance of the synchronous
+#       faulted run.
 # Artifact: CHAOS_SMOKE.json (accuracy curves + per-round exclusions
-# + the events.jsonl cross-checks, streaming + crash-recovery twins
-# included).
+# + the events.jsonl cross-checks, streaming + crash-recovery + HHE
+# twins included).
 # Wired into run_tpu_suite.sh as stage 0b (CPU-only, no TPU probe needed).
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -402,6 +411,95 @@ for leaf in _jax_s.tree_util.tree_leaves(streamed["params"]):
         fail.append("streaming twin's final params contain non-finite values")
         break
 
+# (i) hybrid-HE uplink twin (ISSUE 11): the identical streaming fault
+# schedule under upload_kind=hhe — symmetric uploads, server-side
+# transciphering into CKKS, everything downstream unchanged. The arrival
+# machinery is cipher-agnostic, so the stream.* counters must equal the
+# SAME schedule totals the direct streaming twin was gated on.
+from hefl_tpu.fl import HheConfig
+
+hhe_events = os.path.join(os.path.dirname(events_path), "hhe_events.jsonl")
+hhe_cfg = dataclasses.replace(
+    stream_cfg,
+    events_path=hhe_events,
+    packing=PackingConfig(bits=8, interleave=2, clip=0.5),
+    stream=dataclasses.replace(stream_cfg.stream, upload_kind="hhe"),
+    hhe=HheConfig(key_seed=0),
+)
+print("chaos smoke: hybrid-HE streaming twin (upload_kind=hhe, b=8 k=2) ...",
+      flush=True)
+hhe_run = run_experiment(hhe_cfg, verbose=False)
+
+hhe_summary = {}
+hrec = hhe_run.get("hhe")
+if not isinstance(hrec, dict) or hrec.get("expansion_hhe") is None:
+    fail.append("hhe twin: result carries no hhe wire record")
+elif hrec["expansion_hhe"] > 1.1:
+    fail.append(
+        f"hhe twin: wire expansion {hrec['expansion_hhe']} > the 1.1x gate"
+    )
+acc_hhe = hhe_run["history"][-1]["accuracy"]
+if abs(acc_hhe - acc_chaos) > ACC_TOL:
+    fail.append(
+        f"hhe twin diverged from synchronous faulted run: {acc_hhe:.4f} "
+        f"vs {acc_chaos:.4f} (tol {ACC_TOL})"
+    )
+for leaf in _jax_s.tree_util.tree_leaves(hhe_run["params"]):
+    if not np.all(np.isfinite(np.asarray(leaf))):
+        fail.append("hhe twin's final params contain non-finite values")
+        break
+try:
+    hevs = obs_events.read_events(hhe_events)
+except (OSError, ValueError) as e:
+    hevs = []
+    fail.append(f"hhe events.jsonl unusable: {e}")
+if hevs:
+    hhe_by_round = {
+        e["round"]: e for e in hevs if e["event"] == "stream_round"
+    }
+    for r in range(hhe_cfg.rounds):
+        ev = hhe_by_round.get(r)
+        if ev is None:
+            fail.append(f"hhe twin: no stream_round event for round {r}")
+        elif not ev.get("committed"):
+            fail.append(f"hhe twin round {r}: did not commit at quorum")
+    hend = [e for e in hevs if e["event"] == "experiment_end"]
+    hcounters = (hend[-1].get("metrics") or {}) if hend else {}
+    # The schedule totals, recomputed here (not borrowed from the direct
+    # twin's event check, which may have failed independently).
+    h_arr = h_dup = h_ret = h_rej = 0
+    for r in range(hhe_cfg.rounds):
+        sched = schedule_for_round(stream_faults, r, cfg.num_clients)
+        arr = schedule_arrivals(stream_faults, r, cfg.num_clients)
+        n_dup = int(arr.duplicate.sum())
+        h_arr += int(np.count_nonzero(~sched.dropped)) + n_dup
+        h_dup += n_dup
+        h_ret += int(arr.transient.sum())
+        h_rej += int(np.count_nonzero(sched.poison))
+    for name, want_total in (
+        ("stream.arrivals", h_arr),
+        ("stream.duplicates", h_dup),
+        ("stream.retries", h_ret),
+        ("stream.rejected", h_rej),
+    ):
+        if hcounters.get(name, 0) != want_total:
+            fail.append(
+                f"hhe twin counters: {name} {hcounters.get(name)} != the "
+                f"direct streaming twin's schedule total {want_total}"
+            )
+    transciphered = hcounters.get("hhe.uploads_transciphered", 0)
+    if transciphered <= 0:
+        fail.append("hhe twin: hhe.uploads_transciphered counter is 0")
+    hhe_summary = {
+        "events": len(hevs),
+        "wire": hrec,
+        "uploads_transciphered": transciphered,
+        "acc_hhe": acc_hhe,
+        "rounds_committed": sorted(
+            r for r, e in hhe_by_round.items() if e.get("committed")
+        ),
+    }
+
 # (h) crash-recovery twin (ISSUE 9): the streaming schedule under the
 # write-ahead journal, killed mid-journal-append in round 1 (leaving a
 # REAL torn record), then recovered by simply re-running the config. No
@@ -518,8 +616,10 @@ artifact = {
     "acc_chaos_by_round": [h["accuracy"] for h in chaos["history"]],
     "acc_packed_by_round": [h["accuracy"] for h in packed["history"]],
     "acc_stream_by_round": [h["accuracy"] for h in streamed["history"]],
+    "acc_hhe_by_round": [h["accuracy"] for h in hhe_run["history"]],
     "packing": packed.get("packing"),
     "stream": streamed.get("stream"),
+    "hhe": hrec,
     "rounds": rounds,
     "acc_tolerance": ACC_TOL,
     # The structured-event cross-check (events.jsonl vs fault schedule).
@@ -529,6 +629,9 @@ artifact = {
     # The crash-recovery twin's cross-check (recovered journal vs the
     # uninterrupted journaled twin + recovery.* counters vs the schedule).
     "recovery_check": recovery_summary,
+    # The hybrid-HE twin's cross-check (stream counters vs the schedule
+    # + the wire-expansion record).
+    "hhe_check": hhe_summary,
     "passed": not fail,
     "failures": fail,
 }
@@ -546,9 +649,11 @@ print(
     f"{streamed['history'][-1]['accuracy']:.4f}, exclusions match the "
     "schedule exactly (packed + streaming twins included), no unflagged "
     "NaNs, device-loss retry exercised, events.jsonl counters match the "
-    "fault schedule, streaming rounds all committed at quorum, and the "
+    "fault schedule, streaming rounds all committed at quorum, the "
     "mid-append-killed server recovered to the bitwise state of its "
     "uninterrupted twin (commit sha chain + params identical, recovery "
-    "counters == injected schedule)"
+    "counters == injected schedule), and the hybrid-HE twin committed "
+    f"every round at {hrec.get('expansion_hhe') if isinstance(hrec, dict) else '?'}x "
+    "wire expansion with counters matching the same schedule"
 )
 PY

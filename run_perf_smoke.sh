@@ -36,7 +36,15 @@
 #   (j) static analysis (ISSUE 8): the fast hefl-lint gate exits clean,
 #       and the CLI run's experiment_end metrics embed
 #       analysis.violations = 0 plus an analysis_check event (proof the
-#       pre-flight range/lint certification ran on this tree).
+#       pre-flight range/lint certification ran on this tree);
+#   (k) hybrid-HE uplink (ISSUE 11): --hhe must map to
+#       StreamConfig(upload_kind='hhe') and refuse to run unpacked; a
+#       tiny streaming run under HHE must carry the hhe wire record with
+#       measured expansion_hhe <= 1.1x over the plain quantized bytes and
+#       an hhe.uploads_transciphered counter equal to cohort x rounds;
+#       and its final params must be BITWISE equal to the direct
+#       packed-CKKS twin's — the transcipher-vs-direct parity gate at
+#       the whole-experiment level.
 # Wired into run_tpu_suite.sh as stage 0 (cheap pre-stage, no backend
 # probe needed — both harnesses pin themselves to CPU in smoke mode).
 set -euo pipefail
@@ -78,6 +86,115 @@ JAX_PLATFORMS=cpu python -m hefl_tpu.analysis --fast --json \
   cat "$workdir/hefl_lint.jsonl"
   exit 1
 }
+
+# (k) hybrid-HE uplink (ISSUE 11): wire expansion <= 1.1x + the
+# transcipher-vs-direct bitwise parity gate, at experiment level. The
+# streaming engine shards clients over the virtual device mesh (same
+# emulation the test suite uses).
+JAX_PLATFORMS=cpu \
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=2" \
+python - <<'PY'
+import dataclasses
+import hashlib
+import sys
+
+import numpy as np
+import jax
+
+from hefl_tpu.cli import build_parser, config_from_args
+from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
+from hefl_tpu.fl import HheConfig, PackingConfig, StreamConfig, TrainConfig
+
+fail = []
+
+# The CLI flag path: --hhe maps to upload_kind=hhe + an HheConfig, and
+# refuses to run without packing (the cipher lives in the packed domain).
+argv = ["--dataset", "mnist", "--model", "smallcnn", "--num-clients", "2",
+        "--rounds", "1", "--pack-bits", "8", "--hhe", "--hhe-key-seed", "5"]
+cfg_cli = config_from_args(build_parser().parse_args(argv))
+if cfg_cli.stream is None or cfg_cli.stream.upload_kind != "hhe":
+    fail.append("cli: --hhe did not map to StreamConfig(upload_kind='hhe')")
+if cfg_cli.hhe is None or cfg_cli.hhe.key_seed != 5:
+    fail.append("cli: --hhe-key-seed did not reach the HheConfig")
+try:
+    config_from_args(build_parser().parse_args(["--dataset", "mnist", "--hhe"]))
+    fail.append("cli: --hhe without --pack-bits was not rejected")
+except SystemExit:
+    pass
+
+base = ExperimentConfig(
+    model="smallcnn", dataset="mnist", num_clients=2, rounds=1,
+    encrypted=True, he=HEConfig(n=256), seed=0, n_train=64, n_test=32,
+    train=TrainConfig(num_classes=10, epochs=1, batch_size=8,
+                      augment=False, val_fraction=0.25),
+    packing=PackingConfig(bits=8, interleave=2, clip=0.5),
+    stream=StreamConfig(quorum=1.0),
+)
+print("hhe smoke: direct packed-CKKS twin ...", flush=True)
+direct = run_experiment(base, verbose=False)
+hcfg = dataclasses.replace(
+    base,
+    stream=dataclasses.replace(base.stream, upload_kind="hhe"),
+    hhe=HheConfig(key_seed=0),
+)
+print("hhe smoke: hybrid-HE twin (upload_kind=hhe) ...", flush=True)
+hrun = run_experiment(hcfg, verbose=False)
+
+rec = hrun.get("hhe")
+if not isinstance(rec, dict):
+    fail.append("hhe run: result carries no hhe wire record")
+else:
+    for field in ("hhe_upload", "plain_quantized", "ciphertext_packed",
+                  "expansion_hhe", "reduction_vs_ckks"):
+        if rec.get(field) is None:
+            fail.append(f"hhe record: {field} missing/null")
+    exp = rec.get("expansion_hhe")
+    if not isinstance(exp, (int, float)) or exp > 1.1:
+        fail.append(
+            f"hhe record: measured wire expansion {exp} > the 1.1x gate "
+            "over the plain quantized bytes"
+        )
+    red = rec.get("reduction_vs_ckks")
+    if isinstance(red, (int, float)) and red < 1.2:
+        fail.append(
+            f"hhe record: uplink only {red}x smaller than the packed CKKS "
+            "ciphertext it replaces"
+        )
+
+metrics = (hrun.get("obs") or {}).get("metrics") or {}
+want = base.num_clients * base.rounds
+got = metrics.get("hhe.uploads_transciphered", 0)
+if got != want:
+    fail.append(
+        f"hhe counters: uploads_transciphered {got} != cohort x rounds "
+        f"{want}"
+    )
+
+def _sha(tree):
+    return hashlib.sha256(b"".join(
+        np.ascontiguousarray(np.asarray(leaf)).tobytes()
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )).hexdigest()
+
+sha_d, sha_h = _sha(direct["params"]), _sha(hrun["params"])
+if sha_d != sha_h:
+    fail.append(
+        "hhe parity: final params under HHE transciphering differ bitwise "
+        f"from the direct packed-CKKS twin ({sha_h[:16]} != {sha_d[:16]})"
+    )
+
+if fail:
+    print("PERF SMOKE FAILED (hhe stage):")
+    for f in fail:
+        print(" -", f)
+    sys.exit(1)
+print(
+    f"hhe smoke OK: expansion_hhe {rec['expansion_hhe']}x (<= 1.1x), "
+    f"{rec['reduction_vs_ckks']}x below the packed CKKS uplink, "
+    f"{got} uploads transciphered, final params sha256-equal to the "
+    f"direct twin ({sha_d[:16]})"
+)
+PY
 
 python - "$workdir/mfu_probe.json" "$workdir/profile_smoke.out" \
   "$workdir/events.jsonl" <<'PY'

@@ -65,6 +65,16 @@ t0=$SECONDS
 HEFL_NTT=pallas-interpret python -m pytest -q -m "not slow" \
   tests/test_packing.py
 echo "== packing shard (pallas-interpret): $((SECONDS - t0))s"
+# HHE shard (ISSUE 11): the hybrid-HE uplink suite — stream-cipher units,
+# transcipher-vs-direct parity, engine/journal integration, the static
+# gate — re-run under the Pallas-interpret NTT selector so the symmetric
+# uploads' transciphering (trivial embed + fwd NTT + pad subtract) also
+# exercises the kernel dispatch family; the fused transcipher row's own
+# bitwise-parity test (interpret mode) runs in every configuration.
+t0=$SECONDS
+HEFL_NTT=pallas-interpret python -m pytest -q -m "not slow" \
+  tests/test_hhe.py
+echo "== hhe shard (pallas-interpret): $((SECONDS - t0))s"
 # Journal/durability shard (ISSUE 9): the write-ahead-journal suite —
 # frame codec, torn-tail/chain-break handling, the kill-at-every-boundary
 # recovery matrix — re-run under fsync policy "always", so the maximum-

@@ -1,0 +1,598 @@
+"""Hybrid-HE uplink tests (ISSUE 11).
+
+Layers, cheapest first:
+
+  * the stream cipher as a standalone unit — keystream domain bounds,
+    encrypt/decrypt as a bitwise inverse, per-client/per-round keystream
+    separation, the mod-2**62 add/sub algebra;
+  * transciphering — the XLA reference against the direct packed encrypt
+    (same decrypted integer field sums), the fused Pallas kernel bitwise
+    against the XLA graph (interpret mode), pad provisioning determinism;
+  * THE acceptance gate — with identical quantized updates, the decrypted
+    aggregate via HHE transciphering is bitwise-equal (integer field
+    sums, sha256 hash-gated) to the direct packed-CKKS path, packed
+    k in {1, 4}, across arrival-order permutations and duplicate
+    deliveries; measured HHE uplink bytes <= 1.1x the plain quantized
+    size;
+  * HHE x existing machinery — engine round parity vs the direct path,
+    kill-at-a-boundary journal recovery with persisted symmetric bodies,
+    the no-new-compile guard (traced round counter), dedup idempotence;
+  * the static gate — `certify_transciphering` accepts the default
+    geometry and rejects a deliberately unsafe one NAMING the overflowing
+    op; the hhe modules' exact-integer probes lint clean.
+"""
+
+import hashlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.flatten_util import ravel_pytree
+
+from hefl_tpu.ckks import encoding, ops, quantize
+from hefl_tpu.ckks.keys import CkksContext, keygen
+from hefl_tpu.ckks.packing import PackedSpec, pack_quantized_flat
+from hefl_tpu.ckks.quantize import PackingConfig
+from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+from hefl_tpu.fl import (
+    FaultConfig,
+    HheConfig,
+    StreamConfig,
+    StreamEngine,
+    TrainConfig,
+    aggregate_encrypted,
+    decrypt_average,
+    encrypt_stack_packed,
+)
+from hefl_tpu.fl.secure import hhe_encrypt_stack
+from hefl_tpu.fl.stream import OnlineAccumulator, ct_hash
+from hefl_tpu.hhe import cipher
+from hefl_tpu.hhe import transcipher as hhe_tc
+from hefl_tpu.models import SmallCNN
+from hefl_tpu.parallel import make_mesh
+
+CFG = TrainConfig(
+    epochs=1, batch_size=4, num_classes=10, augment=False, val_fraction=0.25
+)
+
+
+@pytest.fixture(scope="module")
+def ctx_keys():
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(7))
+    return ctx, sk, pk
+
+
+def _rand_tree(key, scale=0.3):
+    k1, k2 = jax.random.split(key)
+    return {
+        "conv": {"kernel": jax.random.normal(k1, (3, 3, 2, 4)) * scale},
+        "dense": {"kernel": jax.random.normal(k2, (20, 6)) * scale},
+    }
+
+
+def _client_trees(num_clients, base, seed=50, eps=0.05):
+    return [
+        jax.tree_util.tree_map(
+            lambda t: t + eps * jax.random.normal(
+                jax.random.key(seed + i), t.shape
+            ),
+            base,
+        )
+        for i in range(num_clients)
+    ]
+
+
+def _setup(num_clients, per_client=8, seed=0):
+    n = num_clients * per_client
+    (x, y), _, _ = make_dataset("mnist", seed=seed, n_train=n, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(n, num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    return model, params, jnp.asarray(xs), jnp.asarray(ys)
+
+
+def _field_sha(v, spec):
+    """sha256 over the decoded integer field sums — the parity currency:
+    the guard band (decrypt noise, which legitimately differs between the
+    two encryption paths) is shifted away first, so equality here is
+    bitwise equality of the integer payload."""
+    fields = quantize.deinterleave_fields(
+        np.asarray(v), spec.k, spec.field_bits, spec.guard
+    )
+    return hashlib.sha256(
+        np.ascontiguousarray(fields.astype(np.int64)).tobytes()
+    ).hexdigest()
+
+
+# ------------------------------------------------------------- the cipher
+
+
+def test_keystream_domain_and_separation():
+    keys = jnp.asarray(cipher.derive_client_keys(0, 3))
+    hi, lo = cipher.keystream_pair(keys[0], jnp.uint32(1), (2, 64))
+    assert hi.dtype == jnp.uint32 and lo.dtype == jnp.uint32
+    # hi, lo < 2**31: hi*2**31 + lo is uniform on [0, 2**62)
+    assert int(jnp.max(hi)) < (1 << 31) and int(jnp.max(lo)) < (1 << 31)
+    # different client, different round -> different streams
+    hi_b, lo_b = cipher.keystream_pair(keys[1], jnp.uint32(1), (2, 64))
+    hi_r, lo_r = cipher.keystream_pair(keys[0], jnp.uint32(2), (2, 64))
+    assert not np.array_equal(np.asarray(lo), np.asarray(lo_b))
+    assert not np.array_equal(np.asarray(lo), np.asarray(lo_r))
+    # deterministic given (key, round)
+    hi2, lo2 = cipher.keystream_pair(keys[0], jnp.uint32(1), (2, 64))
+    np.testing.assert_array_equal(np.asarray(hi), np.asarray(hi2))
+    np.testing.assert_array_equal(np.asarray(lo), np.asarray(lo2))
+
+
+def test_key_derivation_deterministic_and_per_client():
+    a = cipher.derive_client_keys(3, 4)
+    b = cipher.derive_client_keys(3, 4)
+    np.testing.assert_array_equal(a, b)
+    assert len({tuple(row) for row in a}) == 4      # all distinct
+    c = cipher.derive_client_keys(4, 4)
+    assert not np.array_equal(a, c)                 # seed matters
+    with pytest.raises(ValueError):
+        a[0, 0] = 1                                 # lru-cached: read-only
+
+
+def test_stream_cipher_bitwise_roundtrip(ctx_keys):
+    ctx, _, _ = ctx_keys
+    base = _rand_tree(jax.random.key(0))
+    spec = PackedSpec.for_params(
+        base, ctx, PackingConfig(bits=8, interleave=2, clip=0.25), 3
+    )
+    flat, _ = ravel_pytree(_rand_tree(jax.random.key(1)))
+    bflat, _ = ravel_pytree(base)
+    hi, lo, _ = pack_quantized_flat(flat - bflat, spec)
+    key = jnp.asarray(cipher.derive_client_keys(0, 1))[0]
+    w_hi, w_lo = cipher.stream_encrypt(hi, lo, key, jnp.uint32(9))
+    # ciphertext stays in the packed wire domain (hi, lo < 2**31) ...
+    assert int(jnp.max(w_hi)) < (1 << 31) and int(jnp.max(w_lo)) < (1 << 31)
+    # ... actually encrypts (the keystream is not the zero pad) ...
+    assert not np.array_equal(np.asarray(w_lo), np.asarray(lo))
+    # ... and decrypt is the bitwise inverse.
+    d_hi, d_lo = cipher.stream_decrypt(w_hi, w_lo, key, jnp.uint32(9))
+    np.testing.assert_array_equal(np.asarray(d_hi), np.asarray(hi))
+    np.testing.assert_array_equal(np.asarray(d_lo), np.asarray(lo))
+    # wrong round -> garbage (the counter is part of the cipher)
+    g_hi, g_lo = cipher.stream_decrypt(w_hi, w_lo, key, jnp.uint32(8))
+    assert not np.array_equal(np.asarray(g_lo), np.asarray(lo))
+
+
+def test_mod_2_62_add_sub_algebra():
+    rng = np.random.default_rng(0)
+    m31 = (1 << 31) - 1
+
+    def pair(n):
+        return (
+            jnp.asarray(rng.integers(0, 1 << 31, n).astype(np.uint32)),
+            jnp.asarray(rng.integers(0, 1 << 31, n).astype(np.uint32)),
+        )
+
+    a_hi, a_lo = pair(256)
+    b_hi, b_lo = pair(256)
+    s_hi, s_lo = cipher.add_packed_mod(a_hi, a_lo, b_hi, b_lo)
+    # reference in unbounded ints
+    a = np.asarray(a_hi).astype(object) * (1 << 31) + np.asarray(a_lo)
+    b = np.asarray(b_hi).astype(object) * (1 << 31) + np.asarray(b_lo)
+    want = (a + b) % (1 << 62)
+    got = np.asarray(s_hi).astype(object) * (1 << 31) + np.asarray(s_lo)
+    assert (got == want).all()
+    assert int(jnp.max(s_hi)) <= m31 and int(jnp.max(s_lo)) <= m31
+    d_hi, d_lo = cipher.sub_packed_mod(s_hi, s_lo, b_hi, b_lo)
+    np.testing.assert_array_equal(np.asarray(d_hi), np.asarray(a_hi))
+    np.testing.assert_array_equal(np.asarray(d_lo), np.asarray(a_lo))
+
+
+def test_hhe_center_mod_removes_wrap_multiples():
+    guard = 14
+    vals = [5 << guard, 1 << 40, (1 << 61) - 7]
+
+    def carrier(xs):
+        # the transciphered decode reads through uint64 two's-complement
+        # (benign wrap: 2**62 | 2**64) — build it in unbounded ints
+        return np.array(
+            [x & ((1 << 64) - 1) for x in xs], dtype=np.uint64
+        ).astype(np.int64)
+
+    for gamma in (0, 1, 3):
+        carried = carrier([v - gamma * (1 << 62) for v in vals])
+        np.testing.assert_array_equal(
+            cipher.hhe_center_mod(carried, guard),
+            np.array(vals, dtype=np.int64),
+        )
+    # small negative noise survives the shifted window
+    noisy = [v - 3 for v in vals]
+    np.testing.assert_array_equal(
+        cipher.hhe_center_mod(
+            carrier([v - (1 << 62) for v in noisy]), guard
+        ),
+        np.array(noisy, dtype=np.int64),
+    )
+
+
+# -------------------------------------------------------- transciphering
+
+
+def test_wire_expansion_record(ctx_keys):
+    ctx, _, _ = ctx_keys
+    base = _rand_tree(jax.random.key(0))
+    for k in (1, 4):
+        spec = PackedSpec.for_params(
+            base, ctx, PackingConfig(bits=8, interleave=k, clip=0.25), 3
+        )
+        rec = cipher.hhe_bytes_on_wire_record(spec, ctx.num_primes)
+        # THE acceptance bound: symmetric upload <= 1.1x the plain packed
+        # quantized bytes, and strictly below the CKKS ciphertext.
+        assert rec["expansion_hhe"] <= 1.1
+        assert rec["hhe_upload"] < rec["ciphertext_packed"]
+        assert rec["hhe_upload"] == cipher.sym_wire_bytes(spec)
+        assert (
+            rec["plain_quantized"] == spec.n_ct * spec.n * 8
+        )
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_transcipher_parity_with_direct_packed(ctx_keys, k):
+    # THE acceptance parity gate (stack level): identical quantized
+    # updates through (a) direct packed CKKS encrypt and (b) symmetric
+    # encrypt + server transcipher must decode to sha256-identical
+    # integer field sums — in every arrival order, with duplicate
+    # deliveries.
+    ctx, sk, pk = ctx_keys
+    num_clients = 3
+    base = _rand_tree(jax.random.key(0))
+    trees = _client_trees(num_clients, base)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+    enc_keys = jax.random.split(jax.random.key(9), num_clients)
+    spec = PackedSpec.for_params(
+        base, ctx, PackingConfig(bits=8, interleave=k, clip=0.25),
+        num_clients,
+    )
+    # direct path
+    cts, sat_d = encrypt_stack_packed(ctx, pk, stacked, base, enc_keys, spec)
+    ct_sum = aggregate_encrypted(ctx, cts)
+    v_direct = encoding.decode_int_center(
+        ctx.ntt, ops.decrypt(ctx, sk, ct_sum)
+    )
+    want_sha = _field_sha(v_direct, spec)
+    avg_direct = decrypt_average(
+        ctx, sk, ct_sum, num_clients, packing=spec, base_params=base
+    )
+    # hhe path: symmetric encrypt + batched transcipher
+    keys = jnp.asarray(cipher.derive_client_keys(0, num_clients))
+    w_hi, w_lo, sat_h = hhe_encrypt_stack(
+        stacked, base, keys, jnp.uint32(3), spec
+    )
+    np.testing.assert_array_equal(np.asarray(sat_h), np.asarray(sat_d))
+    tc, pad = hhe_tc.transcipher_batch(
+        ctx, spec, pk, w_hi, w_lo, keys, 3, enc_keys
+    )
+    assert tc.scale == spec.guard_scale
+    c0, c1 = np.asarray(tc.c0), np.asarray(tc.c1)
+    rng = np.random.default_rng(1)
+    for trial in range(3):
+        order = rng.permutation(num_clients)
+        acc = OnlineAccumulator(ctx.ntt.p)
+        for c in order:
+            assert acc.fold((int(c), 0), c0[c], c1[c])
+            if trial % 2:      # duplicate redelivery: idempotent
+                assert not acc.fold((int(c), 0), c0[c], c1[c])
+        s0, s1 = acc.value()
+        folded = ops.Ciphertext(
+            c0=jnp.asarray(s0), c1=jnp.asarray(s1), scale=spec.guard_scale
+        )
+        v_h = encoding.decode_int_center(
+            ctx.ntt, ops.decrypt(ctx, sk, folded)
+        )
+        v_rec = cipher.hhe_center_mod(v_h, spec.guard)
+        assert _field_sha(v_rec, spec) == want_sha, (
+            f"arrival order {order} diverged from the direct packed path"
+        )
+        # and the full owner-side decode: bitwise-equal averaged params
+        avg_h = decrypt_average(
+            ctx, sk, folded, num_clients, packing=spec, base_params=base,
+            hhe=True,
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(avg_h),
+            jax.tree_util.tree_leaves(avg_direct),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_transcipher_fused_pallas_bitwise_parity():
+    # The kernel gate (ISSUE 4 lineage): the fused Pallas transcipher row
+    # (Barrett embed + shift-combine + fwd NTT + pad subtract) is bitwise
+    # the XLA reference graph, interpret mode on CPU.
+    from hefl_tpu.ckks import pallas_ntt
+
+    ctx = CkksContext.create(n=1024)
+    _, pk = keygen(ctx, jax.random.key(3))
+    keys = jnp.asarray(cipher.derive_client_keys(0, 2))
+    rng = np.random.default_rng(0)
+    shape = (2, 3, ctx.n)
+    w_hi = jnp.asarray(
+        rng.integers(0, 1 << 31, shape).astype(np.uint32)
+    )
+    w_lo = jnp.asarray(
+        rng.integers(0, 1 << 31, shape).astype(np.uint32)
+    )
+    enc_keys = jax.random.split(jax.random.key(1), 2)
+    pad = hhe_tc.provision_pads(ctx, pk, keys, jnp.uint32(5), enc_keys, 3)
+    c0_x, c1_x = hhe_tc._transcipher_core_xla(
+        ctx.ntt, w_hi, w_lo, pad.c0, pad.c1
+    )
+    c0_p, c1_p = pallas_ntt.transcipher_fused_pallas(
+        ctx.ntt, w_hi, w_lo, pad.c0, pad.c1, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(c0_x), np.asarray(c0_p))
+    np.testing.assert_array_equal(np.asarray(c1_x), np.asarray(c1_p))
+
+
+def test_provision_pads_deterministic(ctx_keys):
+    # Replay's load-bearing property: same (keys, round, enc_keys) ->
+    # bitwise the same pad ciphertexts (what lets journaled symmetric
+    # bodies re-transcipher to the live fold's residues).
+    ctx, _, pk = ctx_keys
+    keys = jnp.asarray(cipher.derive_client_keys(0, 2))
+    enc_keys = jax.random.split(jax.random.key(4), 2)
+    a = hhe_tc.provision_pads(ctx, pk, keys, jnp.uint32(2), enc_keys, 2)
+    b = hhe_tc.provision_pads(ctx, pk, keys, jnp.uint32(2), enc_keys, 2)
+    np.testing.assert_array_equal(np.asarray(a.c0), np.asarray(b.c0))
+    np.testing.assert_array_equal(np.asarray(a.c1), np.asarray(b.c1))
+    c = hhe_tc.provision_pads(ctx, pk, keys, jnp.uint32(3), enc_keys, 2)
+    assert not np.array_equal(np.asarray(a.c0), np.asarray(c.c0))
+
+
+# ------------------------------------------------- engine / end-to-end
+
+
+def test_engine_hhe_round_bitwise_equals_direct(ctx_keys):
+    # The round-level acceptance gate: StreamEngine under upload_kind=hhe
+    # (symmetric uploads + server transcipher) releases a sum whose
+    # decoded average is BITWISE the direct packed round's, same round
+    # key, same cohort, arrival schedule and all.
+    ctx, sk, pk = ctx_keys
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    spec = PackedSpec.for_params(
+        params, ctx, PackingConfig(bits=8, interleave=4, clip=0.5,
+                                   guard_bits=12),
+        num_clients,
+    )
+    key = jax.random.key(22)
+    eng_d = StreamEngine(StreamConfig(quorum=1.0, deadline_s=5.0), None)
+    ct_d, _, _, sm_d = eng_d.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, key, 0, packing=spec
+    )
+    eng_h = StreamEngine(
+        StreamConfig(quorum=1.0, deadline_s=5.0, upload_kind="hhe"), None
+    )
+    ct_h, _, _, sm_h = eng_h.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, key, 0, packing=spec,
+        hhe=HheConfig(),
+    )
+    assert sm_h.fresh == sm_d.fresh == num_clients
+    avg_d = decrypt_average(
+        ctx, sk, ct_d, None, spec, meta=sm_d.meta, packing=spec,
+        base_params=params,
+    )
+    avg_h = decrypt_average(
+        ctx, sk, ct_h, None, spec, meta=sm_h.meta, packing=spec,
+        base_params=params, hhe=True,
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(avg_d), jax.tree_util.tree_leaves(avg_h)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_engine_hhe_no_new_compile_across_rounds(ctx_keys):
+    # The round counter keys the keystream but is TRACED: every round of
+    # an experiment must share one upload executable and one server-side
+    # provision+transcipher executable.
+    from hefl_tpu.fl.stream import _build_upload_fn
+    from hefl_tpu.hhe.transcipher import _build_hhe_server_fn
+
+    ctx, _, pk = ctx_keys
+    num_clients = 2
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    spec = PackedSpec.for_params(
+        params, ctx, PackingConfig(bits=8, interleave=2, clip=0.5),
+        num_clients,
+    )
+    _build_upload_fn.cache_clear()
+    _build_hhe_server_fn.cache_clear()
+    eng = StreamEngine(
+        StreamConfig(quorum=1.0, deadline_s=5.0, upload_kind="hhe"),
+        FaultConfig(seed=1, drop_fraction=0.5),  # masked round included
+    )
+    for r in range(3):
+        eng.run_round(
+            model, CFG, mesh, ctx, pk, params, xs, ys,
+            jax.random.key(40 + r), r, packing=spec, hhe=HheConfig(),
+        )
+    assert _build_upload_fn.cache_info().currsize == 1
+    up = _build_upload_fn(
+        model, CFG, mesh, ctx, None, num_clients, spec, True
+    )
+    assert up._cache_size() == 1, (
+        f"hhe rounds compiled {up._cache_size()} upload programs"
+    )
+    assert _build_hhe_server_fn.cache_info().currsize == 1
+    srv_fn = _build_hhe_server_fn(
+        ctx, int(spec.n_ct), float(spec.guard_scale)
+    )
+    assert srv_fn._cache_size() == 1, (
+        f"hhe rounds compiled {srv_fn._cache_size()} server programs"
+    )
+
+
+def test_journal_recovery_with_persisted_hhe_bodies(tmp_path, ctx_keys):
+    # Kill-at-a-boundary recovery of an HHE round: the journal's fold
+    # bodies are the SYMMETRIC ciphertext bytes (the ~1x wire artifact),
+    # and the recovered server re-transciphers them against re-derived
+    # pads to the sha256-bitwise state of the uninterrupted twin.
+    from hefl_tpu.fl import AggregationServer, CrashConfig, SimulatedCrash
+    from hefl_tpu.fl import journal as jr
+
+    ctx, sk, pk = ctx_keys
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    spec = PackedSpec.for_params(
+        params, ctx, PackingConfig(bits=8, interleave=2, clip=0.5),
+        num_clients,
+    )
+    sc = StreamConfig(quorum=0.75, deadline_s=1.0, upload_kind="hhe")
+    fc = FaultConfig(seed=3, straggler_fraction=0.25, straggler_delay_s=3.0,
+                     duplicate_clients=1)
+    kw = dict(packing=spec, hhe=HheConfig())
+    args = lambda r: (model, CFG, mesh, ctx, pk, params, xs, ys,  # noqa: E731
+                      jax.random.key(100 + r), r)
+
+    twin_ct, _, _, twin_sm = StreamEngine(sc, fc).run_round(*args(0), **kw)
+    twin_sha = ct_hash(twin_ct.c0, twin_ct.c1)
+
+    jp = str(tmp_path / "hhe.wal")
+    srv = AggregationServer(
+        sc, fc, journal_path=jp, fsync_policy=None,
+        crash=CrashConfig(round=0, at="post_fold", after_folds=2),
+    )
+    with pytest.raises(SimulatedCrash):
+        srv.run_round(*args(0), **kw)
+    srv2 = AggregationServer(sc, fc, journal_path=jp, fsync_policy=None)
+    ct_r, _, _, sm_r = srv2.run_round(*args(0), **kw)
+    assert ct_hash(ct_r.c0, ct_r.c1) == twin_sha
+    assert sm_r.record() == twin_sm.record()
+    # the persisted fresh-fold bodies are the symmetric word pairs — the
+    # actual wire artifact (2 uint32 planes, NO limb axis), not the
+    # L-limb CKKS residues the accumulator folds
+    recs = jr.read_journal(jp)
+    folds = [
+        r for r in recs
+        if r["kind"] == "fold" and r["round"] == 0 and "body" in r
+    ]
+    assert folds, "no persisted fold bodies journaled"
+    sym_bytes = 2 * spec.n_ct * ctx.n * 4
+    ckks_bytes = 2 * spec.n_ct * ctx.num_primes * ctx.n * 4
+    for r in folds:
+        assert len(r["body"]) == sym_bytes != ckks_bytes
+    # decrypted average of the recovered sum == the twin's, bitwise
+    avg_t = decrypt_average(
+        ctx, sk, twin_ct, None, spec, meta=twin_sm.meta, packing=spec,
+        base_params=params, hhe=True,
+    )
+    avg_r = decrypt_average(
+        ctx, sk, ct_r, None, spec, meta=sm_r.meta, packing=spec,
+        base_params=params, hhe=True,
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(avg_t), jax.tree_util.tree_leaves(avg_r)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    srv2.close()
+
+
+def test_hhe_requires_packing_and_config_consistency(ctx_keys):
+    ctx, _, pk = ctx_keys
+    model, params, xs, ys = _setup(2)
+    mesh = make_mesh(2)
+    eng = StreamEngine(StreamConfig(upload_kind="hhe"), None)
+    with pytest.raises(ValueError, match="PACKED quantized"):
+        eng.run_round(
+            model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(0), 0
+        )
+    with pytest.raises(ValueError, match="'ckks' or 'hhe'"):
+        StreamConfig(upload_kind="paper-tape")
+    # experiment-level fail-loud: hhe config without the hhe upload kind
+    from hefl_tpu.experiment import ExperimentConfig, run_experiment
+
+    with pytest.raises(ValueError, match="upload_kind"):
+        run_experiment(ExperimentConfig(
+            model="smallcnn", dataset="mnist", num_clients=2, rounds=1,
+            encrypted=True, hhe=HheConfig(),
+            stream=StreamConfig(quorum=1.0),
+            packing=PackingConfig(bits=8),
+        ))
+    with pytest.raises(ValueError, match="PackingConfig"):
+        run_experiment(ExperimentConfig(
+            model="smallcnn", dataset="mnist", num_clients=2, rounds=1,
+            encrypted=True, hhe=HheConfig(),
+            stream=StreamConfig(quorum=1.0, upload_kind="hhe"),
+        ))
+
+
+# ------------------------------------------------------- the static gate
+
+
+def test_certify_transciphering_accepts_default_and_names_offender():
+    from hefl_tpu.analysis.ranges import certify_transciphering
+
+    ctx = CkksContext.create(n=256)
+    q = int(ctx.modulus)
+    good = certify_transciphering(q, 8, 3, 8, 16)
+    assert good.ok, good.summary()
+    assert "CERTIFIED" in good.summary()
+    # deliberately unsafe: a modulus too small for the q/2 wall — the
+    # refutation must NAME the overflowing op
+    bad = certify_transciphering(1 << 40, 8, 3, 8, 16)
+    assert not bad.ok
+    assert bad.findings and all(f.op for f in bad.findings)
+    assert "`" in str(bad.findings[0])  # op named in the message
+    # and an interleave far past the carry-free headroom
+    bad_k = certify_transciphering(q, 16, 16, 1024, 16)
+    assert not bad_k.ok
+
+
+def test_engine_rejects_uncertified_hhe_geometry(ctx_keys):
+    # The round-setup gate: an HHE round whose geometry fails the range
+    # proof refuses to run, naming the offender, BEFORE any training.
+    import dataclasses as dc
+
+    ctx, _, pk = ctx_keys
+    model, params, xs, ys = _setup(2)
+    mesh = make_mesh(2)
+    spec = PackedSpec.for_params(
+        params, ctx, PackingConfig(bits=8, interleave=2, clip=0.5), 2
+    )
+    # forge a spec whose guard band blows the packed domain: the payload
+    # shifts escape the mod-2**62 recovery window and the proof must
+    # refuse the round
+    bad = dc.replace(spec, guard=60)
+    eng = StreamEngine(
+        StreamConfig(quorum=1.0, upload_kind="hhe"), None
+    )
+    with pytest.raises(ValueError, match="static range analysis"):
+        eng.run_round(
+            model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(0),
+            0, packing=bad, hhe=HheConfig(),
+        )
+
+
+def test_hhe_exact_int_probes_registered_and_lint_clean():
+    from hefl_tpu.analysis import lint
+
+    regions = lint.exact_int_regions()
+    mine = [r for r in regions if r.startswith("hhe.")]
+    assert set(mine) >= {
+        "hhe.cipher.keystream",
+        "hhe.cipher.stream_encrypt",
+        "hhe.transcipher.core",
+    }
+    findings = []
+    for region in mine:
+        fn, fargs = regions[region]
+        findings.extend(lint.lint_fn(fn, fargs, region, exact_int=True))
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_hhe_scope_coverage_clean():
+    from hefl_tpu.analysis import coverage
+
+    assert coverage.check_hhe_coverage() == []

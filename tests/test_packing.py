@@ -268,6 +268,89 @@ def test_packed_stack_aggregate_decrypt_matches_quantized_mean(ctx_keys):
         assert float(jnp.max(jnp.abs(a - b))) <= spec.error_budget
 
 
+def test_packed_round_per_tensor_clip_schedule(ctx_keys):
+    # ROADMAP carried item (ISSUE 11 satellite): a TUPLE clip is a
+    # per-tensor schedule — one bound per parameter-tree leaf in ravel
+    # order, each tensor quantized on its own grid all the way through
+    # encrypt_stack_packed -> lazy modular sum -> packed decrypt, pinned
+    # against a per-coefficient-step reference.
+    ctx, sk, pk = ctx_keys
+    num_clients = 3
+    base = _rand_tree(jax.random.key(2))
+
+    # Leaf deltas at very different magnitudes (ravel order: conv then
+    # dense): one coarse grid would waste the dense leaf's levels; the
+    # schedule gives each leaf its own clip.
+    def perturb(i):
+        k1, k2 = jax.random.split(jax.random.key(80 + i))
+        return {
+            "conv": {
+                "kernel": base["conv"]["kernel"]
+                + 0.04 * jax.random.normal(k1, (3, 3, 2, 4))
+            },
+            "dense": {
+                "kernel": base["dense"]["kernel"]
+                + 0.004 * jax.random.normal(k2, (20, 6))
+            },
+        }
+
+    trees = [perturb(i) for i in range(num_clients)]
+    cfg = PackingConfig(bits=8, interleave=2, clip=(0.25, 0.025))
+    spec = PackedSpec.for_params(base, ctx, cfg, num_clients)
+    assert spec.clips == (0.25, 0.025)
+    assert spec.spans == (72, 120)
+    # The scalar compat fields collapse to the COARSEST grid (the
+    # error-budget bound).
+    assert spec.clip == 0.25 and spec.step == quantize.symmetric_step(0.25, 8)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+    enc_keys = jax.random.split(jax.random.key(13), num_clients)
+    cts, sat = encrypt_stack_packed(ctx, pk, stacked, base, enc_keys, spec)
+    assert np.asarray(sat).tolist() == [0] * num_clients
+    avg = decrypt_average(
+        ctx, sk, aggregate_encrypted(ctx, cts), num_clients,
+        packing=spec, base_params=base,
+    )
+    # Reference: quantize each client's flat delta on the PER-COEFFICIENT
+    # step vector (each leaf's step broadcast over its span), average.
+    from jax.flatten_util import ravel_pytree
+
+    from hefl_tpu.ckks.packing import step_vector
+
+    steps = jnp.asarray(step_vector(spec))
+    base_flat, unravel = ravel_pytree(base)
+    deltas = [
+        np.asarray(
+            quantize.dequantize(
+                quantize.quantize(
+                    ravel_pytree(t)[0] - base_flat, steps, spec.bits
+                ),
+                steps,
+            )
+        )
+        for t in trees
+    ]
+    expect = unravel(base_flat + jnp.asarray(np.mean(deltas, axis=0)))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(avg), jax.tree_util.tree_leaves(expect)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    # The fine leaf really quantized on ITS grid: against the true mean
+    # its error is bounded by the fine step — ~10x tighter than the
+    # coarse-grid budget the scalar clip would allow.
+    true_dense = sum(t["dense"]["kernel"] for t in trees) / num_clients
+    fine_budget = 0.5 * quantize.symmetric_step(0.025, 8) + 1e-4
+    assert (
+        float(jnp.max(jnp.abs(avg["dense"]["kernel"] - true_dense)))
+        <= fine_budget
+    )
+    assert fine_budget < 0.2 * spec.error_budget
+    # A schedule whose length does not match the template fails loudly.
+    with pytest.raises(ValueError, match="one clip per leaf"):
+        PackedSpec.for_params(
+            base, ctx, PackingConfig(bits=8, clip=(0.25,)), num_clients
+        )
+
+
 def test_packed_excluded_client_composes_with_surviving_count(ctx_keys):
     # A zeroed ciphertext (the masked engine's exclusion) contributes
     # nothing; the unpack's surviving-count offset handling must decode the
