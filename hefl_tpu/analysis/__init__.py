@@ -26,12 +26,17 @@ from hefl_tpu.analysis import coverage, lint, ranges
 from hefl_tpu.analysis.lint import ALLOWLIST, Allow, LintFinding
 from hefl_tpu.analysis.ranges import (
     AggregationCertificate,
+    FoldCertificate,
+    InferenceCertificate,
     Interval,
+    LoopReport,
     PackingCertificate,
     RangeFinding,
     TranscipherCertificate,
     certified_max_interleave,
     certify_aggregation,
+    certify_fold_inductive,
+    certify_inference,
     certify_packing,
     certify_transciphering,
     eval_jaxpr_ranges,
@@ -52,7 +57,10 @@ def check_experiment(cfg, ctx=None, say=None):
         the streaming engine's int64 fold;
       * the packed-quantized headroom (`certify_packing`) for the
         configured (bits, interleave, clients, guard) when packing is
-        enabled — the full-inputs proof, not a sampled test.
+        enabled — the full-inputs proof, not a sampled test;
+      * for streaming configs, the inductive fold invariant
+        (`certify_fold_inductive`): the OnlineAccumulator stays canonical
+        for ANY arrival count, proven as a loop post-fixpoint.
 
     Publishes `analysis.violations` (an obs counter embedded in artifact
     metrics snapshots; 0 on a healthy config) and an `analysis_check`
@@ -67,6 +75,7 @@ def check_experiment(cfg, ctx=None, say=None):
 
     report: dict = {
         "aggregation": None, "packing": None, "transciphering": None,
+        "fold": None,
     }
     certs = []
     if getattr(cfg, "encrypted", True) and not getattr(
@@ -92,6 +101,15 @@ def check_experiment(cfg, ctx=None, say=None):
         agg = certify_aggregation(max_prime)
         report["aggregation"] = agg
         certs.append(agg)
+        if getattr(cfg, "stream", None) is not None:
+            # Streaming rounds fold arrivals one at a time: the inductive
+            # fold certificate (ISSUE 12) proves the OnlineAccumulator
+            # invariant for ANY arrival count before the engine runs (the
+            # engine re-checks at round setup with the built PackedSpec;
+            # both calls share one lru_cached proof per geometry).
+            fold = certify_fold_inductive(max_prime)
+            report["fold"] = fold
+            certs.append(fold)
         packing = getattr(cfg, "packing", None)
         if packing is not None and packing.enabled:
             from hefl_tpu.ckks.quantize import max_interleave
@@ -121,7 +139,13 @@ def check_experiment(cfg, ctx=None, say=None):
                 report["transciphering"] = tc_cert
                 certs.append(tc_cert)
 
-    violations = sum(len(c.findings) for c in certs)
+    # The fold certificate's findings are already embedded in the
+    # aggregation certificate (certify_aggregation leg 3, the same
+    # lru-cached proof) — excluded from the count so a broken fold is
+    # one violation set, not two; its summary still rides as evidence.
+    violations = sum(
+        len(c.findings) for c in certs if c is not report["fold"]
+    )
     # inc(0) REGISTERS the counter: a clean run's artifacts still carry
     # analysis.violations = 0 as queryable evidence the gate ran.
     obs_metrics.counter("analysis.violations").inc(violations)
@@ -140,19 +164,61 @@ def check_experiment(cfg, ctx=None, say=None):
     return report
 
 
+def check_inference(ctx, say=None):
+    """Pre-flight static analysis of one encrypted-inference serving
+    context (ISSUE 12) — the serving twin of :func:`check_experiment`.
+
+    Certifies the rotate-and-sum Galois ladder (`certify_inference`) at
+    the context's ring geometry — carried residues canonical at any
+    ladder depth, gadget digit x key products inside the 2**62 wall —
+    publishes the same `analysis.violations` counter and `analysis_check`
+    event training runs embed, and raises :class:`AnalysisError` naming
+    the offending op on any violation. -> {"inference": certificate}.
+    """
+    import numpy as np
+
+    from hefl_tpu.obs import events as obs_events
+    from hefl_tpu.obs import metrics as obs_metrics
+
+    max_prime = int(np.asarray(ctx.ntt.p).max())
+    cert = certify_inference(
+        max_prime, int(ctx.ksk_digit_bits), int(ctx.ksk_num_digits)
+    )
+    violations = len(cert.findings)
+    obs_metrics.counter("analysis.violations").inc(violations)
+    obs_events.emit(
+        "analysis_check",
+        violations=violations,
+        certified=[cert.summary()],
+    )
+    if violations:
+        raise AnalysisError(
+            f"static analysis rejected this serving ring — {cert.summary()}"
+        )
+    if say is not None:
+        say(f"analysis: {cert.summary()}")
+    return {"inference": cert}
+
+
 __all__ = [
     "AnalysisError",
     "check_experiment",
+    "check_inference",
     "ranges",
     "lint",
     "coverage",
     "Interval",
     "RangeFinding",
+    "LoopReport",
     "PackingCertificate",
     "AggregationCertificate",
+    "FoldCertificate",
+    "InferenceCertificate",
     "TranscipherCertificate",
     "certify_packing",
     "certify_aggregation",
+    "certify_fold_inductive",
+    "certify_inference",
     "certify_transciphering",
     "certified_max_interleave",
     "eval_jaxpr_ranges",

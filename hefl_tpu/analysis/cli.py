@@ -2,7 +2,9 @@
 
     hefl-lint                  # full gate (exit 1 on any violation)
     hefl-lint --fast           # skip the compile-heavy coverage stages
-    hefl-lint --json           # machine-readable findings
+    hefl-lint --json           # machine-readable JSON lines (schema
+                               # documented in README "Static analysis";
+                               # pinned by tests/test_analysis.py)
     hefl-lint --fixture F.py   # run ONE rule against a violation fixture
                                # (exit 1 when the seeded violation fires —
                                # the fixture CONTRACT is that it does)
@@ -10,38 +12,57 @@
 Stages of the full gate, each a CI failure on findings:
 
   1. source sweep — AST-level `jnp.remainder`/`lax.rem`/`jnp.mod` scan
-  2. exact-integer regions — the modules' declared probes, no rem/div,
-     no float contamination
+  2. exact-integer regions — the modules' declared probes (now including
+     the LOOP probes: the streaming fold's arrival while-loop, the HHE
+     keystream counter loop, the inference ladder), no rem/div, no float
+     contamination
   3. range certification — aggregation no-wrap at the default ring's
-     prime size, plus the full supported PackingConfig grid (b × C at
-     auto-k; every point certified by interval analysis, with the
+     prime size (with the streaming fold proven INDUCTIVELY for any
+     arrival count, `certify_fold_inductive`), the rotate-and-sum
+     serving ladder (`certify_inference`: canonical carries at any
+     ladder depth, gadget products inside the 2**62 wall), plus the full
+     supported PackingConfig grid (b × C at auto-k; every point's
+     C-client sums derived as scan-fold loop fixpoints, with the
      formula-vs-analysis divergence tripwire armed inside
      `max_interleave`), each point paired with its HHE transciphering
      twin (`certify_transciphering`: keystream-subtract carry-free,
-     q/2 wall, mod-2**62 recovery window)
+     q/2 wall, mod-2**62 recovery window, counter-loop no-wrap)
   4. hot-path lint — the real round programs (both fusion backends,
      secure included): integer rem/div, f64, host callbacks
   5. donation — declared `donate_argnums` sites actually alias
   6. scope coverage — every leaf compute op phase-attributed (jaxpr +
      compiled HLO, both fusion backends, secure included, plus the
-     streaming upload program the durable aggregation server dispatches
-     and the hybrid-HE upload/transcipher programs)
+     streaming upload program the durable aggregation server dispatches,
+     the hybrid-HE upload/transcipher programs, and the encrypted-
+     inference serving program with its gather-inclusive leaf set)
+
+`--json` emits one JSON object per line, each with a `type` field:
+`certificate` (the range proofs stage 3 produced), `finding` (rule /
+where / message), and a final `summary` (schema version, violation
+count, per-stage timings). Stage timings also print on the human path,
+so gate-cost regressions are visible in CI logs.
 
 Fixture protocol (tests/fixtures/lint/*.py): the module defines `RULE`
 (one of forbidden-primitive | float-contamination | missing-scope |
-broken-donation) and `build()` returning `(fn, args)` — jitted for
-missing-scope, `(jitted, args)` with donation declared for
-broken-donation.
+broken-donation | loop-overflow) and `build()` returning `(fn, args)` —
+jitted for missing-scope, `(jitted, args)` with donation declared for
+broken-donation; loop-overflow fixtures are traced and RANGE-analyzed
+(the findings cite the loop-carried op that overflows).
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import importlib.util
 import json
 import os
 import sys
 import time
+
+# The hefl-lint --json line-schema version (bump on breaking changes;
+# pinned by the golden-schema test).
+JSON_SCHEMA_VERSION = 1
 
 
 # The supported PackingConfig grid the tree gate certifies end to end:
@@ -52,14 +73,20 @@ GRID_CLIENTS = (2, 8, 32, 256, 1024)
 GRID_GUARD = 16
 
 
-def _default_ring() -> tuple[int, int]:
-    """(modulus q, largest RNS prime) of the default HEConfig ring."""
+def _default_ring() -> tuple[int, int, int, int]:
+    """(modulus q, largest RNS prime, ksk digit bits, ksk digit count) of
+    the default HEConfig ring."""
     import numpy as np
 
     from hefl_tpu.experiment import HEConfig
 
     ctx = HEConfig().build()
-    return int(ctx.modulus), int(np.asarray(ctx.ntt.p).max())
+    return (
+        int(ctx.modulus),
+        int(np.asarray(ctx.ntt.p).max()),
+        int(ctx.ksk_digit_bits),
+        int(ctx.ksk_num_digits),
+    )
 
 
 def run_fixture(path: str) -> list:
@@ -81,34 +108,105 @@ def run_fixture(path: str) -> list:
         found = coverage.check_fn_coverage(fn, tuple(args), name)
     elif rule == "broken-donation":
         found = lint.check_donation(fn, tuple(args), name)
+    elif rule == "loop-overflow":
+        # Trace and RANGE-analyze (ISSUE 12): a loop-carried integer that
+        # can escape its carrier only after enough iterations is invisible
+        # to the per-eqn lint rules — the loop fixpoint finds it and the
+        # finding cites the carried op.
+        import jax
+
+        from hefl_tpu.analysis import ranges
+
+        res = ranges.eval_jaxpr_ranges(
+            jax.make_jaxpr(fn)(*args),
+            # The fixture's concrete input ranges: each STEP is in-bounds
+            # (per-eqn checks alone stay blind); only the loop fixpoint
+            # sees the carry escape.
+            [ranges._array_interval(leaf)
+             for leaf in jax.tree_util.tree_leaves(args)],
+        )
+        found = [
+            lint.LintFinding(
+                rule="loop-overflow", where=name, message=f.message
+            )
+            for f in res.findings
+        ]
     else:
         raise SystemExit(f"{path}: unknown fixture RULE {rule!r}")
     # The fixture contract: its seeded violation must fire under ITS rule.
     return [f for f in found if f.rule == rule] or found
 
 
-def run_tree_gate(fast: bool = False, progress=print) -> list:
-    """The whole-tree gate; -> findings (empty on a healthy tree)."""
+@dataclasses.dataclass
+class GateReport:
+    """What one whole-tree gate run established: the findings (empty on a
+    healthy tree), the range certificates stage 3 produced (as the JSON
+    records `--json` emits), and per-stage wall-clock — the gate-cost
+    telemetry CI watches."""
+
+    findings: list = dataclasses.field(default_factory=list)
+    certificates: list = dataclasses.field(default_factory=list)
+    stages: list = dataclasses.field(default_factory=list)
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(s["seconds"] for s in self.stages)
+
+
+def _cert_record(kind: str, cert) -> dict:
+    """One certificate as a `--json` line (type=certificate)."""
+    rec = {"type": "certificate", "kind": kind, "ok": bool(cert.ok),
+           "summary": cert.summary()}
+    for field in ("modulus_bits", "prime_bits", "bits", "k", "clients",
+                  "fbits", "guard", "chunk", "ceiling_bits", "digit_bits",
+                  "num_digits", "depth_ceiling_bits", "count_ceiling_bits"):
+        if hasattr(cert, field):
+            rec[field] = getattr(cert, field)
+    return rec
+
+
+def run_tree_gate(fast: bool = False, progress=print) -> GateReport:
+    """The whole-tree gate; -> GateReport (no findings on a healthy
+    tree)."""
     from hefl_tpu.analysis import coverage, lint, ranges
 
-    findings: list = []
+    report = GateReport()
 
     def stage(label, fn):
         t0 = time.time()
         got = fn()
-        findings.extend(got)
-        progress(
-            f"  {label}: {len(got)} finding(s) [{time.time() - t0:.1f}s]"
+        seconds = round(time.time() - t0, 2)
+        report.findings.extend(got)
+        report.stages.append(
+            {"stage": label, "seconds": seconds, "findings": len(got)}
         )
+        progress(f"  {label}: {len(got)} finding(s) [{seconds:.1f}s]")
 
     stage("source sweep", lint.source_sweep)
     stage("exact-int regions", lint.lint_exact_regions)
 
     def certs():
         got = []
-        q, max_prime = _default_ring()
-        agg = ranges.certify_aggregation(max_prime)
-        got.extend(agg.findings)
+
+        def record(kind, cert):
+            report.certificates.append(_cert_record(kind, cert))
+            got.extend(cert.findings)
+
+        q, max_prime, ksk_w, ksk_d = _default_ring()
+        record("aggregation", ranges.certify_aggregation(max_prime))
+        # The streaming fold, proven inductively for ANY arrival count
+        # (ISSUE 12). Its findings are ALREADY embedded in the
+        # aggregation certificate (certify_aggregation leg 3, the same
+        # lru-cached proof) — only the standalone record is added, so a
+        # broken fold is counted once, not twice.
+        report.certificates.append(_cert_record(
+            "fold-inductive", ranges.certify_fold_inductive(max_prime)
+        ))
+        # The rotate-and-sum serving ladder (ISSUE 12): the encrypted-
+        # inference direction's analysis prerequisite, gated on every run.
+        record("inference", ranges.certify_inference(
+            max_prime, ksk_w, ksk_d
+        ))
         from hefl_tpu.ckks.quantize import max_interleave
 
         points = 0
@@ -118,18 +216,17 @@ def run_tree_gate(fast: bool = False, progress=print) -> list:
                     k = max_interleave(q, bits, clients, GRID_GUARD)
                 except ValueError:
                     continue  # no headroom at all: correctly unsupported
-                cert = ranges.certify_packing(
+                record("packing", ranges.certify_packing(
                     q, bits, k, clients, GRID_GUARD
-                )
-                got.extend(cert.findings)
+                ))
                 # Hybrid-HE transciphering (ISSUE 11) rides the same
                 # grid: every packing point the gate certifies must also
                 # survive the keystream-subtract / q/2-wall / mod-2**62
                 # recovery proof, so an HHE run can never select an
                 # uncertified geometry.
-                got.extend(ranges.certify_transciphering(
+                record("transciphering", ranges.certify_transciphering(
                     q, bits, k, clients, GRID_GUARD
-                ).findings)
+                ))
                 points += 1
         progress(
             f"    packing grid: {points} (b, C) points certified "
@@ -168,7 +265,11 @@ def run_tree_gate(fast: bool = False, progress=print) -> list:
             "scope coverage [hhe]",
             coverage.check_hhe_coverage,
         )
-    return findings
+        stage(
+            "scope coverage [inference]",
+            coverage.check_inference_coverage,
+        )
+    return report
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -195,18 +296,24 @@ def main(argv: list[str] | None = None) -> int:
     progress = (lambda *_: None) if quiet else print
     if args.fixture:
         findings = run_fixture(args.fixture)
+        report = GateReport(findings=list(findings))
     else:
         progress("hefl-lint: whole-tree static-analysis gate")
-        findings = run_tree_gate(fast=args.fast, progress=progress)
+        report = run_tree_gate(fast=args.fast, progress=progress)
+        findings = report.findings
 
     if args.json:
-        for f in findings:
-            print(json.dumps(
-                {"rule": f.rule, "where": f.where, "message": f.message}
-            ))
+        for line in emit_json(report):
+            print(line)
     else:
         for f in findings:
             print(f"  FAIL {f}")
+        if report.stages:
+            timings = " ".join(
+                f"{s['stage']}={s['seconds']:.1f}s" for s in report.stages
+            )
+            print(f"hefl-lint stage timings: {timings} "
+                  f"(total {report.total_seconds:.1f}s)")
     if findings:
         if not quiet:
             print(f"hefl-lint: {len(findings)} violation(s)")
@@ -214,6 +321,30 @@ def main(argv: list[str] | None = None) -> int:
     if not quiet:
         print("hefl-lint: clean")
     return 0
+
+
+def emit_json(report: GateReport) -> list[str]:
+    """The `--json` JSON-lines document (schema documented in README
+    "Static analysis" and pinned by the golden-schema test): certificate
+    lines, finding lines, one trailing summary line."""
+    lines = [json.dumps(rec) for rec in report.certificates]
+    lines.extend(
+        json.dumps({
+            "type": "finding", "rule": f.rule, "where": f.where,
+            "message": f.message,
+        })
+        for f in report.findings
+    )
+    lines.append(json.dumps({
+        "type": "summary",
+        "schema": JSON_SCHEMA_VERSION,
+        "ok": not report.findings,
+        "violations": len(report.findings),
+        "certificates": len(report.certificates),
+        "stages": report.stages,
+        "total_seconds": round(report.total_seconds, 2),
+    }))
+    return lines
 
 
 if __name__ == "__main__":  # pragma: no cover

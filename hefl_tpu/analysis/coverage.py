@@ -36,23 +36,36 @@ from hefl_tpu.analysis.lint import LintFinding
 LEAF_PRIMS = ("dot_general", "conv_general_dilated")
 # compiled-HLO leaf opcodes.
 LEAF_OPCODES = ("convolution", "dot")
+# The SERVING programs' leaf set (ISSUE 12): encrypted inference has no
+# GEMM/conv stream — its device time lives in the Montgomery pointwise
+# chains and the Galois automorphism GATHERS, so the gather joins the
+# leaf set there (the rotation is the ladder's data movement).
+INFERENCE_LEAF_PRIMS = ("dot_general", "conv_general_dilated", "gather")
+INFERENCE_LEAF_OPCODES = ("convolution", "dot", "gather")
 
-_INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*[^=\s]+\s+(" +
-    "|".join(LEAF_OPCODES) + r")\(([^\n]*)$",
-    re.M,
-)
 _OPNAME_RE = re.compile(r'op_name="([^"]*)"')
 
 
-def jaxpr_scope_findings(closed, where: str) -> list[LintFinding]:
+def _instr_re(leaf_opcodes: tuple) -> re.Pattern:
+    return re.compile(
+        r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*[^=\s]+\s+(" +
+        "|".join(leaf_opcodes) + r")\(([^\n]*)$",
+        re.M,
+    )
+
+
+def jaxpr_scope_findings(
+    closed, where: str, *, leaf_prims: tuple = LEAF_PRIMS
+) -> list[LintFinding]:
     """missing-scope findings for leaf compute eqns whose trace-time name
     stack carries no hefl.* scope (the strict, source-structural rule).
 
     Name stacks inside call-like sub-jaxprs (custom_vjp_call, pjit, scan,
-    shard_map, ...) are RELATIVE to the call eqn, so the walk threads the
-    inherited prefix down — an einsum inside a custom-VJP body whose CALL
-    sits under `hefl.sgd_core` is correctly attributed."""
+    while, shard_map, cond, ...) are RELATIVE to the call eqn, so the
+    walk threads the inherited prefix down through EVERY sub-jaxpr a
+    param carries — an einsum inside a custom-VJP body whose CALL sits
+    under `hefl.sgd_core`, or a looped leaf op inside a `while` body
+    whose call eqn carries the scope, is correctly attributed."""
     from jax.extend import core as jex_core
 
     from hefl_tpu.analysis.lint import _as_jaxprs
@@ -65,7 +78,7 @@ def jaxpr_scope_findings(closed, where: str) -> list[LintFinding]:
             stack = str(getattr(eqn.source_info, "name_stack", ""))
             full = f"{prefix}/{stack}"
             if (
-                eqn.primitive.name in LEAF_PRIMS
+                eqn.primitive.name in leaf_prims
                 and obs_scopes.scope_of(full) is None
             ):
                 shape = getattr(eqn.outvars[0].aval, "shape", ())
@@ -86,7 +99,9 @@ def jaxpr_scope_findings(closed, where: str) -> list[LintFinding]:
     return findings
 
 
-def leaf_scope_findings(hlo_text: str, where: str) -> list[LintFinding]:
+def leaf_scope_findings(
+    hlo_text: str, where: str, *, leaf_opcodes: tuple = LEAF_OPCODES
+) -> list[LintFinding]:
     """missing-scope findings for one compiled module's HLO text: leaf
     instructions that KEPT their op_name provenance but resolve to no
     hefl.* scope. Metadata-less (XLA-synthesized) instructions are the
@@ -94,7 +109,7 @@ def leaf_scope_findings(hlo_text: str, where: str) -> list[LintFinding]:
     from hefl_tpu.obs import scopes as obs_scopes
 
     findings: list[LintFinding] = []
-    for m in _INSTR_RE.finditer(hlo_text):
+    for m in _instr_re(leaf_opcodes).finditer(hlo_text):
         name, opcode, rest = m.groups()
         op_name_m = _OPNAME_RE.search(rest)
         if op_name_m is None:
@@ -113,7 +128,11 @@ def leaf_scope_findings(hlo_text: str, where: str) -> list[LintFinding]:
     return findings
 
 
-def check_fn_coverage(fn, args: tuple, where: str) -> list[LintFinding]:
+def check_fn_coverage(
+    fn, args: tuple, where: str, *,
+    leaf_prims: tuple = LEAF_PRIMS,
+    leaf_opcodes: tuple = LEAF_OPCODES,
+) -> list[LintFinding]:
     """Both layers for one function: the strict jaxpr rule plus the
     compiled-HLO rule (metadata-preserving compile — a persistent-cache
     deserialization answers as_text() without op_name)."""
@@ -121,10 +140,14 @@ def check_fn_coverage(fn, args: tuple, where: str) -> list[LintFinding]:
 
     from hefl_tpu.obs.trace import metadata_preserving_compile
 
-    findings = jaxpr_scope_findings(jax.make_jaxpr(fn)(*args), where)
+    findings = jaxpr_scope_findings(
+        jax.make_jaxpr(fn)(*args), where, leaf_prims=leaf_prims
+    )
     with metadata_preserving_compile():
         txt = fn.lower(*args).compile().as_text()
-    findings.extend(leaf_scope_findings(txt, where))
+    findings.extend(
+        leaf_scope_findings(txt, where, leaf_opcodes=leaf_opcodes)
+    )
     return findings
 
 
@@ -242,13 +265,54 @@ def check_hhe_coverage() -> list[LintFinding]:
     return findings
 
 
+def check_inference_coverage() -> list[LintFinding]:
+    """The encrypted-inference SERVING program (ISSUE 12): the compiled
+    linear scorer — ct x plaintext multiply, the scanned rotate-and-sum
+    Galois ladder, bias add — at both layers, with the serving leaf set
+    (GEMM/conv plus GATHER: the automorphism is the ladder's dominant
+    data movement, and a refactor that hoists it out of its
+    `hefl.serve_rotate` scope must fail here). The scan call itself stays
+    a scope-less container per the obs.scopes annotation rule; the leaf
+    ops INSIDE the loop body attribute through the threaded name-stack
+    prefix."""
+    import numpy as np
+
+    import jax
+
+    from hefl_tpu import he_inference as hei
+    from hefl_tpu.ckks import encoding
+    from hefl_tpu.ckks.keys import CkksContext, keygen
+
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(0))
+    gks = hei.gen_rotation_keys(ctx, sk, jax.random.key(1))
+    rng = np.random.default_rng(0)
+    d = encoding.num_slots(ctx.ntt)
+    scorer = hei.LinearScorer(
+        ctx, rng.normal(0, 0.3, (2, d)), rng.normal(0, 0.2, (2,)), gks
+    )
+    ct_x = hei.encrypt_features(
+        ctx, pk, rng.normal(0, 0.5, (d,)), jax.random.key(2)
+    )
+    fn = hei._linear_program(ctx, scorer.pt_scale)
+    return check_fn_coverage(
+        fn, (ct_x, scorer._w_res, scorer._b_res, scorer._ladder),
+        "he_inference.serve[linear]",
+        leaf_prims=INFERENCE_LEAF_PRIMS,
+        leaf_opcodes=INFERENCE_LEAF_OPCODES,
+    )
+
+
 __all__ = [
     "LEAF_PRIMS",
     "LEAF_OPCODES",
+    "INFERENCE_LEAF_PRIMS",
+    "INFERENCE_LEAF_OPCODES",
     "jaxpr_scope_findings",
     "leaf_scope_findings",
     "check_fn_coverage",
     "check_round_coverage",
     "check_stream_coverage",
     "check_hhe_coverage",
+    "check_inference_coverage",
 ]

@@ -106,6 +106,30 @@ ALLOWLIST: tuple[Allow, ...] = (
         ),
     ),
     Allow(
+        region="fl.stream.fold_loop",
+        rule="forbidden-primitive",
+        primitive="rem",
+        reason=(
+            "the arrival-loop form of the same host-side fold mirror "
+            "(fold_loop_probe, ISSUE 12): the `%` inside the while body "
+            "is OnlineAccumulator._add's numpy modulo, traced so the "
+            "INDUCTIVE invariant proof analyzes the real loop shape"
+        ),
+    ),
+    Allow(
+        region="he_inference.rotate_ladder",
+        rule="forbidden-primitive",
+        primitive="rem",
+        reason=(
+            "rotation_ladder_range_probe (ISSUE 12) mirrors the serving "
+            "ladder's canonical-residue arithmetic with `%` standing in "
+            "for the Montgomery REDC contract — a probe traced for range "
+            "analysis, never executed on a device; the REAL ladder "
+            "(rotate_and_sum_scan) stays division-free and is hot-path "
+            "linted separately"
+        ),
+    ),
+    Allow(
         region="*",
         rule="forbidden-primitive",
         primitive="rem",
@@ -310,6 +334,7 @@ def lint_fn(
 def exact_int_regions() -> dict[str, tuple[Callable, tuple]]:
     """Every declared exact-integer region in the codebase, as the shaped
     jaxpr probes their home modules export."""
+    from hefl_tpu import he_inference
     from hefl_tpu.ckks import encoding, packing, quantize
     from hefl_tpu.fl import secure, stream
     from hefl_tpu.hhe import cipher as hhe_cipher
@@ -318,7 +343,7 @@ def exact_int_regions() -> dict[str, tuple[Callable, tuple]]:
 
     regions: dict[str, tuple[Callable, tuple]] = {}
     for mod in (quantize, packing, encoding, secure, stream, collectives,
-                hhe_cipher, hhe_transcipher):
+                hhe_cipher, hhe_transcipher, he_inference):
         regions.update(mod.exact_int_probes())
     return regions
 

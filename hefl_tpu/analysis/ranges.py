@@ -16,9 +16,25 @@ abstract interpretation of the real jaxprs:
     rather than wrapped), floats with ±inf for float dtypes.
   * :func:`eval_jaxpr_ranges` — the interpreter: propagates intervals
     through add/mul/shift/and/or/select/reduce/convert/psum/... including
-    sub-jaxprs (pjit, shard_map, custom_{j,v}jp), recording a
-    :class:`RangeFinding` at the exact eqn whose INTEGER output interval
+    sub-jaxprs (pjit, shard_map, custom_{j,v}jp, cond branches), recording
+    a :class:`RangeFinding` at the exact eqn whose INTEGER output interval
     escapes the declared ceiling or its dtype — the "offending op".
+  * **loop fixpoints** (ISSUE 12) — `lax.scan` / `lax.while_loop` carries
+    are no longer conservatively unbounded: the body jaxpr is evaluated
+    iteratively over the carried intervals until a post-fixpoint. A scan
+    with a small static trip count is iterated exactly (with early exit on
+    a stable carry); anything else — long scans, every while — joins
+    iterates and, after :data:`WIDEN_DELAY` unstable rounds, WIDENS the
+    unstable carries up a threshold ladder (declared ceiling → dtype
+    bounds → ±inf), then applies one narrowing pass re-anchored at the
+    initial carry. A final AUDITED body pass at the proven invariant
+    emits the per-eqn findings, so a carry that can grow past a ceiling
+    still cites the offending op inside the loop body. `while` conditions
+    of the shape `carry OP bound` additionally refine the carry on entry
+    (and, negated, on exit), which is what bounds count-up/count-down
+    loop counters. Every loop contributes a :class:`LoopReport` to the
+    result — the proof that the analysis reached a sound post-fixpoint
+    rather than giving up.
   * :func:`certify_packing` — the headroom proof: traces
     `ckks.quantize.packing_sum_probe` (the shaped jaxpr of the plaintext
     integer math that encode_packed → encrypt → psum_mod /
@@ -43,6 +59,7 @@ with the no-divide/no-float rules instead.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import math
@@ -52,6 +69,19 @@ import numpy as np
 
 _POS_INF = float("inf")
 _NEG_INF = float("-inf")
+
+# Loop-fixpoint knobs (ISSUE 12). A scan with static length <= the unroll
+# limit is iterated exactly (tight bounds like C * field_max fall out);
+# longer scans and every while_loop go through join-then-widen. WIDEN_DELAY
+# is the classic K: how many unstable joined iterations to observe before
+# widening a moving bound up the threshold ladder.
+SCAN_EXACT_LIMIT = 4096
+WIDEN_DELAY = 3
+# The declared iteration-count ceiling the while-loop probes certify
+# against ("any arrival count / ladder depth up to 2**48"): large enough
+# for any real deployment, small enough that a counter increment provably
+# stays inside its int64 carrier.
+LOOP_COUNT_CEILING = 1 << 48
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,6 +104,44 @@ class Interval:
 
 TOP = Interval(_NEG_INF, _POS_INF)
 BOOL = Interval(0, 1)
+_TRUE = Interval(1, 1)
+_FALSE = Interval(0, 0)
+
+
+def _compare(name: str, a: "Interval", b: "Interval"):
+    """[1,1] / [0,0] when the comparison is decided by the intervals,
+    None when it is not."""
+    if name == "lt":
+        if a.hi < b.lo:
+            return _TRUE
+        if a.lo >= b.hi:
+            return _FALSE
+    elif name == "le":
+        if a.hi <= b.lo:
+            return _TRUE
+        if a.lo > b.hi:
+            return _FALSE
+    elif name == "gt":
+        if a.lo > b.hi:
+            return _TRUE
+        if a.hi <= b.lo:
+            return _FALSE
+    elif name == "ge":
+        if a.lo >= b.hi:
+            return _TRUE
+        if a.hi < b.lo:
+            return _FALSE
+    elif name == "eq":
+        if a.hi < b.lo or a.lo > b.hi:
+            return _FALSE
+        if a.lo == a.hi == b.lo == b.hi:
+            return _TRUE
+    elif name == "ne":
+        if a.hi < b.lo or a.lo > b.hi:
+            return _TRUE
+        if a.lo == a.hi == b.lo == b.hi:
+            return _FALSE
+    return None
 
 
 def _fmt(v) -> str:
@@ -104,15 +172,38 @@ class RangeFinding:
         return self.message
 
 
+@dataclasses.dataclass(frozen=True)
+class LoopReport:
+    """How one scan/while reached its post-fixpoint (always sound: TOP is
+    a post-fixpoint, so the analysis never gives up unsoundly — `widened`
+    records that precision, not soundness, was traded)."""
+
+    op: str            # "scan" | "while"
+    eqn_index: int     # position in the flattened eqn walk
+    mode: str          # "exact" (unrolled static trip count) | "fixpoint"
+    length: int | None # static trip count for scans, None for while
+    rounds: int        # abstract body iterations evaluated
+    widened: bool      # the threshold-ladder widening fired
+    narrowed: bool     # the narrowing pass tightened the invariant
+
+
 @dataclasses.dataclass
 class RangeResult:
     out_intervals: list
     findings: list
     notes: list      # non-fatal analysis caveats (unknown primitives, ...)
+    loops: list = dataclasses.field(default_factory=list)  # LoopReports
+
+
+def _contains(outer: Interval, inner: Interval) -> bool:
+    return outer.lo <= inner.lo and outer.hi >= inner.hi
 
 
 def _is_int_dtype(dtype) -> bool:
-    return np.issubdtype(np.dtype(dtype), np.integer)
+    try:
+        return np.issubdtype(np.dtype(dtype), np.integer)
+    except TypeError:   # extended dtypes (PRNG keys) have no numpy analog
+        return False
 
 
 def _dtype_interval(dtype) -> Interval:
@@ -203,7 +294,10 @@ class _RangeInterpreter:
         self.axis_sizes = dict(axis_sizes or {})
         self.findings: list[RangeFinding] = []
         self.notes: list[str] = []
+        self.loops: list[LoopReport] = []
         self.counter = 0
+        self._quiet = 0
+        self._note_seen: set[str] = set()
 
     # -- environment ------------------------------------------------------
     def _read(self, env, v) -> Interval:
@@ -213,15 +307,41 @@ class _RangeInterpreter:
             return _array_interval(v.val)
         return env[v]
 
+    @contextlib.contextmanager
+    def _quieted(self):
+        """Suppress findings/notes/loop-reports during the exploratory
+        fixpoint iterations; the AUDITED pass at the proven invariant is
+        the one that reports, so each in-loop violation fires once."""
+        self._quiet += 1
+        try:
+            yield
+        finally:
+            self._quiet -= 1
+
+    def _note(self, msg: str) -> None:
+        if self._quiet or msg in self._note_seen:
+            return
+        self._note_seen.add(msg)
+        self.notes.append(msg)
+
+    def _report_loop(self, rep: "LoopReport") -> None:
+        # Quiet-gated like findings/notes: a loop nested inside another
+        # loop's exploratory iterations reports once, at the audited pass.
+        if not self._quiet:
+            self.loops.append(rep)
+
     # -- one eqn ----------------------------------------------------------
     def _check(self, eqn, out: Interval, aval) -> None:
+        if self._quiet:
+            return
         if not _is_int_dtype(getattr(aval, "dtype", np.float32)):
             return
         name = eqn.primitive.name
+        finding = None
         if self.ceiling is not None and (
             out.lo < self.ceiling.lo or out.hi > self.ceiling.hi
         ):
-            self.findings.append(RangeFinding(
+            finding = RangeFinding(
                 kind="ceiling", op=name, eqn_index=self.counter,
                 interval=out, bound=self.ceiling,
                 message=(
@@ -229,11 +349,11 @@ class _RangeInterpreter:
                     f"{out}, outside the declared exact-integer ceiling "
                     f"{self.ceiling}"
                 ),
-            ))
+            )
         elif self.check_dtype:
             drange = _dtype_interval(aval.dtype)
             if out.lo < drange.lo or out.hi > drange.hi:
-                self.findings.append(RangeFinding(
+                finding = RangeFinding(
                     kind="dtype-overflow", op=name, eqn_index=self.counter,
                     interval=out, bound=drange,
                     message=(
@@ -241,7 +361,11 @@ class _RangeInterpreter:
                         f"{out}, wrapping its {np.dtype(aval.dtype).name} "
                         f"carrier {drange}"
                     ),
-                ))
+                )
+        # Multi-output eqns (scan carries + ys) can derive the identical
+        # finding per outvar; report it once.
+        if finding is not None and finding not in self.findings[-4:]:
+            self.findings.append(finding)
 
     def _eval_eqn(self, eqn, ins: list[Interval]) -> list[Interval]:
         name = eqn.primitive.name
@@ -270,7 +394,17 @@ class _RangeInterpreter:
             cands = [a.lo / b.lo, a.lo / b.hi, a.hi / b.lo, a.hi / b.hi]
             return [Interval(min(cands), max(cands))]
         if name == "rem":
-            # numpy/lax rem bounds depend on sign conventions; conservative.
+            if a.lo >= 0 and b.lo > 0 and b.hi != _POS_INF:
+                # Non-negative dividend, positive divisor: the canonical
+                # residue case the fold/ladder probes rely on. rem < b and
+                # rem <= a, so the invariant [0, p-1] is closed.
+                hi = b.hi - 1
+                if a.hi != _POS_INF:
+                    hi = min(hi, a.hi)
+                return [Interval(0, hi)]
+            # sign conventions differ across rem flavors; conservative.
+            if b.lo in (_NEG_INF,) or b.hi in (_POS_INF,):
+                return [TOP]
             m = max(abs(b.lo), abs(b.hi))
             return [Interval(-m, m)]
         if name == "integer_pow":
@@ -302,14 +436,30 @@ class _RangeInterpreter:
             if a.lo >= 0:
                 return [_floordiv_pow2(a, b)]
             return [_dtype_interval(out_aval.dtype)]
-        if name in ("and", "or", "xor"):
+        if name == "and":
+            # x & y <= min(x, y) for non-negative operands — one bounded
+            # non-negative side caps the result even when the other is
+            # unbounded (the mod-2**32 counter-wrap mask idiom).
+            caps = [x.hi for x in (a, b) if x.lo >= 0 and x.hi != _POS_INF]
+            if caps:
+                return [Interval(0, min(caps))]
+            return [_bitwise(a, b, out_aval.dtype)]
+        if name in ("or", "xor"):
             return [_bitwise(a, b, out_aval.dtype)]
         if name == "not":
             return [_dtype_interval(out_aval.dtype)
                     if _is_int_dtype(out_aval.dtype) else BOOL]
         if name == "select_n":
-            out = ins[1]
-            for case in ins[2:]:
+            pred, cases = ins[0], ins[1:]
+            # Dead-branch elimination: a predicate the comparison handlers
+            # proved constant selects exactly one case — this is what
+            # keeps `jnp.remainder`'s sign-correction branch (provably
+            # dead for canonical operands) from poisoning the bound.
+            if (pred.lo == pred.hi and isinstance(pred.lo, int)
+                    and 0 <= pred.lo < len(cases)):
+                return [cases[pred.lo]]
+            out = cases[0]
+            for case in cases[1:]:
                 out = out.union(case)
             return [out]
         if name == "convert_element_type":
@@ -341,7 +491,7 @@ class _RangeInterpreter:
                     # to the identity (a silent under-approximation) —
                     # unbounded is the sound answer, and the note tells
                     # the caller which axis to declare.
-                    self.notes.append(
+                    self._note(
                         f"psum over axis {ax!r} with undeclared size: "
                         "outputs unbounded (pass axis_sizes)"
                     )
@@ -368,8 +518,31 @@ class _RangeInterpreter:
         if name == "iota":
             dim = int(eqn.params["shape"][eqn.params["dimension"]])
             return [Interval(0, max(dim - 1, 0))]
-        if name in ("eq", "ne", "lt", "le", "gt", "ge", "is_finite"):
+        if name in ("eq", "ne", "lt", "le", "gt", "ge"):
+            # Definite results when the intervals prove them: the
+            # comparison feeds select_n's dead-branch elimination and the
+            # while-loop zero-iteration check.
+            verdict = _compare(name, a, b)
+            return [verdict if verdict is not None else BOOL]
+        if name == "is_finite":
             return [BOOL]
+        if name == "scan":
+            return self._eval_scan(eqn, ins)
+        if name == "while":
+            return self._eval_while(eqn, ins)
+        if name == "cond":
+            branches = eqn.params.get("branches", ())
+            outs = None
+            for br in branches:
+                # Either branch may execute: evaluate both (audited — a
+                # violation on one branch is a violation) and union.
+                res = self._eval_jaxpr(br, ins[1:])
+                outs = res if outs is None else [
+                    o.union(r) for o, r in zip(res, outs)
+                ]
+            if outs is not None and len(outs) == len(eqn.outvars):
+                return outs
+            return [TOP for _ in eqn.outvars]
         if name in ("pjit", "closed_call", "custom_jvp_call",
                     "custom_vjp_call", "remat", "checkpoint", "shard_map",
                     "core_call"):
@@ -388,11 +561,292 @@ class _RangeInterpreter:
                         except Exception:  # abstract mesh without .shape
                             pass
                 return self._eval_jaxpr(sub, ins)
-            self.notes.append(f"opaque call `{name}`: outputs unbounded")
+            self._note(f"opaque call `{name}`: outputs unbounded")
             return [TOP for _ in eqn.outvars]
 
-        self.notes.append(f"unsupported primitive `{name}`: output unbounded")
+        self._note(f"unsupported primitive `{name}`: output unbounded")
         return [TOP for _ in eqn.outvars]
+
+    # -- loop fixpoints (ISSUE 12) ----------------------------------------
+
+    def _widen(self, joined: Interval, prev: Interval, aval) -> Interval:
+        """Escalate whichever bound is still moving up the threshold
+        ladder: declared ceiling -> dtype bounds -> ±inf. Each unstable
+        round strictly climbs the finite ladder, so the fixpoint loop
+        terminates; a carry pushed past its dtype threshold is exactly the
+        loop-overflow the audited pass then reports."""
+        los: list = []
+        his: list = []
+        if self.ceiling is not None:
+            los.append(self.ceiling.lo)
+            his.append(self.ceiling.hi)
+        dtype = getattr(aval, "dtype", None)
+        if dtype is not None:
+            try:
+                if _is_int_dtype(dtype):
+                    d = _dtype_interval(dtype)
+                    los.append(d.lo)
+                    his.append(d.hi)
+            except TypeError:
+                pass
+        lo, hi = joined.lo, joined.hi
+        if joined.lo < prev.lo:
+            cands = [t for t in los if t <= joined.lo]
+            lo = max(cands) if cands else _NEG_INF
+        if joined.hi > prev.hi:
+            cands = [t for t in his if t >= joined.hi]
+            hi = min(cands) if cands else _POS_INF
+        return Interval(lo, hi)
+
+    def _loop_fixpoint(self, body, init, avals, refine):
+        """Join-iterate `body` over the carried intervals to a
+        post-fixpoint (body(carry) ⊆ carry), widening after WIDEN_DELAY
+        unstable rounds, then apply one narrowing pass re-anchored at the
+        initial carry. -> (invariant, rounds, widened, narrowed)."""
+        carry = list(init)
+        widened = narrowed = False
+        rounds = 0
+        max_rounds = WIDEN_DELAY + 8
+
+        def step(c):
+            entry = refine(c) if refine is not None else c
+            if entry is None:       # refinement contradicts: body dead
+                return None
+            with self._quieted():
+                return body(entry)[:len(init)]
+
+        while True:
+            out = step(carry)
+            rounds += 1
+            if out is None or all(
+                _contains(c, o) for c, o in zip(carry, out)
+            ):
+                break               # post-fixpoint reached
+            joined = [c.union(o) for c, o in zip(carry, out)]
+            if rounds >= WIDEN_DELAY:
+                joined = [
+                    j if _contains(c, j) else self._widen(j, c, a)
+                    for j, c, a in zip(joined, carry, avals)
+                ]
+                widened = True
+            carry = joined
+            if rounds >= max_rounds:  # pragma: no cover - ladder backstop
+                carry = [TOP for _ in init]
+                widened = True
+                break
+        # One narrowing pass: re-anchor at the initial carry. Accept only
+        # if the tightened candidate is itself still a post-fixpoint.
+        out = step(carry)
+        if out is not None:
+            cand = [i.union(o) for i, o in zip(init, out)]
+            if any(
+                n.lo > c.lo or n.hi < c.hi for n, c in zip(cand, carry)
+            ) and all(_contains(c, n) for c, n in zip(carry, cand)):
+                out2 = step(cand)
+                if out2 is not None and all(
+                    _contains(n, i.union(o))
+                    for n, i, o in zip(cand, init, out2)
+                ):
+                    carry = cand
+                    narrowed = True
+        return carry, rounds, widened, narrowed
+
+    def _eval_scan(self, eqn, ins):
+        params = eqn.params
+        sub = params["jaxpr"]
+        nc = int(params.get("num_consts", 0))
+        ncar = int(params.get("num_carry", 0))
+        length = params.get("length")
+        consts = list(ins[:nc])
+        init = list(ins[nc:nc + ncar])
+        xs = list(ins[nc + ncar:])   # per-iteration slice == stacked range
+        n_ys = len(eqn.outvars) - ncar
+        avals = [v.aval for v in eqn.outvars[:ncar]]
+
+        def body(c):
+            return self._eval_jaxpr(sub, consts + list(c) + xs)
+
+        if length is not None and int(length) == 0:
+            # A zero-trip scan never runs its body: the carry is exactly
+            # the init and the stacked outputs are empty (any interval is
+            # vacuously sound for zero elements) — no audit, no findings.
+            with self._quieted():
+                outs = body(list(init))
+            self._report_loop(LoopReport(
+                op="scan", eqn_index=self.counter, mode="exact", length=0,
+                rounds=0, widened=False, narrowed=False,
+            ))
+            return list(init) + list(outs[ncar:])
+
+        widened = narrowed = False
+        rounds = 0
+        ys: list = [None] * n_ys
+        if length is not None and 0 < int(length) <= SCAN_EXACT_LIMIT:
+            mode = "exact"
+            carry = list(init)
+            # Join of carry ENTRY values only (never the final carry-out):
+            # auditing the body at this join covers every iteration that
+            # actually runs without charging a phantom extra step — a
+            # boundary-exact headroom config must not be rejected for an
+            # iteration C+1 that does not exist.
+            entry_join: list | None = None
+            for _ in range(int(length)):
+                entry_join = (list(carry) if entry_join is None else
+                              [e.union(c) for e, c in zip(entry_join, carry)])
+                with self._quieted():
+                    outs = body(carry)
+                new = outs[:ncar]
+                for i, y in enumerate(outs[ncar:]):
+                    ys[i] = y if ys[i] is None else ys[i].union(y)
+                rounds += 1
+                stable = all(
+                    n.lo == c.lo and n.hi == c.hi
+                    for n, c in zip(new, carry)
+                )
+                carry = new
+                if stable:
+                    break           # deterministic: later iterates equal
+            invariant = entry_join if entry_join is not None else list(init)
+        else:
+            mode = "fixpoint"
+            invariant, rounds, widened, narrowed = self._loop_fixpoint(
+                body, init, avals, None
+            )
+            carry = invariant
+        # AUDITED pass at the loop invariant: per-eqn checks fire here, so
+        # a carry that escapes a ceiling cites the in-body offending op.
+        audited = body(invariant)
+        if mode == "fixpoint" or any(y is None for y in ys):
+            ys = list(audited[ncar:])
+        self._report_loop(LoopReport(
+            op="scan", eqn_index=self.counter, mode=mode,
+            length=int(length) if length is not None else None,
+            rounds=rounds, widened=widened, narrowed=narrowed,
+        ))
+        return list(carry) + list(ys)
+
+    def _cond_refiners(self, cond_closed, cond_const_ivs, carry_avals):
+        """Entry/exit carry refiners from a while condition of the shape
+        `carry[i] OP bound` (bound = literal, cond const, or jaxpr const).
+        Returns (entry, exit) callables (or Nones when the pattern does
+        not match — sound, just less precise): entry refines the carry
+        seen by the body (cond true), exit the carry the loop returns
+        (cond false, negated relation)."""
+        from jax.extend import core as jex_core
+
+        jaxpr = cond_closed.jaxpr
+        if len(jaxpr.outvars) != 1:
+            return None, None
+        outv = jaxpr.outvars[0]
+        if isinstance(outv, jex_core.Literal):
+            return None, None
+        def_eqn = None
+        for e in jaxpr.eqns:
+            if outv in e.outvars:
+                def_eqn = e
+        flips = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+        if def_eqn is None or def_eqn.primitive.name not in flips:
+            return None, None
+        consts_env = {
+            v: _array_interval(c)
+            for v, c in zip(jaxpr.constvars, cond_closed.consts)
+        }
+        invars = list(jaxpr.invars)
+        ncc = len(cond_const_ivs)
+
+        def classify(v):
+            if isinstance(v, jex_core.Literal):
+                return "iv", _array_interval(v.val)
+            if v in consts_env:
+                return "iv", consts_env[v]
+            if v in invars:
+                idx = invars.index(v)
+                if idx < ncc:
+                    return "iv", cond_const_ivs[idx]
+                return "carry", idx - ncc
+            return None, None
+
+        a_kind, a_val = classify(def_eqn.invars[0])
+        b_kind, b_val = classify(def_eqn.invars[1])
+        rel = def_eqn.primitive.name
+        if a_kind == "carry" and b_kind == "iv":
+            ci, bound = a_val, b_val
+        elif b_kind == "carry" and a_kind == "iv":
+            ci, bound = b_val, a_val
+            rel = flips[rel]
+        else:
+            return None, None
+        dtype = getattr(getattr(def_eqn.invars[0], "aval", None),
+                        "dtype", None)
+        step = 1 if (dtype is not None and _is_int_dtype(dtype)) else 0
+
+        def make(r):
+            def refine(carry):
+                c = carry[ci]
+                lo, hi = c.lo, c.hi
+                if r == "lt":
+                    hi = min(hi, bound.hi - step)
+                elif r == "le":
+                    hi = min(hi, bound.hi)
+                elif r == "gt":
+                    lo = max(lo, bound.lo + step)
+                elif r == "ge":
+                    lo = max(lo, bound.lo)
+                if lo > hi:
+                    return None      # contradiction: branch unreachable
+                new = list(carry)
+                new[ci] = Interval(lo, hi)
+                return new
+
+            return refine
+
+        negations = {"lt": "ge", "le": "gt", "gt": "le", "ge": "lt"}
+        return make(rel), make(negations[rel])
+
+    def _eval_while(self, eqn, ins):
+        params = eqn.params
+        cond_closed = params["cond_jaxpr"]
+        body_closed = params["body_jaxpr"]
+        cn = int(params.get("cond_nconsts", 0))
+        bn = int(params.get("body_nconsts", 0))
+        cond_consts = list(ins[:cn])
+        body_consts = list(ins[cn:cn + bn])
+        init = list(ins[cn + bn:])
+        avals = [v.aval for v in eqn.outvars]
+
+        entry_refine, exit_refine = self._cond_refiners(
+            cond_closed, cond_consts, avals
+        )
+
+        def body(c):
+            return self._eval_jaxpr(body_closed, body_consts + list(c))
+
+        invariant, rounds, widened, narrowed = self._loop_fixpoint(
+            body, init, avals, entry_refine
+        )
+        # AUDITED pass at the invariant (skipped when the entry
+        # refinement proves the body unreachable).
+        entry = (entry_refine(invariant) if entry_refine is not None
+                 else invariant)
+        if entry is not None:
+            body(entry)
+        self._report_loop(LoopReport(
+            op="while", eqn_index=self.counter, mode="fixpoint",
+            length=None, rounds=rounds, widened=widened, narrowed=narrowed,
+        ))
+        # Loop output: the invariant under the NEGATED condition — plus
+        # the initial carry whenever the condition may be false on entry
+        # (the loop can run zero times).
+        out = (exit_refine(invariant) if exit_refine is not None
+               else list(invariant))
+        if out is None:
+            out = list(invariant)
+        with self._quieted():
+            cond0 = self._eval_jaxpr(cond_closed, cond_consts + init)
+        may_skip = not cond0 or cond0[0].lo <= 0
+        if may_skip:
+            out = [o.union(i) for o, i in zip(out, init)]
+        return out
 
     # -- a whole (closed) jaxpr -------------------------------------------
     def _eval_jaxpr(self, closed, in_intervals: list[Interval]):
@@ -412,7 +866,7 @@ class _RangeInterpreter:
             try:
                 outs = self._eval_eqn(eqn, eins)
             except Exception as e:  # a handler hole must not kill analysis
-                self.notes.append(
+                self._note(
                     f"`{eqn.primitive.name}`: interval evaluation failed "
                     f"({type(e).__name__}: {e}); output unbounded"
                 )
@@ -446,7 +900,7 @@ def eval_jaxpr_ranges(
     """
     interp = _RangeInterpreter(ceiling, check_dtype, axis_sizes)
     outs = interp._eval_jaxpr(closed_jaxpr, in_intervals)
-    return RangeResult(outs, interp.findings, interp.notes)
+    return RangeResult(outs, interp.findings, interp.notes, interp.loops)
 
 
 # ---------------------------------------------------------------------------
@@ -589,14 +1043,16 @@ def certify_aggregation(prime: int) -> AggregationCertificate:
       2. `parallel.collectives.psum_mod`'s fused lazy all-reduce at
          MAX_PSUM_CLIENTS participants per mesh axis (analyzed at the
          declared worst-case axis size, whatever mesh traced it);
-      3. `fl.stream.OnlineAccumulator`'s int64 online fold.
+      3. `fl.stream.OnlineAccumulator`'s int64 online fold — proven
+         INDUCTIVELY for any arrival count (`certify_fold_inductive`),
+         not at one traced fold.
 
     These are the invariants the MAX_PSUM_CLIENTS constant encodes; a
     prime-size bump that silently breaks them fails here, statically.
     """
     import jax
 
-    from hefl_tpu.fl import secure, stream
+    from hefl_tpu.fl import secure
     from hefl_tpu.parallel import collectives
     from hefl_tpu.parallel.collectives import MAX_PSUM_CLIENTS
 
@@ -630,16 +1086,264 @@ def certify_aggregation(prime: int) -> AggregationCertificate:
         axis_sizes={"clients": MAX_PSUM_CLIENTS},
     )
 
-    # 3. the streaming engine's int64 online fold
-    fn, args = stream.fold_range_probe(prime)
-    with jax.experimental.enable_x64():
-        closed = jax.make_jaxpr(fn)(*args)
-    run("OnlineAccumulator fold", closed, [canonical, canonical])
+    # 3. the streaming engine's int64 online fold: the inductive loop
+    # certificate (any arrival count), replacing the old one-fold trace.
+    fold = certify_fold_inductive(prime)
+    findings.extend(fold.findings)
+    checks.extend(fold.checks)
 
     return AggregationCertificate(
         ok=not findings,
         prime_bits=prime.bit_length(),
         chunk=MAX_PSUM_CLIENTS,
+        findings=tuple(findings),
+        checks=tuple(checks),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class FoldCertificate:
+    """Inductive proof of the streaming fold invariant (ISSUE 12).
+
+    accumulator-in-[0, p-1] ∧ one fold step ⇒ accumulator-in-[0, p-1],
+    established as a while-loop post-fixpoint over an ABSTRACT arrival
+    count — valid for any number of arrivals up to 2**48, not the fixed
+    C a traced test exercises. With a PackedSpec, the headroom-capped
+    packed C-client sum is re-derived through the same loop machinery
+    (`certify_packing`'s scan fold)."""
+
+    ok: bool
+    prime_bits: int
+    count_ceiling_bits: int
+    bits: int | None     # packed leg (None when certifying unpacked)
+    k: int | None
+    clients: int | None
+    findings: tuple
+    checks: tuple
+
+    def summary(self) -> str:
+        head = (
+            f"fold-inductive p<2**{self.prime_bits} "
+            f"arrivals<=2**{self.count_ceiling_bits}"
+        )
+        if self.bits is not None:
+            head += f" packed(b={self.bits} k={self.k} C={self.clients})"
+        if self.ok:
+            return f"{head}: CERTIFIED — " + "; ".join(self.checks)
+        return f"{head}: UNSAFE — " + "; ".join(str(f) for f in self.findings)
+
+
+@functools.lru_cache(maxsize=64)
+def certify_fold_inductive(
+    prime: int, spec=None, modulus: int | None = None
+) -> FoldCertificate:
+    """Prove the `OnlineAccumulator` invariant inductively for UNBOUNDED
+    arrival counts (ISSUE 12).
+
+    Traces `fl.stream.fold_loop_probe` — the online fold as a
+    `lax.while_loop` over an abstract arrival count in [0, 2**48] — and
+    establishes, as a loop post-fixpoint:
+
+      * the carried accumulator stays canonical ([0, p-1]) after EVERY
+        fold, for any arrival count (the base case is the canonical
+        first upload; the step is the body jaxpr, so this is a machine-
+        checked induction, replacing the fixed-C fold trace);
+      * the fold's int64 carrier never wraps (acc + row < 2p fits).
+
+    With `spec` (a hashable `PackedSpec`) and `modulus`, the packed
+    integer half rides along: the headroom-capped C-client packed sum is
+    re-derived through `certify_packing`'s scan-fold machinery at the
+    spec's exact geometry — so the streaming engine's fold cap
+    (`stream.headroom_blocked`) is backed by the same loop proof.
+    """
+    import jax
+
+    from hefl_tpu.fl import stream
+
+    prime = int(prime)
+    canonical = Interval(0, prime - 1)
+    probe, args = stream.fold_loop_probe(prime)
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(probe)(*args)
+
+    res = eval_jaxpr_ranges(
+        closed,
+        [Interval(0, LOOP_COUNT_CEILING), canonical, canonical],
+    )
+    findings = list(res.findings)
+    checks: list[str] = []
+    out = res.out_intervals[0]
+    loops = [rep for rep in res.loops if rep.op == "while"]
+    if not loops:  # pragma: no cover - probe/interpreter drift tripwire
+        findings.append(RangeFinding(
+            kind="output-bound", op="while", eqn_index=-1,
+            interval=out, bound=canonical,
+            message="fold probe traced without a while loop — the "
+                    "inductive machinery was not exercised",
+        ))
+    if out.lo < canonical.lo or out.hi > canonical.hi:
+        findings.append(RangeFinding(
+            kind="output-bound", op="while", eqn_index=-1,
+            interval=out, bound=canonical,
+            message=(
+                f"OnlineAccumulator fold: carried sum reaches {out}, "
+                f"escaping the canonical residue range {canonical}"
+            ),
+        ))
+    else:
+        checks.append(
+            f"OnlineAccumulator fold invariant {out} ⊆ {canonical} closed "
+            f"under any arrival count <= 2**{LOOP_COUNT_CEILING.bit_length() - 1}"
+            " (inductive)"
+        )
+
+    bits = k = clients = None
+    if spec is not None:
+        if modulus is None:
+            raise ValueError(
+                "certify_fold_inductive: a PackedSpec needs the ring "
+                "modulus to re-derive the packed C-client sum"
+            )
+        bits, k, clients = int(spec.bits), int(spec.k), int(spec.clients)
+        raw_guard = spec.guard - max(clients - 1, 0).bit_length()
+        packed = certify_packing(int(modulus), bits, k, clients, raw_guard)
+        for f in packed.findings:
+            findings.append(dataclasses.replace(
+                f, message=f"packed fold: {f.message}"
+            ))
+        if packed.ok:
+            checks.append(
+                f"headroom-capped packed fold (C={clients} scan): "
+                + "; ".join(packed.checks)
+            )
+
+    return FoldCertificate(
+        ok=not findings,
+        prime_bits=prime.bit_length(),
+        count_ceiling_bits=LOOP_COUNT_CEILING.bit_length() - 1,
+        bits=bits, k=k, clients=clients,
+        findings=tuple(findings),
+        checks=tuple(checks),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class InferenceCertificate:
+    """Static proof (or refutation) of the rotate-and-sum serving program
+    (ISSUE 12): the encrypted-inference ladder's integer invariants."""
+
+    ok: bool
+    prime_bits: int
+    digit_bits: int
+    num_digits: int
+    depth_ceiling_bits: int
+    findings: tuple
+    checks: tuple
+
+    def summary(self) -> str:
+        head = (
+            f"inference ladder p<2**{self.prime_bits} "
+            f"gadget(w={self.digit_bits} d={self.num_digits}) "
+            f"depth<=2**{self.depth_ceiling_bits}"
+        )
+        if self.ok:
+            return f"{head}: CERTIFIED — " + "; ".join(self.checks)
+        return f"{head}: UNSAFE — " + "; ".join(str(f) for f in self.findings)
+
+
+@functools.lru_cache(maxsize=64)
+def certify_inference(
+    prime: int, digit_bits: int, num_digits: int
+) -> InferenceCertificate:
+    """Range-certify the rotate-and-sum Galois serving program
+    (`he_inference.rotate_and_sum_scan`) for one ring geometry — the
+    named analysis prerequisite of the encrypted-inference direction.
+
+    Traces `he_inference.rotation_ladder_range_probe` — the ladder's
+    carrier arithmetic as one `lax.while_loop` over an abstract stage
+    depth, with the gadget decomposition and the rotation (gather +
+    worst-case sign flip) inlined, and the rotation/gadget KEY tensors
+    abstracted as canonical-residue inputs — and proves, as a loop
+    post-fixpoint:
+
+      * the carried (c0, c1) residues stay canonical ([0, p-1]) at ANY
+        ladder depth (rotate-and-sum needs log2(slots) stages; the
+        certificate does not care);
+      * every gadget digit stays below 2**digit_bits and every
+        digit x key inner-product term inside the declared 2**62
+        exact-integer ceiling (the Montgomery REDC carrier contract);
+      * the modular tree-sum re-canonicalizes at every step.
+
+    The wrapping uint32 Montgomery cores themselves are NOT range-probed
+    (intentional wraparound, covered by the lint rules + bitwise parity
+    tests); the probe mirrors their canonical-residue CONTRACT, exactly
+    like the packing probes mirror `psum_mod`.
+    """
+    import jax
+
+    from hefl_tpu import he_inference
+    from hefl_tpu.ckks import quantize
+
+    prime = int(prime)
+    canonical = Interval(0, prime - 1)
+    wall = (1 << quantize.MAX_PACKED_BITS) - 1
+    probe, args = he_inference.rotation_ladder_range_probe(
+        prime, digit_bits, num_digits
+    )
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(probe)(*args)
+
+    in_ivs = [
+        Interval(0, LOOP_COUNT_CEILING),   # abstract ladder depth
+        canonical, canonical,              # carried ciphertext residues
+        canonical, canonical,              # gadget/rotation key tensors
+        # automorphism table indices: gather is range-preserving, so the
+        # index bound is immaterial to the carried invariant.
+        Interval(0, LOOP_COUNT_CEILING),
+    ]
+    res = eval_jaxpr_ranges(
+        closed, in_ivs, ceiling=Interval(-wall, wall)
+    )
+    findings = list(res.findings)
+    checks: list[str] = []
+    if not any(rep.op == "while" for rep in res.loops):
+        findings.append(RangeFinding(  # pragma: no cover - drift tripwire
+            kind="output-bound", op="while", eqn_index=-1,
+            interval=res.out_intervals[0], bound=canonical,
+            message="ladder probe traced without a while loop — the "
+                    "inductive machinery was not exercised",
+        ))
+
+    def out_check(idx: int, what: str):
+        iv = res.out_intervals[idx]
+        if iv.lo < canonical.lo or iv.hi > canonical.hi:
+            outvar = closed.jaxpr.outvars[idx]
+            op = "input"
+            for eqn in closed.jaxpr.eqns:
+                if outvar in eqn.outvars:
+                    op = eqn.primitive.name
+            findings.append(RangeFinding(
+                kind="output-bound", op=op, eqn_index=-1,
+                interval=iv, bound=canonical,
+                message=f"{what}: `{op}` yields {iv}, outside {canonical}",
+            ))
+        else:
+            checks.append(f"{what} in {iv} ⊆ {canonical}")
+
+    out_check(0, "carried c0 residues (any ladder depth)")
+    out_check(1, "carried c1 residues (any ladder depth)")
+    if not findings:
+        checks.append(
+            f"gadget digit x key products inside the 2**62 wall "
+            f"(w={digit_bits}, d={num_digits})"
+        )
+
+    return InferenceCertificate(
+        ok=not findings,
+        prime_bits=prime.bit_length(),
+        digit_bits=int(digit_bits),
+        num_digits=int(num_digits),
+        depth_ceiling_bits=LOOP_COUNT_CEILING.bit_length() - 1,
         findings=tuple(findings),
         checks=tuple(checks),
     )
@@ -759,6 +1463,48 @@ def certify_transciphering(
     out_check(3, Interval(0, domain - 1),
               "shifted recovery (mod-2**62 window)")
 
+    # The counter-mode keystream loop (ISSUE 12): the cipher's word-pair
+    # no-wrap invariants proven over ANY round count — the round counter
+    # (intentionally mod 2**32) and the carry-propagating add stay inside
+    # their uint32 carriers at every iteration of the service's lifetime,
+    # established as a while-loop post-fixpoint, not sampled at one round.
+    cprobe, cargs = hhe_cipher.keystream_counter_probe()
+    with jax.experimental.enable_x64():
+        cclosed = jax.make_jaxpr(cprobe)(*cargs)
+    word = Interval(0, (1 << 31) - 1)
+    cres = eval_jaxpr_ranges(cclosed, [
+        Interval(0, LOOP_COUNT_CEILING),     # abstract round count
+        Interval(0, (1 << 32) - 1),          # round counter (mod 2**32)
+        Interval((1 << 32) - 1, (1 << 32) - 1),  # the mod-2**32 mask
+        word, word,                          # packed (hi, lo) payload
+        word, word,                          # keystream (hi, lo) draws
+    ])
+    for f in cres.findings:
+        findings.append(dataclasses.replace(
+            f, message=f"keystream counter loop: {f.message}"
+        ))
+    if not any(rep.op == "while" for rep in cres.loops):
+        findings.append(RangeFinding(  # pragma: no cover - drift tripwire
+            kind="output-bound", op="while", eqn_index=-1,
+            interval=cres.out_intervals[0], bound=word,
+            message="keystream counter probe traced without a while loop",
+        ))
+    ctr_out, whi_out, wlo_out = cres.out_intervals
+    for what, iv, bound in (
+        ("round counter (mod 2**32)", ctr_out, Interval(0, (1 << 32) - 1)),
+        ("cipher word hi", whi_out, word),
+        ("cipher word lo", wlo_out, word),
+    ):
+        if iv.lo < bound.lo or iv.hi > bound.hi:
+            findings.append(RangeFinding(
+                kind="output-bound", op="while", eqn_index=-1,
+                interval=iv, bound=bound,
+                message=f"keystream counter loop: {what} reaches {iv}, "
+                        f"outside {bound}",
+            ))
+        else:
+            checks.append(f"{what} in {iv} ⊆ {bound} at any round count")
+
     return TranscipherCertificate(
         ok=not findings,
         modulus_bits=modulus.bit_length(),
@@ -787,12 +1533,22 @@ def certified_max_interleave(
 __all__ = [
     "Interval",
     "TOP",
+    "LOOP_COUNT_CEILING",
+    "SCAN_EXACT_LIMIT",
+    "WIDEN_DELAY",
     "RangeFinding",
     "RangeResult",
+    "LoopReport",
     "eval_jaxpr_ranges",
     "PackingCertificate",
+    "AggregationCertificate",
+    "FoldCertificate",
+    "InferenceCertificate",
     "TranscipherCertificate",
     "certify_packing",
+    "certify_aggregation",
+    "certify_fold_inductive",
+    "certify_inference",
     "certify_transciphering",
     "certified_max_interleave",
 ]

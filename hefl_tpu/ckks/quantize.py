@@ -335,18 +335,24 @@ def packing_sum_probe(
     Mirrors, in plaintext integers, exactly what the homomorphic path
     computes: quantize (clip to ±qmax) → offset to non-negative codes →
     shift each of the k fields to its bit offset (`interleave_fields`'s
-    math on the recombined value hi·2**31+lo) → sum over C clients
-    (`psum_mod` / `OnlineAccumulator.fold`) → add the accumulated decrypt
+    math on the recombined value hi·2**31+lo) → FOLD over C clients as a
+    `lax.scan` — one arrival at a time, the same loop shape `psum_mod` /
+    `OnlineAccumulator.fold` iterate — → add the accumulated decrypt
     noise → outputs the analyzer bounds:
 
         (field_sums [k, m], noise_sum [m], packed_total [m])
 
-    Shift offsets may exceed 63 for unsafe configs — that is the point:
-    tracing still succeeds (shift amounts are small constants) and the
-    range analyzer reports the shift as the offending op. Trace under
+    The C-client sums are loop CARRIES (ISSUE 12): the range analyzer
+    derives their bounds by iterating the body jaxpr over the carried
+    intervals to a post-fixpoint, so the carry-free-sum proof is the loop
+    machinery's, not a closed-form reduce bound. Shift offsets may exceed
+    63 for unsafe configs — that is the point: tracing still succeeds
+    (shift amounts are small constants) and the audited loop-body pass
+    reports the shift as the offending op. Trace under
     `jax.experimental.enable_x64()` so the int64 carrier is nameable.
     -> (fn, example_args).
     """
+    import jax as _jax
     import jax.numpy as _jnp
 
     qm = qmax(bits)
@@ -355,12 +361,20 @@ def packing_sum_probe(
     def probe(x, noise):
         q = quantize(x, 1.0, bits)                     # int32 in [-qm, qm]
         u = (q + qm).astype(_jnp.int64)                # [C, k, m] >= 0
-        field_sums = _jnp.sum(u, axis=0)               # [k, m] client sums
-        packed = _jnp.zeros((x.shape[0], m), _jnp.int64)
-        for j in range(k):
-            packed = packed + (u[:, j, :] << (guard + j * fbits))
-        noise_sum = _jnp.sum(noise, axis=0)            # [m]
-        packed_total = _jnp.sum(packed, axis=0) + noise_sum
+
+        def fold(carry, inp):
+            fs, ns, tot = carry
+            u_c, n_c = inp                             # [k, m], [m]
+            packed_c = _jnp.zeros((m,), _jnp.int64)
+            for j in range(k):
+                packed_c = packed_c + (u_c[j] << (guard + j * fbits))
+            return (fs + u_c, ns + n_c, tot + packed_c + n_c), None
+
+        zk = _jnp.zeros((k, m), _jnp.int64)
+        zm = _jnp.zeros((m,), _jnp.int64)
+        (field_sums, noise_sum, packed_total), _ = _jax.lax.scan(
+            fold, (zk, zm, zm), (u, noise)
+        )
         return field_sums, noise_sum, packed_total
 
     x = jnp.zeros((int(clients), k, m), jnp.float32)

@@ -181,13 +181,16 @@ class OnlineAccumulator:
 
 
 def exact_int_probes() -> dict:
-    """Shaped jaxpr probe of the online fold's declared exact-integer
-    region (ISSUE 8, analysis.lint). `OnlineAccumulator._add` runs
-    host-side in numpy; this jax mirror traces the same arithmetic (the
+    """Shaped jaxpr probes of the online fold's declared exact-integer
+    regions (ISSUE 8/12, analysis.lint). `OnlineAccumulator._add` runs
+    host-side in numpy; these jax mirrors trace the same arithmetic (the
     `%` is the allowlisted host-side modulo — see analysis.lint.ALLOWLIST)
     so the no-float / no-stray-div rules still watch the fold's math. The
     int32 carrier is sound here for the same reason the fold is exact:
-    two canonical residues < 2**27 sum below 2**28."""
+    two canonical residues < 2**27 sum below 2**28. The `fold_loop`
+    region is the ARRIVAL-LOOP form (fold_loop_probe at a representative
+    prime): the declared exact-int region now contains the real loop, so
+    its carried state is lint- and range-watched, not just one step."""
     p = jnp.asarray([[2**27 - 39]], jnp.int32)
 
     def probe(acc, row):
@@ -195,21 +198,39 @@ def exact_int_probes() -> dict:
         return t.astype(jnp.uint32)
 
     z = jnp.zeros((1, 8), jnp.uint32)
-    return {"fl.stream.accumulator_fold": (probe, (z, z))}
+    loop_fn, loop_args = fold_loop_probe(2**27 - 39)
+    return {
+        "fl.stream.accumulator_fold": (probe, (z, z)),
+        "fl.stream.fold_loop": (loop_fn, loop_args),
+    }
 
 
-def fold_range_probe(prime: int):
-    """Range probe (analysis.ranges.certify_aggregation): the faithful
-    int64 mirror of `OnlineAccumulator._add` — proves the canonical fold
-    never wraps its int64 carrier for the configured prime size. Trace
-    under `jax.experimental.enable_x64()`."""
+def fold_loop_probe(prime: int):
+    """The online fold as an UNBOUNDED arrival loop (ISSUE 12): a
+    `lax.while_loop` folding one canonical row per arrival, with the
+    arrival count an abstract input — the shape
+    `analysis.ranges.certify_fold_inductive` needs to prove the
+    accumulator invariant [0, p-1] INDUCTIVELY (base: the canonical first
+    upload; step: this body) for ANY arrival count, where the old
+    one-step trace only covered a single fold. The count-down counter
+    makes the loop's post-fixpoint immediate for the analyzer; the `%`
+    mirrors `OnlineAccumulator._add`'s host-side numpy modulo. Trace
+    under `jax.experimental.enable_x64()` (int64 carrier)."""
     p = np.asarray([[int(prime)]], np.int64)
 
-    def probe(acc, row):
-        return (acc + row) % p
+    def probe(count, acc, row):
+        def cond(state):
+            return state[0] > 0
+
+        def body(state):
+            remaining, a = state
+            return remaining - 1, (a + row) % p
+
+        _, out = jax.lax.while_loop(cond, body, (count, acc))
+        return out
 
     z = np.zeros((1, 8), np.int64)
-    return probe, (z, z)
+    return probe, (np.int64(0), z, z)
 
 
 def ct_hash(c0, c1) -> str:
@@ -719,6 +740,25 @@ class StreamEngine:
                     "upload_kind=hhe rejected by static range analysis — "
                     f"{cert.summary()}"
                 )
+        # Inductive fold certificate (ISSUE 12): the OnlineAccumulator
+        # invariant this round's folds rely on, proven for ANY arrival
+        # count (lru_cached — one proof per (prime, spec) geometry); a
+        # packed round also re-derives its headroom-capped C-client sum
+        # through the same loop machinery. An uncertified fold refuses to
+        # run, naming the offending op.
+        from hefl_tpu.analysis.ranges import certify_fold_inductive
+
+        max_prime = int(np.asarray(ctx.ntt.p).max())
+        fold_cert = (
+            certify_fold_inductive(max_prime, packing, int(ctx.modulus))
+            if packing is not None
+            else certify_fold_inductive(max_prime)
+        )
+        if not fold_cert.ok:
+            raise ValueError(
+                "streaming fold rejected by static range analysis — "
+                f"{fold_cert.summary()}"
+            )
         if dp is not None and s.staleness_rounds > 0:
             # A carried upload lets one client contribute to a release
             # TWICE (its stale + fresh uploads: sensitivity 2C while

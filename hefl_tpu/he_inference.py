@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from hefl_tpu.ckks import encoding, galois, ops
 from hefl_tpu.ckks.keys import CkksContext, GaloisKey, PublicKey, SecretKey, gen_galois_key
 from hefl_tpu.ckks.ops import Ciphertext
+from hefl_tpu.obs import scopes as obs_scopes
 
 
 def rotation_steps(num_slots: int) -> list[int]:
@@ -134,11 +135,14 @@ def rotate_and_sum_scan(ctx: CkksContext, ct: Ciphertext, ladder) -> Ciphertext:
     def stage(carry, inp):
         c0, c1 = carry
         src, flip, b_mont, a_mont = inp
-        pc0 = galois.apply_automorphism(ntt_inverse(ntt, c0), p, src, flip)
-        pc1 = galois.apply_automorphism(ntt_inverse(ntt, c1), p, src, flip)
-        k0, k1 = _keyswitch_coeff(ctx, pc1, b_mont, a_mont)
-        rot0 = add_mod(ntt_forward(ntt, pc0), k0, p)
-        return (add_mod(c0, rot0, p), add_mod(c1, k1, p)), None
+        # Leaf compute of the serving ladder: the stage body (inside the
+        # scan, so the loop op itself stays a scope-less container).
+        with jax.named_scope(obs_scopes.SERVE_ROTATE):
+            pc0 = galois.apply_automorphism(ntt_inverse(ntt, c0), p, src, flip)
+            pc1 = galois.apply_automorphism(ntt_inverse(ntt, c1), p, src, flip)
+            k0, k1 = _keyswitch_coeff(ctx, pc1, b_mont, a_mont)
+            rot0 = add_mod(ntt_forward(ntt, pc0), k0, p)
+            return (add_mod(c0, rot0, p), add_mod(c1, k1, p)), None
 
     (c0, c1), _ = jax.lax.scan(stage, (ct.c0, ct.c1), ladder)
     return Ciphertext(c0=c0, c1=c1, scale=ct.scale)
@@ -150,9 +154,11 @@ def _linear_apply(ctx: CkksContext, pt_scale: float, ct_x: Ciphertext, w_res, b_
     add."""
 
     def one(w, b):
-        ct = ops.ct_mul_plain_poly(ctx, ct_x, w, pt_scale)
-        ct = rotate_and_sum_scan(ctx, ct, ladder)
-        return ops.ct_add_plain(ctx, ct, b)
+        with jax.named_scope(obs_scopes.SERVE_SCORE):
+            ct = ops.ct_mul_plain_poly(ctx, ct_x, w, pt_scale)
+        ct = rotate_and_sum_scan(ctx, ct, ladder)   # scan call: scope-less
+        with jax.named_scope(obs_scopes.SERVE_SCORE):
+            return ops.ct_add_plain(ctx, ct, b)
 
     return jax.vmap(one)(w_res, b_res)
 
@@ -335,6 +341,82 @@ def slice_secret_key(sk: SecretKey, num_primes: int) -> SecretKey:
     return SecretKey(s_mont=sk.s_mont[:num_primes])
 
 
+# ---------------------------------------------------------------------------
+# Shaped jaxpr probes (ISSUE 12): the static-analysis gate, extended to the
+# serving side — `analysis.ranges.certify_inference` proves the
+# rotate-and-sum ladder's integer invariants over this mirror.
+# ---------------------------------------------------------------------------
+
+
+def rotation_ladder_range_probe(prime: int, digit_bits: int, num_digits: int):
+    """The rotate-and-sum serving ladder's carrier arithmetic as ONE
+    traceable loop (analysis.ranges.certify_inference).
+
+    Mirrors, per ladder stage, what `rotate_and_sum_scan`'s body computes
+    on each RNS limb — automorphism (a gather through the rotation table
+    plus the sign flip, taken at its worst case `(p - x) mod p`; the
+    unflipped element shares the interval), the gadget key-switch
+    (base-2**w digit decomposition, digit centering, digit x key
+    inner-product summed mod p against the Galois key tensors), and the
+    rotate+add re-canonicalization — as a `lax.while_loop` over an
+    ABSTRACT stage count, so the carried (c0, c1) invariant is proven for
+    ANY ladder depth, not the log2(slots) stages one trace happens to
+    run.
+
+    The wrapping uint32 Montgomery cores are deliberately NOT mirrored
+    bit-for-bit: the probe computes the digit x key product on the int64
+    carrier and reduces with `%` (the allowlisted probe modulo), which is
+    the REDC canonical-residue CONTRACT — the analyzer proves the product
+    fits the exact-integer ceiling and the reduction restores [0, p-1];
+    the cores' own wraparound is covered by the lint rules and bitwise
+    parity tests, exactly like every other probe in this tree. Trace
+    under `jax.experimental.enable_x64()`. -> (fn, example_args).
+    """
+    p = int(prime)
+    w = int(digit_bits)
+    half = 1 << max(w - 1, 0)
+    mask = (1 << w) - 1
+    m = 4  # coefficients per probe limb; ranges are per-element anyway
+
+    def probe(depth, c0, c1, key_b, key_a, src):
+        def cond(state):
+            return state[0] > 0
+
+        def body(state):
+            remaining, c0, c1 = state
+            # Rotation: gather through the automorphism table, sign flip
+            # at its worst case (canonical-preserving).
+            g0 = jnp.take(c0, src, axis=-1)
+            g1 = jnp.take(c1, src, axis=-1)
+            pc0 = (p - g0) % p
+            pc1 = (p - g1) % p
+            # Gadget key-switch: digit-decompose pc1, center, inner-product
+            # against the key tensors, modular tree-sum.
+            ks0 = jnp.zeros_like(c0)
+            ks1 = jnp.zeros_like(c1)
+            for kk in range(int(num_digits)):
+                digit = (pc1 >> (w * kk)) & mask       # [0, 2**w - 1]
+                centered = (digit + (p - half)) % p    # canonical
+                ks0 = (ks0 + centered * key_b) % p
+                ks1 = (ks1 + centered * key_a) % p
+            return remaining - 1, (pc0 + ks0) % p, ks1
+
+        _, c0, c1 = jax.lax.while_loop(cond, body, (depth, c0, c1))
+        return c0, c1
+
+    z = np.zeros((m,), np.int64)
+    return probe, (np.int64(0), z, z, z, z, np.zeros((m,), np.int64))
+
+
+def exact_int_probes() -> dict:
+    """The serving side's declared exact-integer region (analysis.lint):
+    the ladder probe — now a region that CONTAINS the loop, so its
+    carried residues are watched by the no-float / no-stray-div rules
+    (the `%` is the allowlisted probe modulo)."""
+    fn, args = rotation_ladder_range_probe(2**27 - 39, 9, 3)
+    return {"he_inference.rotate_ladder": (fn, args)}
+
+
 def _const_eval_residues(ctx: CkksContext, c: np.ndarray, scale: float) -> np.ndarray:
     """Eval-domain RNS residues of constant-in-every-slot plaintexts.
 
@@ -385,20 +467,21 @@ def _mlp_tail_apply(ctx: CkksContext, pt_scale: float, rescales: int, h, rlk, w2
     """
     from hefl_tpu.ckks import modular
 
-    sq = ops.ct_mul(ctx, h, h, rlk)        # batched over the H axis
-    cur = ctx
-    for _ in range(rescales):
-        cur, sq = ops.rescale(cur, sq)
-    p = jnp.asarray(cur.ntt.p)
-    pinv = jnp.asarray(cur.ntt.pinv_neg)
-    # [K,H,L,1] consts × [1,H,L,N] limbs → [K,H,L,N], contract H mod p.
-    t0 = modular.mont_mul(sq.c0[None], w2m, p, pinv)
-    t1 = modular.mont_mul(sq.c1[None], w2m, p, pinv)
-    c0, c1 = t0[:, 0], t1[:, 0]
-    for j in range(1, t0.shape[1]):        # static H: unrolled modular sum
-        c0 = modular.add_mod(c0, t0[:, j], p)
-        c1 = modular.add_mod(c1, t1[:, j], p)
-    c0 = modular.add_mod(c0, jnp.broadcast_to(b2e, c0.shape), p)
+    with jax.named_scope(obs_scopes.SERVE_SCORE):
+        sq = ops.ct_mul(ctx, h, h, rlk)    # batched over the H axis
+        cur = ctx
+        for _ in range(rescales):
+            cur, sq = ops.rescale(cur, sq)
+        p = jnp.asarray(cur.ntt.p)
+        pinv = jnp.asarray(cur.ntt.pinv_neg)
+        # [K,H,L,1] consts × [1,H,L,N] limbs → [K,H,L,N], contract H mod p.
+        t0 = modular.mont_mul(sq.c0[None], w2m, p, pinv)
+        t1 = modular.mont_mul(sq.c1[None], w2m, p, pinv)
+        c0, c1 = t0[:, 0], t1[:, 0]
+        for j in range(1, t0.shape[1]):    # static H: unrolled modular sum
+            c0 = modular.add_mod(c0, t0[:, j], p)
+            c1 = modular.add_mod(c1, t1[:, j], p)
+        c0 = modular.add_mod(c0, jnp.broadcast_to(b2e, c0.shape), p)
     return Ciphertext(c0=c0, c1=c1, scale=sq.scale * pt_scale)
 
 

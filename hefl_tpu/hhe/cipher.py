@@ -284,6 +284,7 @@ def exact_int_probes() -> dict:
     key = jnp.zeros((4,), jnp.uint32)
     hi = jnp.zeros((2, 8), jnp.uint32)
     lo = jnp.zeros((2, 8), jnp.uint32)
+    counter_fn, counter_args = keystream_counter_probe()
     return {
         "hhe.cipher.keystream": (
             lambda k: keystream_pair(k, jnp.uint32(1), (2, 8)), (key,)
@@ -292,6 +293,10 @@ def exact_int_probes() -> dict:
             lambda h, l, k: stream_encrypt(h, l, k, jnp.uint32(1)),
             (hi, lo, key),
         ),
+        # The counter-mode round loop (ISSUE 12): the declared exact-int
+        # region now CONTAINS the while loop, so its carried counter and
+        # cipher words are lint-watched (no rem/div, no float) too.
+        "hhe.cipher.keystream_counter": (counter_fn, counter_args),
     }
 
 
@@ -327,23 +332,85 @@ def transcipher_sum_probe(bits: int, k: int, fbits: int, guard: int,
     def probe(x, gamma, noise):
         q = quantize.quantize(x, 1.0, bits)            # int32 [-qm, qm]
         u = (q + qm).astype(jnp.int64)                 # [C, k, m] >= 0
-        field_sums = jnp.sum(u, axis=0)                # [k, m]
-        packed = jnp.zeros((x.shape[0], m), jnp.int64)
-        for j in range(k):
-            packed = packed + (u[:, j, :] << (guard + j * fbits))
-        trans = packed - gamma * jnp.int64(domain)     # per-client w - z
-        noise_sum = jnp.sum(noise, axis=0)             # [m]
-        total = jnp.sum(trans, axis=0) + noise_sum
-        recovered = (
-            jnp.sum(packed, axis=0) + noise_sum
-            + jnp.int64(1 << max(guard - 1, 0))
+
+        # The C-client sums as a lax.scan fold (ISSUE 12): one arrival at
+        # a time, the loop shape the streaming engine actually iterates —
+        # the analyzer derives the carried bounds as a loop post-fixpoint.
+        def fold(carry, inp):
+            fs, ns, tot, rec = carry
+            u_c, g_c, n_c = inp                        # [k,m], [m], [m]
+            packed_c = jnp.zeros((m,), jnp.int64)
+            for j in range(k):
+                packed_c = packed_c + (u_c[j] << (guard + j * fbits))
+            trans_c = packed_c - g_c * domain          # per-client w - z
+            return (
+                fs + u_c, ns + n_c, tot + trans_c + n_c,
+                rec + packed_c + n_c,
+            ), None
+
+        zk = jnp.zeros((k, m), jnp.int64)
+        zm = jnp.zeros((m,), jnp.int64)
+        (field_sums, noise_sum, total, rec), _ = jax.lax.scan(
+            fold, (zk, zm, zm, zm), (u, gamma, noise)
         )
+        recovered = rec + (1 << max(guard - 1, 0))
         return field_sums, noise_sum, total, recovered
 
     x = jnp.zeros((int(clients), k, m), jnp.float32)
     gamma = np.zeros((int(clients), m), np.int64)
     noise = np.zeros((int(clients), m), np.int64)
     return probe, (x, gamma, noise)
+
+
+def keystream_counter_probe():
+    """The counter-mode round-counter loop as one traceable function
+    (ISSUE 12; analysis.ranges.certify_transciphering's loop leg).
+
+    The cipher's per-round counter is the one piece of loop-carried
+    integer state the HHE uplink owns: every round increments the 32-bit
+    round counter (wrapping mod 2**32 BY DESIGN — modeled here as an
+    explicit mask on an int64 carrier so the intent is a proven bound,
+    not a silent uint32 wrap) and encrypts a fresh packed payload with
+    fresh keystream words. The probe runs that loop over an ABSTRACT
+    round count and mirrors `add_packed_mod`'s word-pair carry add at its
+    REAL uint32 dtypes, so the analyzer proves, at any round count:
+
+      * the round counter stays in [0, 2**32); the increment's int64
+        carrier never wraps;
+      * the lo-word add of two sub-2**31 words never wraps uint32, and
+        both output words stay below 2**31 (the packed (hi, lo) wire
+        invariant).
+
+    The keystream DERIVATION (the SplitMix64 mix) wraps uint32
+    intentionally and stays exempt from range analysis, exactly like the
+    Montgomery cores — its words enter here as [0, 2**31) inputs, which
+    is the only fact `keystream_pair`'s masking exports. Trace under
+    `jax.experimental.enable_x64()`. -> (fn, example_args).
+    """
+
+    def probe(rounds, r0, mask, v_hi, v_lo, z_hi, z_lo):
+        def cond(state):
+            return state[0] > 0
+
+        def body(state):
+            remaining, r, _hi, _lo = state
+            r = (r + 1) & mask                # the mod-2**32 counter
+            w_hi, w_lo = add_packed_mod(v_hi, v_lo, z_hi, z_lo)
+            return remaining - 1, r, w_hi, w_lo
+
+        _, r, w_hi, w_lo = jax.lax.while_loop(
+            cond, body,
+            (rounds, r0, jnp.zeros_like(v_hi), jnp.zeros_like(v_lo)),
+        )
+        return r, w_hi, w_lo
+
+    hi = np.zeros((2, 8), np.uint32)
+    # The counter mask rides as a uint32 ARG (an in-trace 0xFFFFFFFF
+    # literal cannot be named without x64; the argument form traces under
+    # both modes and the analyzer receives its exact interval).
+    return probe, (
+        np.int64(0), np.int64(0), np.uint32(0xFFFFFFFF), hi, hi, hi, hi
+    )
 
 
 __all__ = [
@@ -361,4 +428,5 @@ __all__ = [
     "hhe_bytes_on_wire_record",
     "exact_int_probes",
     "transcipher_sum_probe",
+    "keystream_counter_probe",
 ]

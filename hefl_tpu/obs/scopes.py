@@ -39,6 +39,8 @@ PSUM_AGGREGATE = "hefl.psum_aggregate"  # ciphertext masking + lazy sum + psum
 AGGREGATE = "hefl.aggregate"          # plaintext (masked) FedAvg mean + pmean
 DECRYPT = "hefl.decrypt"              # c0 + c1*s, iNTT, decode, unpack
 EVALUATE = "hefl.evaluate"            # test-set forward + softmax
+SERVE_SCORE = "hefl.serve_score"      # inference ct x plain mul + bias
+SERVE_ROTATE = "hefl.serve_rotate"    # rotate-and-sum ladder stage body
 
 # HOST-side spans (jax.profiler.TraceAnnotation, not named_scope): driver
 # work that owns wall-clock but runs no device ops. The trace parser
@@ -60,6 +62,8 @@ PHASES = (
     AGGREGATE,
     DECRYPT,
     EVALUATE,
+    SERVE_SCORE,
+    SERVE_ROTATE,
 )
 
 

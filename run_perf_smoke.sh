@@ -44,7 +44,13 @@
 #       an hhe.uploads_transciphered counter equal to cohort x rounds;
 #       and its final params must be BITWISE equal to the direct
 #       packed-CKKS twin's — the transcipher-vs-direct parity gate at
-#       the whole-experiment level.
+#       the whole-experiment level;
+#   (l) encrypted-inference certification (ISSUE 12): the smoke serving
+#       bench runs with the certify_inference pre-flight — both serving
+#       rings' rotate-and-sum ladders certify (canonical carries at any
+#       ladder depth, gadget products inside the 2**62 wall) and the
+#       bench's analysis_check row must report violations = 0, the same
+#       analysis.violations evidence training artifacts embed.
 # Wired into run_tpu_suite.sh as stage 0 (cheap pre-stage, no backend
 # probe needed — both harnesses pin themselves to CPU in smoke mode).
 set -euo pipefail
@@ -86,6 +92,55 @@ JAX_PLATFORMS=cpu python -m hefl_tpu.analysis --fast --json \
   cat "$workdir/hefl_lint.jsonl"
   exit 1
 }
+
+# (l) encrypted-inference certification (ISSUE 12): the serving bench at
+# smoke geometry with the certify_inference pre-flight; the analysis_check
+# row must be present with 0 violations (and the scoring rows sane).
+INFERENCE_SMOKE=1 INFERENCE_REPS=2 JAX_PLATFORMS=cpu \
+python bench_inference.py > "$workdir/inference_smoke.out" || {
+  echo "PERF SMOKE FAILED: bench_inference (certify_inference pre-flight):"
+  tail -20 "$workdir/inference_smoke.out"
+  exit 1
+}
+python - "$workdir/inference_smoke.out" <<'PY'
+import json
+import sys
+
+fail = []
+rows = []
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if line.startswith("{"):
+        try:
+            rows.append(json.loads(line))
+        except ValueError:
+            continue
+check = [r for r in rows if r.get("row") == "analysis_check"]
+score = [r for r in rows if r.get("row") != "analysis_check"]
+if not check:
+    fail.append("bench_inference: no analysis_check row (certify_inference "
+                "pre-flight not wired)")
+else:
+    if check[-1].get("violations") != 0:
+        fail.append(
+            f"bench_inference: analysis.violations = "
+            f"{check[-1].get('violations')} on the smoke serving rings"
+        )
+    certs = check[-1].get("certified") or []
+    if len(certs) < 2 or not all("CERTIFIED" in c for c in certs):
+        fail.append(f"bench_inference: expected 2 CERTIFIED serving-ring "
+                    f"summaries, got {certs}")
+if len(score) < 2 or not all(r.get("argmax_ok") for r in score):
+    fail.append(f"bench_inference: scoring rows missing/!argmax_ok: {score}")
+if fail:
+    print("PERF SMOKE FAILED (inference stage):")
+    for f in fail:
+        print(" -", f)
+    sys.exit(1)
+print(f"inference smoke OK: {len(score)} scoring rows, "
+      f"{len(check[-1]['certified'])} serving rings certified, "
+      "analysis.violations=0")
+PY
 
 # (k) hybrid-HE uplink (ISSUE 11): wire expansion <= 1.1x + the
 # transcipher-vs-direct bitwise parity gate, at experiment level. The

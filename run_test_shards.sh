@@ -31,11 +31,14 @@ if [ -n "$1" ]; then
   exit 0
 fi
 # Static-analysis pre-shard (ISSUE 8): source sweep, exact-integer region
-# lint, range certification of the full packing grid, and the hot-path
+# lint, range certification of the full packing grid (+ the loop-fixpoint
+# fold/inference certificates, ISSUE 12), and the hot-path
 # rem/div/f64/callback lint of the real round programs — the cheapest
 # whole-tree gate, so a reintroduced `lax.rem` or an unsafe packing
-# geometry fails in seconds, before any test compiles. The compile-heavy
-# scope-coverage stages run in the full-gate shard below.
+# geometry fails in seconds, before any test compiles. The CLI prints
+# per-stage timings (gate-cost regressions are visible right here); the
+# compile-heavy scope-coverage stages run in the budgeted full-gate
+# shard below.
 t0=$SECONDS
 python -m hefl_tpu.analysis --fast
 echo "== hefl-lint pre-shard (--fast): $((SECONDS - t0))s"
@@ -84,14 +87,25 @@ t0=$SECONDS
 HEFL_JOURNAL_FSYNC=always python -m pytest -q -m "not slow" \
   tests/test_journal.py
 echo "== journal shard (fsync=always): $((SECONDS - t0))s"
-# Analysis shard (ISSUE 8): the FULL static-analysis gate — everything the
-# pre-shard ran plus the scope-coverage stages, which compile the real
-# round programs (both fusion backends + the secure round) and require
-# every provenance-carrying leaf compute op to resolve to a hefl.* phase
-# scope.
+# Analysis shard (ISSUE 8/12): the FULL static-analysis gate (no --fast)
+# — everything the pre-shard ran plus the scope-coverage stages, which
+# compile the real round programs (both fusion backends + the secure
+# round), the streaming/HHE upload programs, and the encrypted-inference
+# serving program, and require every provenance-carrying leaf compute op
+# to resolve to a hefl.* phase scope. The gate prints per-stage timings
+# (see the pre-shard output too) and runs under an explicit wall-clock
+# budget so a gate-cost regression fails CI as loudly as a violation.
 t0=$SECONDS
 python -m hefl_tpu.analysis
-echo "== hefl-lint full gate: $((SECONDS - t0))s"
+gate_s=$((SECONDS - t0))
+echo "== hefl-lint full gate: ${gate_s}s"
+budget=${HEFL_LINT_BUDGET_S:-600}
+if [ "$gate_s" -gt "$budget" ]; then
+  echo "ANALYSIS SHARD FAILED: full hefl-lint gate took ${gate_s}s," \
+       "over the ${budget}s budget (HEFL_LINT_BUDGET_S) — a gate-cost" \
+       "regression; check the per-stage timings above"
+  exit 1
+fi
 for k in $(seq 1 "$N"); do
   run "slow shard $k/$N" -m slow --shard "$k/$N"
 done

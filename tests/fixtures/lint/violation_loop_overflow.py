@@ -1,0 +1,29 @@
+"""Golden violation: a scan whose carried int32 accumulator overflows
+only after enough iterations.
+
+Every SINGLE step is in-bounds — the carry grows by at most 2**16 per
+iteration, so any per-eqn check of one body evaluation stays green — but
+after ~2**15 of the 100000 iterations the running sum crosses 2**31 and
+wraps its int32 carrier. Exactly the class of bug the ISSUE-12 loop
+fixpoint exists for: the widened carry invariant exposes the escape, and
+`hefl-lint --fixture` must exit 1 with a loop-overflow finding CITING the
+carried op (`add`).
+"""
+
+import jax
+import jax.numpy as jnp
+
+RULE = "loop-overflow"
+
+
+def build():
+    def creeping_sum(xs):
+        # The pre-ISSUE-12 blind spot: a per-round byte counter
+        # accumulated in int32 across a long training scan.
+        def body(acc, v):
+            return acc + v, acc
+
+        total, _ = jax.lax.scan(body, jnp.int32(0), xs)
+        return total
+
+    return creeping_sum, (jnp.full((100000,), 2**16, jnp.int32),)

@@ -21,8 +21,11 @@ from hefl_tpu.analysis import (
     AnalysisError,
     Interval,
     check_experiment,
+    check_inference,
     certified_max_interleave,
     certify_aggregation,
+    certify_fold_inductive,
+    certify_inference,
     certify_packing,
     coverage,
     eval_jaxpr_ranges,
@@ -94,6 +97,190 @@ def test_unknown_primitive_is_conservative_not_fatal():
     res = eval_jaxpr_ranges(closed, [Interval(0, 10)])
     # sort passes through, cumsum multiplies; no crash either way.
     assert res.out_intervals[0].hi >= 10
+
+
+# ------------------------------------------------ loop fixpoints (ISSUE 12)
+
+
+def test_scan_carry_exact_iteration_is_tight():
+    """A static-trip-count scan iterates exactly: the carried sum's bound
+    is n * per-step max, not a widened ceiling."""
+
+    def f(x):
+        def body(c, v):
+            return c + v, c
+
+        out, _ = jax.lax.scan(body, jnp.int32(0), x)
+        return out
+
+    closed = jax.make_jaxpr(f)(jnp.zeros((5,), jnp.int32))
+    res = eval_jaxpr_ranges(closed, [Interval(0, 10)])
+    assert not res.findings
+    assert res.out_intervals[0].lo == 0 and res.out_intervals[0].hi == 50
+    (rep,) = res.loops
+    assert rep.op == "scan" and rep.mode == "exact" and not rep.widened
+
+
+def test_scan_loop_overflow_cites_carried_op():
+    """A carry that escapes its dtype only after many iterations: every
+    single step is in-bounds, the fixpoint (widening) sees the escape and
+    the audited body pass cites the carried `add`."""
+
+    def f(x):
+        def body(c, v):
+            return c + v, None
+
+        out, _ = jax.lax.scan(body, jnp.int32(0), x)
+        return out
+
+    closed = jax.make_jaxpr(f)(jnp.zeros((100000,), jnp.int32))
+    res = eval_jaxpr_ranges(closed, [Interval(0, 2**16)])
+    assert any(
+        f.kind == "dtype-overflow" and f.op == "add" for f in res.findings
+    )
+    (rep,) = res.loops
+    assert rep.op == "scan" and rep.mode == "fixpoint" and rep.widened
+
+
+def test_while_countdown_posts_fixpoint_without_widening():
+    """The count-down idiom every loop probe uses: cond refinement plus
+    the decreasing counter reach a post-fixpoint on the first join."""
+
+    def f(n, acc, row):
+        def cond(s):
+            return s[0] > 0
+
+        def body(s):
+            rem, a = s
+            return rem - 1, (a + row) % jnp.int32(97)
+
+        return jax.lax.while_loop(cond, body, (n, acc))
+
+    closed = jax.make_jaxpr(f)(jnp.int32(0), jnp.int32(0), jnp.int32(0))
+    res = eval_jaxpr_ranges(
+        closed, [Interval(0, 2**20), Interval(0, 96), Interval(0, 96)]
+    )
+    assert not res.findings
+    assert res.out_intervals[1].lo == 0 and res.out_intervals[1].hi == 96
+    (rep,) = res.loops
+    assert rep.op == "while" and not rep.widened
+
+
+def test_while_counter_widens_then_narrows_to_cond_bound():
+    """A count-UP while: the joined counter widens past WIDEN_DELAY, the
+    narrowing pass re-anchored at the init plus the exit refinement
+    recover the condition's bound on the way out."""
+
+    def f(n):
+        def cond(s):
+            return s[0] < n
+
+        def body(s):
+            return (s[0] + 1,)
+
+        return jax.lax.while_loop(cond, body, (jnp.int32(0),))
+
+    closed = jax.make_jaxpr(f)(jnp.int32(0))
+    res = eval_jaxpr_ranges(closed, [Interval(0, 1000)])
+    assert not res.findings
+    assert res.out_intervals[0].hi <= 1000
+    (rep,) = res.loops
+    assert rep.op == "while" and rep.widened and rep.narrowed
+
+
+def test_zero_length_scan_is_init_with_no_findings():
+    """A zero-trip scan never runs its body: the carry must come back as
+    exactly the init — not a widened fixpoint — and a body that WOULD
+    overflow must produce no findings (it never executes)."""
+
+    def f(x):
+        def body(c, v):
+            return c + v, c
+
+        out, _ = jax.lax.scan(body, jnp.int32(0), x)
+        return out
+
+    closed = jax.make_jaxpr(f)(jnp.zeros((0,), jnp.int32))
+    res = eval_jaxpr_ranges(closed, [Interval(0, 2**30)])
+    assert not res.findings
+    assert res.out_intervals[0].lo == 0 and res.out_intervals[0].hi == 0
+    (rep,) = res.loops
+    assert rep.op == "scan" and rep.length == 0 and not rep.widened
+
+
+def test_nested_loops_report_once_each():
+    """LoopReports are quiet-gated like findings: a scan nested inside
+    another scan contributes ONE report (at the outer audited pass), not
+    one per exploratory outer iteration."""
+
+    def f(x):
+        def outer(c, v):
+            def inner(a, w):
+                return a + w, None
+
+            s, _ = jax.lax.scan(inner, jnp.int32(0), x)
+            return c + s + v, None
+
+        out, _ = jax.lax.scan(outer, jnp.int32(0), x)
+        return out
+
+    closed = jax.make_jaxpr(f)(jnp.zeros((3,), jnp.int32))
+    res = eval_jaxpr_ranges(closed, [Interval(0, 5)])
+    assert not res.findings
+    assert len(res.loops) == 2
+    assert sorted((rep.op for rep in res.loops)) == ["scan", "scan"]
+
+
+def test_fold_findings_embedded_once_in_aggregation():
+    """Double-count regression: certify_aggregation embeds the inductive
+    fold certificate's findings (leg 3) verbatim — the gate and
+    check_experiment must therefore count the standalone fold certificate
+    as a record only, never as additional findings."""
+    bad = (1 << 62) + 57          # breaks the int64 fold carrier
+    agg = certify_aggregation(bad)
+    fold = certify_fold_inductive(bad)
+    assert not fold.ok and not agg.ok
+    for f in fold.findings:
+        assert agg.findings.count(f) == 1
+
+
+def test_cond_branches_union():
+    def f(p, x):
+        return jax.lax.cond(p, lambda v: v + 1, lambda v: v - 1, x)
+
+    closed = jax.make_jaxpr(f)(True, jnp.int32(0))
+    res = eval_jaxpr_ranges(closed, [Interval(0, 1), Interval(0, 10)])
+    assert res.out_intervals[0].lo == -1 and res.out_intervals[0].hi == 11
+    assert not any("unsupported primitive `cond`" in n for n in res.notes)
+
+
+def test_round_program_loops_reach_fixpoint():
+    """Acceptance (ISSUE 12): the interval interpreter reaches a sound
+    post-fixpoint on the real round program's loops (the flat training
+    scan + validation cond) — no conservatively-unbounded `scan`/`while`
+    notes remain."""
+    from hefl_tpu.analysis.lint import _tiny_round_inputs
+    from hefl_tpu.fl import TrainConfig
+    from hefl_tpu.fl.fedavg import _build_round_fn
+    from hefl_tpu.analysis import ranges as ranges_mod
+
+    module, params, mesh, gp, xs, ys, keys = _tiny_round_inputs()
+    cfg = TrainConfig(
+        epochs=1, batch_size=4, num_classes=10, val_fraction=0.25,
+    )
+    fn = _build_round_fn(module, cfg, mesh)
+    closed = jax.make_jaxpr(fn)(gp, xs, ys, keys)
+    res = eval_jaxpr_ranges(
+        closed,
+        [ranges_mod.TOP] * len(closed.jaxpr.invars),
+        check_dtype=False,
+    )
+    bad = [n for n in res.notes
+           if "unsupported primitive `scan`" in n
+           or "unsupported primitive `while`" in n
+           or "unsupported primitive `cond`" in n]
+    assert not bad, bad
+    assert any(rep.op == "scan" for rep in res.loops)
 
 
 # ------------------------------------------------ packing certification
@@ -182,6 +369,8 @@ def test_aggregation_certified_at_production_prime():
     cert = certify_aggregation(2**27 - 39)
     assert cert.ok, cert.summary()
     assert cert.chunk == 32
+    # The fold leg is now the INDUCTIVE certificate (ISSUE 12).
+    assert any("inductive" in c for c in cert.checks), cert.checks
 
 
 def test_aggregation_rejects_oversized_prime():
@@ -190,6 +379,101 @@ def test_aggregation_rejects_oversized_prime():
     cert = certify_aggregation((1 << 31) - 1)
     assert not cert.ok
     assert any(f.kind == "dtype-overflow" for f in cert.findings)
+
+
+# ------------------------------------------------ fold induction (ISSUE 12)
+
+
+def test_fold_inductive_certifies_unbounded_arrivals():
+    cert = certify_fold_inductive(2**27 - 39)
+    assert cert.ok, cert.summary()
+    assert cert.count_ceiling_bits == 48
+    assert any("any arrival count" in c for c in cert.checks)
+
+
+def test_fold_inductive_rejects_carrier_breaking_prime():
+    """A prime past 2**62 makes acc + row escape the int64 carrier: the
+    induction step itself fails, citing the op."""
+    cert = certify_fold_inductive((1 << 62) + 57)
+    assert not cert.ok
+    assert any(
+        f.kind == "dtype-overflow" and f.op == "add" for f in cert.findings
+    )
+
+
+def test_fold_inductive_packed_leg(ring):
+    spec = PackedSpec.for_params(
+        {"w": jnp.zeros((64,))}, ring,
+        PackingConfig(bits=8, interleave=2, clip=0.5), 2,
+    )
+    cert = certify_fold_inductive(2**27 - 39, spec, int(ring.modulus))
+    assert cert.ok, cert.summary()
+    assert cert.bits == 8 and cert.clients == 2
+    assert any("packed fold" in c for c in cert.checks)
+    with pytest.raises(ValueError, match="modulus"):
+        certify_fold_inductive((1 << 27) - 39 + 2, spec)
+
+
+# ------------------------------------------------ inference certification
+
+
+def test_inference_certified_at_production_geometry():
+    cert = certify_inference(2**27 - 39, 5, 6)
+    assert cert.ok, cert.summary()
+    assert any("any ladder depth" in c for c in cert.checks)
+
+
+def test_inference_rejects_oversized_prime_citing_op():
+    """Past 2**31 the gadget digit x key product escapes the declared
+    2**62 exact-integer ceiling — rejected naming the multiply."""
+    cert = certify_inference((1 << 32) + 15, 9, 4)
+    assert not cert.ok
+    assert any(
+        f.kind == "ceiling" and f.op == "mul" for f in cert.findings
+    )
+
+
+def test_check_inference_registers_violations(ring):
+    from hefl_tpu.obs import metrics as obs_metrics
+
+    base = obs_metrics.snapshot().get("analysis.violations", 0)
+    report = check_inference(ring)
+    assert report["inference"].ok
+    assert obs_metrics.snapshot()["analysis.violations"] == base
+
+
+def test_serving_ladder_program_loops_reach_fixpoint(ring):
+    """The REAL rotate-and-sum scan (not the probe): its loop carries
+    reach a post-fixpoint too — the Montgomery uint32 wraps keep the
+    intervals wide (that is their documented exemption), but the
+    analysis terminates with a sound invariant instead of punting."""
+    import numpy as np
+
+    from hefl_tpu import he_inference as hei
+    from hefl_tpu.analysis import ranges as ranges_mod
+    from hefl_tpu.ckks.keys import keygen
+
+    sk, pk = keygen(ring, jax.random.key(0))
+    gks = hei.gen_rotation_keys(ring, sk, jax.random.key(1))
+    ladder = hei.stack_rotation_ladder(ring, gks)
+    ct = hei.encrypt_features(
+        ring, pk, np.zeros((8,)), jax.random.key(2)
+    )
+
+    def fn(c0, c1, lad):
+        out = hei.rotate_and_sum_scan(
+            ring, hei.Ciphertext(c0=c0, c1=c1, scale=ct.scale), lad
+        )
+        return out.c0, out.c1
+
+    closed = jax.make_jaxpr(fn)(ct.c0, ct.c1, ladder)
+    res = eval_jaxpr_ranges(
+        closed,
+        [ranges_mod.TOP] * len(closed.jaxpr.invars),
+        check_dtype=False,
+    )
+    assert any(rep.op == "scan" for rep in res.loops)
+    assert not [n for n in res.notes if "unsupported primitive `scan" in n]
 
 
 # ------------------------------------------------ lint rules
@@ -294,18 +578,93 @@ def test_each_violation_fixture_fails_hefl_lint(fixture):
     assert lint_main(["--fixture", path, "--json"]) == 1
 
 
-def test_fixture_count_covers_all_four_rules():
+def test_fixture_count_covers_all_five_rules():
     rules = set()
     for p in glob.glob(os.path.join(FIXTURES, "violation_*.py")):
         src = open(p).read()
         for rule in ("forbidden-primitive", "float-contamination",
-                     "missing-scope", "broken-donation"):
+                     "missing-scope", "broken-donation", "loop-overflow"):
             if f'RULE = "{rule}"' in src:
                 rules.add(rule)
     assert rules == {
         "forbidden-primitive", "float-contamination",
-        "missing-scope", "broken-donation",
+        "missing-scope", "broken-donation", "loop-overflow",
     }
+
+
+def test_json_schema_golden():
+    """The `hefl-lint --json` line schema, pinned (ISSUE 12): CI
+    consumers parse these lines — any key/type change here is a breaking
+    change and must bump JSON_SCHEMA_VERSION. One JSON object per line:
+    `certificate` lines first, then `finding` lines, then exactly one
+    trailing `summary` line."""
+    import json as json_mod
+
+    from hefl_tpu.analysis.cli import (
+        GateReport,
+        JSON_SCHEMA_VERSION,
+        _cert_record,
+        emit_json,
+    )
+    from hefl_tpu.analysis.lint import LintFinding
+
+    report = GateReport(
+        findings=[LintFinding(
+            rule="loop-overflow", where="fixture", message="carry escapes"
+        )],
+        certificates=[
+            _cert_record("aggregation", certify_aggregation(2**27 - 39)),
+            _cert_record("fold-inductive",
+                         certify_fold_inductive(2**27 - 39)),
+            _cert_record("inference", certify_inference(2**27 - 39, 5, 6)),
+        ],
+        stages=[{"stage": "range certification", "seconds": 1.5,
+                 "findings": 1}],
+    )
+    lines = [json_mod.loads(s) for s in emit_json(report)]
+
+    assert JSON_SCHEMA_VERSION == 1  # bump ONLY with a schema change
+    assert [r["type"] for r in lines] == (
+        ["certificate"] * 3 + ["finding", "summary"]
+    )
+    for rec in lines[:3]:
+        assert {"type", "kind", "ok", "summary"} <= set(rec)
+        assert isinstance(rec["ok"], bool) and isinstance(
+            rec["summary"], str
+        )
+    kinds = {r["kind"] for r in lines[:3]}
+    assert kinds == {"aggregation", "fold-inductive", "inference"}
+    # Per-kind numeric fields CI dashboards key on.
+    by_kind = {r["kind"]: r for r in lines[:3]}
+    assert by_kind["fold-inductive"]["count_ceiling_bits"] == 48
+    assert "depth_ceiling_bits" in by_kind["inference"]
+    assert "prime_bits" in by_kind["aggregation"]
+
+    finding = lines[3]
+    assert set(finding) == {"type", "rule", "where", "message"}
+
+    summary = lines[-1]
+    assert set(summary) == {
+        "type", "schema", "ok", "violations", "certificates", "stages",
+        "total_seconds",
+    }
+    assert summary["schema"] == JSON_SCHEMA_VERSION
+    assert summary["ok"] is False and summary["violations"] == 1
+    assert summary["certificates"] == 3
+    (stage,) = summary["stages"]
+    assert set(stage) == {"stage", "seconds", "findings"}
+    assert summary["total_seconds"] == 1.5
+
+
+def test_loop_overflow_fixture_names_the_carry_op():
+    """The ISSUE-12 golden fixture: a scan whose carried accumulator
+    overflows only after enough iterations — invisible per-eqn — must
+    drive hefl-lint to exit 1 CITING the carried op."""
+    path = os.path.join(FIXTURES, "violation_loop_overflow.py")
+    findings = run_fixture(path)
+    assert findings and all(f.rule == "loop-overflow" for f in findings)
+    assert any("`add`" in f.message for f in findings), findings
+    assert lint_main(["--fixture", path, "--json"]) == 1
 
 
 # ------------------------------------------------ coverage
@@ -324,6 +683,40 @@ def test_coverage_passes_scoped_and_flags_unscoped():
     fn, fargs = run_fixture_build("violation_missing_scope.py")
     found = coverage.check_fn_coverage(fn, fargs, "unscoped")
     assert any(f.rule == "missing-scope" for f in found)
+
+
+def test_coverage_threads_scope_through_while_body():
+    """ISSUE 12 regression: name stacks inside a `while` body jaxpr are
+    RELATIVE to the call eqn (empty for a leaf op traced with no extra
+    scope inside the body) — the walk must thread the call's inherited
+    prefix down so a looped leaf op attributes to the scope wrapping the
+    loop, and must still flag the same leaf when no scope wraps it."""
+    from hefl_tpu.obs import scopes as obs_scopes
+
+    def body_of(x, w):
+        def body(s):
+            i, acc = s
+            return i - 1, acc + x @ w
+
+        return jax.lax.while_loop(
+            lambda s: s[0] > 0, body, (jnp.int32(3), jnp.zeros((4, 4)))
+        )
+
+    def scoped(x, w):
+        with jax.named_scope(obs_scopes.SGD_CORE):
+            return body_of(x, w)
+
+    args = (jnp.zeros((4, 8)), jnp.zeros((8, 4)))
+    closed = jax.make_jaxpr(scoped)(*args)
+    # The looped dot_general's own stack is empty — only the threaded
+    # prefix can attribute it.
+    assert coverage.jaxpr_scope_findings(closed, "while-scoped") == []
+    unscoped = jax.make_jaxpr(body_of)(*args)
+    found = coverage.jaxpr_scope_findings(unscoped, "while-unscoped")
+    assert any(
+        f.rule == "missing-scope" and "dot_general" in f.message
+        for f in found
+    )
 
 
 def test_round_program_lint_clean_plaintext():
