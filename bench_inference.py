@@ -1,17 +1,26 @@
-"""Private-inference serving benchmark: encrypted linear + depth-2 MLP.
+"""Private-inference serving benchmark: the BENCH_INFER artifact family.
 
 Measures the steady-state serving cost of the precompiled scorers
-(`he_inference.LinearScorer` / `MlpScorer`): compile time once, then warm
-per-sample latency → scores/sec. Both configurations sit within the
-128-bit-security envelope (linear: N=4096 / 3×27-bit primes, log2(q)=81
-≤ 109; MLP: N=8192 / 5 primes, log2(q)=135 ≤ 218).
+(`he_inference.LinearScorer` ladder reference, `BsgsLinearScorer` — the
+ISSUE-13 baby-step giant-step serving plan — and `MlpScorer`): compile
+time once, then per-call latency percentiles (p50/p95/p99) and QPS, with
+each call blocked to completion the way a serving loop would experience
+it. Batched rows drive `score_many` (bucket-padded batches, one fused
+dispatch chain per batch) against the single-query rows, which is the
+throughput claim the perf smoke gates at >= 1.3x.
 
-The reference has no private-inference capability at all (its model always
-runs on plaintext, /root/reference/FLPyfhelin.py:366-390), so these rows
-are beyond-parity: there is no baseline number to compare against.
+Both configurations sit within the 128-bit-security envelope (linear:
+N=4096 / 3x27-bit primes, log2(q)=81 <= 109; MLP: N=8192 / 5 primes,
+log2(q)=135 <= 218). The reference has no private-inference capability at
+all (its model always runs on plaintext, /root/reference/FLPyfhelin.py:
+366-390), so these rows are beyond-parity: there is no baseline number.
 
 Output: a markdown table on stdout (the TPU suite redirects it to
-INFERENCE_TABLE.md) with one machine-readable JSON line per row at the end.
+INFERENCE_TABLE.md), one machine-readable JSON line per row, and the
+BENCH_INFER JSON artifact (path: $BENCH_INFER_PATH, default
+BENCH_INFER.json) carrying the rows + the `analysis_check` evidence
+(certify_inference AND certify_keyswitch per serving ring) + the resolved
+`he_backend` record.
 
 INFERENCE_SMOKE=1 pins CPU and shrinks rings for a pipeline shakeout.
 """
@@ -32,76 +41,42 @@ from hefl_tpu.utils.probe import setup_backend
 setup_backend("bench_inference.py", "cpu" if SMOKE else None)
 
 REPS = int(os.environ.get("INFERENCE_REPS", "20"))
+ARTIFACT_PATH = os.environ.get("BENCH_INFER_PATH", "BENCH_INFER.json")
 
 
-def _bench_scorer(name, scorer, ctx, sk, pk, make_x, want_fn, decrypt_ctx, dec_sk):
-    from hefl_tpu import he_inference as hei
-
-    rng = np.random.default_rng(0)
-    x = make_x(rng)
-    ct_x = hei.encrypt_features(ctx, pk, x, jax.random.key(100))
-
+def _measure(call, ready, reps):
+    """Per-call wall latencies, each blocked to completion (serving
+    style: a single query pays its own dispatch; a batch amortizes one).
+    -> (compile_s, latencies_s[reps])."""
     t0 = time.perf_counter()
-    out = scorer.score_batched(ct_x)
-    jax.block_until_ready((out.c0, out.c1))
+    out = call()
+    jax.block_until_ready(ready(out))
     compile_s = time.perf_counter() - t0
+    lats = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = call()
+        jax.block_until_ready(ready(out))
+        lats.append(time.perf_counter() - t0)
+    return compile_s, np.asarray(lats), out
 
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        out = scorer.score_batched(ct_x)
-    jax.block_until_ready((out.c0, out.c1))
-    warm_s = (time.perf_counter() - t0) / REPS
 
-    got = hei.decrypt_scores(
-        decrypt_ctx,
-        dec_sk,
-        [
-            hei.Ciphertext(c0=out.c0[k], c1=out.c1[k], scale=out.scale)
-            for k in range(scorer.num_classes)
-        ],
-    )
-    err = float(np.max(np.abs(got - want_fn(x))))
+def _row(name, plan, batch, keyswitches, compile_s, lats, err, argmax_ok):
+    mean = float(np.mean(lats))
     return {
         "row": name,
+        "plan": plan,
+        "batch": batch,
+        "keyswitches_per_score": keyswitches,
         "compile_s": round(compile_s, 3),
-        "warm_latency_ms": round(warm_s * 1e3, 3),
-        "scores_per_s": round(1.0 / warm_s, 2),
+        "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+        "p95_ms": round(float(np.percentile(lats, 95)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+        "warm_latency_ms": round(mean * 1e3, 3),
+        "qps": round(batch / mean, 2),
+        "scores_per_s": round(batch / mean, 2),
         "max_abs_err": err,
-        "argmax_ok": bool(np.argmax(got) == np.argmax(want_fn(x))),
-    }
-
-
-def _bench_batched(name, scorer, ctx, pk, make_xs, want_fn, decrypt_ctx, dec_sk):
-    """Throughput row: score_many over a batch in one dispatch."""
-    from hefl_tpu import he_inference as hei
-
-    rng = np.random.default_rng(1)
-    xs = make_xs(rng)
-    ct_xs = hei.encrypt_features(ctx, pk, xs, jax.random.key(200))
-
-    t0 = time.perf_counter()
-    out = scorer.score_many(ct_xs)
-    jax.block_until_ready((out.c0, out.c1))
-    compile_s = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        out = scorer.score_many(ct_xs)
-    jax.block_until_ready((out.c0, out.c1))
-    warm_s = (time.perf_counter() - t0) / REPS
-
-    got = hei.decrypt_score_matrix(decrypt_ctx, dec_sk, out)
-    err = float(np.max(np.abs(got - want_fn(xs))))
-    b = xs.shape[0]
-    return {
-        "row": name,
-        "compile_s": round(compile_s, 3),
-        "warm_latency_ms": round(warm_s * 1e3, 3),
-        "scores_per_s": round(b / warm_s, 2),
-        "max_abs_err": err,
-        "argmax_ok": bool(
-            np.all(np.argmax(got, -1) == np.argmax(want_fn(xs), -1))
-        ),
+        "argmax_ok": argmax_ok,
     }
 
 
@@ -109,6 +84,7 @@ def main():
     from hefl_tpu import he_inference as hei
     from hefl_tpu.analysis import check_inference
     from hefl_tpu.ckks import encoding
+    from hefl_tpu.ckks.backend import he_backend_report
     from hefl_tpu.ckks.keys import CkksContext, gen_relin_key, keygen
     from hefl_tpu.obs import metrics as obs_metrics
 
@@ -117,53 +93,101 @@ def main():
     rng = np.random.default_rng(42)
     certified = []
 
-    # --- Row 1: encrypted linear, full-width features -------------------
+    # --- Encrypted linear: ladder reference vs the BSGS serving plan ----
     n_lin = 256 if SMOKE else 4096
     ctx = CkksContext.create(n=n_lin)
-    # Pre-flight static analysis (ISSUE 12): the rotate-and-sum serving
-    # ladder certifies at this ring's geometry before any bench work —
-    # inference runs register analysis.violations exactly like training
-    # runs do, and an uncertified ring fails loudly here.
-    certified.append(check_inference(ctx)["inference"].summary())
+    # Pre-flight static analysis (ISSUE 12/13): the rotate-and-sum ladder
+    # AND the key-switch gadget certify at this ring's geometry before any
+    # bench work — an uncertified serving ring fails loudly here.
+    certified.extend(
+        c.summary() for c in check_inference(ctx).values()
+    )
     sk, pk = keygen(ctx, jax.random.key(0))
     gks = hei.gen_rotation_keys(ctx, sk, jax.random.key(1))
-    d = encoding.num_slots(ctx.ntt)  # every slot carries a feature
+    slots = encoding.num_slots(ctx.ntt)
+    # d = slots/4 leaves headroom for 4-per-ct query packing in the
+    # batched row (full-width d admits no packing, q = 1).
+    d = 32 if SMOKE else slots // 4
     K = 10
     W = rng.normal(0, 0.3, (K, d))
     b = rng.normal(0, 0.2, K)
-    scorer = hei.LinearScorer(ctx, W, b, gks)
-    rows.append(
-        _bench_scorer(
-            f"linear N={n_lin} d={d} K={K}",
-            scorer,
-            ctx,
-            sk,
-            pk,
-            lambda r: r.normal(0, 0.5, d),
-            lambda x: x @ W.T + b,
-            ctx,
-            sk,
-        )
-    )
+    want = lambda xs: np.asarray(xs) @ W.T + b  # noqa: E731
 
-    B_lin = 4 if SMOKE else 16
-    rows.append(
-        _bench_batched(
-            f"linear N={n_lin} d={d} K={K} B={B_lin}",
-            scorer,
-            ctx,
-            pk,
-            lambda r: r.normal(0, 0.5, (B_lin, d)),
-            lambda xs: xs @ W.T + b,
-            ctx,
-            sk,
-        )
-    )
+    x1 = rng.normal(0, 0.5, d)
+    ct1 = hei.encrypt_features(ctx, pk, x1, jax.random.key(100))
+    B_lin = 8 if SMOKE else 16
 
-    # --- Row 2: depth-2 MLP (square activation) -------------------------
+    ladder = hei.LinearScorer(ctx, W, b, gks)
+    compile_s, lats, out = _measure(
+        lambda: ladder.score_batched(ct1), lambda o: (o.c0, o.c1), REPS
+    )
+    got = hei.decrypt_scores(
+        ctx, sk,
+        [hei.Ciphertext(c0=out.c0[k], c1=out.c1[k], scale=out.scale)
+         for k in range(K)],
+    )
+    rows.append(_row(
+        f"linear N={n_lin} d={d} K={K}", "ladder", 1,
+        hei.ladder_keyswitches(slots, K), compile_s, lats,
+        float(np.max(np.abs(got - want(x1)))),
+        bool(np.argmax(got) == np.argmax(want(x1))),
+    ))
+
+    plan = hei.bsgs_plan(slots, d, K)
+    bsgs_gks = hei.gen_rotation_keys_for_steps(
+        ctx, sk, jax.random.key(2), plan.rotation_steps_needed
+    )
+    bsgs = hei.BsgsLinearScorer(ctx, W, b, bsgs_gks)
+    compile_s, lats, out = _measure(
+        lambda: bsgs.score(ct1), lambda o: (o.c0, o.c1), REPS
+    )
+    got = hei.decrypt_class_scores(ctx, sk, out, K)
+    single = _row(
+        f"bsgs N={n_lin} d={d} K={K}", "bsgs", 1,
+        bsgs.plan.num_keyswitches, compile_s, lats,
+        float(np.max(np.abs(got - want(x1)))),
+        bool(np.argmax(got) == np.argmax(want(x1))),
+    )
+    rows.append(single)
+
+    # Batched serving: queries packed q-per-ciphertext into slot blocks
+    # (ISSUE 13 — the device program is unchanged, the diagonals tile) AND
+    # batched across ciphertexts, so one dispatch scores q * B_ct queries.
+    q = max(1, slots // max(d, K))
+    while slots % q:
+        q -= 1
+    B_ct = max(1, B_lin // q)
+    n_queries = q * B_ct
+    xq = rng.normal(0, 0.5, (B_ct, q, d))
+    packed = hei.BsgsLinearScorer(
+        ctx, W, b, bsgs_gks, queries_per_ct=q
+    )
+    ct_q = hei.encrypt_query_block(ctx, pk, xq, jax.random.key(102), q)
+    compile_s, lats, out = _measure(
+        lambda: packed.score_many(ct_q), lambda o: (o.c0, o.c1), REPS
+    )
+    got = hei.decrypt_class_scores(ctx, sk, out, K, queries_per_ct=q)
+    batched = _row(
+        f"bsgs N={n_lin} d={d} K={K} q={q} B={n_queries}", "bsgs",
+        n_queries, round(packed.plan.num_keyswitches / q, 2),
+        compile_s, lats,
+        float(np.max(np.abs(got - want(xq)))),
+        bool(np.all(np.argmax(got, -1) == np.argmax(want(xq), -1))),
+    )
+    rows.append(batched)
+    batched_vs_single = {
+        "plan": "bsgs",
+        "batch": n_queries,
+        "queries_per_ct": q,
+        "single_qps": single["qps"],
+        "batched_qps": batched["qps"],
+        "speedup": round(batched["qps"] / single["qps"], 3),
+    }
+
+    # --- Depth-2 MLP (square activation) --------------------------------
     n_mlp = 512 if SMOKE else 8192
     ctx2 = CkksContext.create(n=n_mlp, num_primes=5)
-    certified.append(check_inference(ctx2)["inference"].summary())
+    certified.extend(c.summary() for c in check_inference(ctx2).values())
     sk2, pk2 = keygen(ctx2, jax.random.key(10))
     gks2 = hei.gen_rotation_keys(ctx2, sk2, jax.random.key(11))
     rlk2 = gen_relin_key(ctx2, sk2, jax.random.key(12))
@@ -174,56 +198,93 @@ def main():
     b2 = rng.normal(0, 0.2, K)
     mlp = hei.MlpScorer(ctx2, w1, b1, w2, b2, gks2, rlk2)
     sk_dec = hei.slice_secret_key(sk2, mlp.sub_ctx.num_primes)
-    rows.append(
-        _bench_scorer(
-            f"mlp N={n_mlp} d={d2} H={H} K={K}",
-            mlp,
-            ctx2,
-            sk2,
-            pk2,
-            lambda r: r.normal(0, 0.4, d2),
-            lambda x: ((x @ w1.T + b1) ** 2) @ w2.T + b2,
-            mlp.sub_ctx,
-            sk_dec,
-        )
+    mlp_want = lambda xs: (  # noqa: E731
+        (np.asarray(xs) @ w1.T + b1) ** 2
+    ) @ w2.T + b2
+    # H hidden-ladder key-switches per sample plus H relinearizations.
+    mlp_ks = hei.ladder_keyswitches(encoding.num_slots(ctx2.ntt), H) + H
+
+    xm = rng.normal(0, 0.4, d2)
+    ctm = hei.encrypt_features(ctx2, pk2, xm, jax.random.key(110))
+    compile_s, lats, out = _measure(
+        lambda: mlp.score_batched(ctm), lambda o: (o.c0, o.c1), REPS
     )
+    got = hei.decrypt_scores(
+        mlp.sub_ctx, sk_dec,
+        [hei.Ciphertext(c0=out.c0[k], c1=out.c1[k], scale=out.scale)
+         for k in range(K)],
+    )
+    rows.append(_row(
+        f"mlp N={n_mlp} d={d2} H={H} K={K}", "mlp", 1, mlp_ks,
+        compile_s, lats,
+        float(np.max(np.abs(got - mlp_want(xm)))),
+        bool(np.argmax(got) == np.argmax(mlp_want(xm))),
+    ))
 
     B_mlp = 2 if SMOKE else 8
-    rows.append(
-        _bench_batched(
-            f"mlp N={n_mlp} d={d2} H={H} K={K} B={B_mlp}",
-            mlp,
-            ctx2,
-            pk2,
-            lambda r: r.normal(0, 0.4, (B_mlp, d2)),
-            lambda xs: ((xs @ w1.T + b1) ** 2) @ w2.T + b2,
-            mlp.sub_ctx,
-            sk_dec,
-        )
+    xms = rng.normal(0, 0.4, (B_mlp, d2))
+    ctms = hei.encrypt_features(ctx2, pk2, xms, jax.random.key(111))
+    compile_s, lats, out = _measure(
+        lambda: mlp.score_many(ctms), lambda o: (o.c0, o.c1), REPS
     )
+    got = hei.decrypt_score_matrix(mlp.sub_ctx, sk_dec, out)
+    rows.append(_row(
+        f"mlp N={n_mlp} d={d2} H={H} K={K} B={B_mlp}", "mlp", B_mlp,
+        mlp_ks, compile_s, lats,
+        float(np.max(np.abs(got - mlp_want(xms)))),
+        bool(np.all(np.argmax(got, -1) == np.argmax(mlp_want(xms), -1))),
+    ))
 
     print(f"# Private-inference serving bench ({backend.device_kind}, reps={REPS})")
     print()
-    print("| config | compile (s) | warm latency (ms) | scores/s | max |err| | argmax ok |")
-    print("|---|---|---|---|---|---|")
+    print("| config | plan | B | keyswitches/score | compile (s) | "
+          "p50 (ms) | p95 (ms) | p99 (ms) | QPS | max |err| | argmax ok |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|")
     for r in rows:
         print(
-            f"| {r['row']} | {r['compile_s']} | {r['warm_latency_ms']} "
-            f"| {r['scores_per_s']} | {r['max_abs_err']:.2e} | {r['argmax_ok']} |"
+            f"| {r['row']} | {r['plan']} | {r['batch']} "
+            f"| {r['keyswitches_per_score']} | {r['compile_s']} "
+            f"| {r['p50_ms']} | {r['p95_ms']} | {r['p99_ms']} "
+            f"| {r['qps']} | {r['max_abs_err']:.2e} | {r['argmax_ok']} |"
         )
     print()
-    # The analysis evidence row (ISSUE 12): violations is the same
+    print(
+        f"batched-vs-single ({batched_vs_single['plan']}, "
+        f"B={batched_vs_single['batch']}): "
+        f"{batched_vs_single['speedup']}x QPS"
+    )
+    print()
+    # The analysis evidence row (ISSUE 12/13): violations is the same
     # `analysis.violations` counter training artifacts embed — 0 here is
-    # queryable proof the serving rings were certified, not skipped.
-    rows.append({
+    # queryable proof the serving rings AND the key-switch gadget were
+    # certified, not skipped.
+    check_row = {
         "row": "analysis_check",
         "violations": int(
             obs_metrics.snapshot().get("analysis.violations", 0)
         ),
         "certified": certified,
-    })
-    for r in rows:
+    }
+    for r in rows + [check_row]:
         print(json.dumps(r))
+
+    artifact = {
+        "artifact": "BENCH_INFER",
+        "device": getattr(backend, "device_kind", str(backend)),
+        "backend": jax.default_backend(),
+        "smoke": SMOKE,
+        "reps": REPS,
+        "rows": rows,
+        "batched_vs_single": batched_vs_single,
+        "analysis_check": {
+            "violations": check_row["violations"],
+            "certified": certified,
+        },
+        "he_backend": he_backend_report(),
+    }
+    with open(ARTIFACT_PATH, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(f"artifact written to {ARTIFACT_PATH}")
 
 
 if __name__ == "__main__":

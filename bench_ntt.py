@@ -16,6 +16,13 @@ pk combination — as one Mosaic dispatch vs the XLA graph), and asserts
 bit-exact parity between the two backends for every op on hardware (the
 CPU test suite only ever runs the kernels interpreted — VERDICT r2 weak #4).
 
+The keyswitch stage (ISSUE 13) runs at the [18, 3, 4096] gadget shape the
+suite has carried since PR 4 precisely to measure this: the whole gadget
+key-switch (digit decompose -> per-component forward NTT -> digit x key
+Montgomery inner product) as `ops._keyswitch_coeff_xla` vs the fused
+`pallas_ntt.keyswitch_fused_pallas` dispatch, bitwise-parity-gated under
+the same exit-42 contract as every other stage.
+
 Usage: python bench_ntt.py            (writes a row table to stdout)
 """
 
@@ -96,6 +103,7 @@ def main() -> None:
 
     prev = ntt_mod._BACKEND
     rows = []
+    ks_rows = []
     # [14, 3, 4096] is the PACKED flagship-bench batch (ISSUE 6): the
     # 2-client flagship's 55 ciphertexts bit-interleaved 4-to-a-slot ->
     # ceil(55/4) = 14 rows. (k is client-count-dependent: the 8-client
@@ -179,6 +187,47 @@ def main() -> None:
                  t_ex * 1e3, t_ep * 1e3, t_ex / t_ep,
                  t_dx * 1e3, t_dp * 1e3, t_dx / t_dp)
             )
+
+            # Keyswitch stage (ISSUE 13): the fused gadget key-switch vs
+            # the XLA reference, at the gadget shape this bench has
+            # carried since PR 4 (and at the smoke shape on CPU). Same
+            # exit-42 parity contract: a c0/c1 mismatch is a
+            # deterministic kernel failure, not a tunnel blip.
+            if shape[0] == 18 or os.environ.get("NTT_SMOKE") == "1":
+                num_c = ctx.num_primes * ctx.ksk_num_digits + 1
+                ks_b = rand_res((num_c,) + shape[1:])
+                ks_a = rand_res((num_c,) + shape[1:])
+                ks_x = jax.jit(lambda c: ops_mod._keyswitch_coeff_xla(
+                    ctx, c, ks_b, ks_a)[0])
+                ks_p = jax.jit(lambda c: pallas_ntt.keyswitch_fused_pallas(
+                    nttc, c, ks_b, ks_a,
+                    digit_bits=ctx.ksk_digit_bits,
+                    num_digits=ctx.ksk_num_digits)[0])
+                t_kx = _time(ks_x, a, reps=5)
+                t_kp = _time(ks_p, a, reps=5 if on_tpu else 1)
+                try:
+                    # ONE jitted evaluation per backend covers both
+                    # components of the parity contract (c0 AND c1).
+                    full_x = jax.jit(lambda c: ops_mod._keyswitch_coeff_xla(
+                        ctx, c, ks_b, ks_a))(a)
+                    full_p = jax.jit(
+                        lambda c: pallas_ntt.keyswitch_fused_pallas(
+                            nttc, c, ks_b, ks_a,
+                            digit_bits=ctx.ksk_digit_bits,
+                            num_digits=ctx.ksk_num_digits))(a)
+                    np.testing.assert_array_equal(
+                        np.asarray(full_x[0]), np.asarray(full_p[0])
+                    )
+                    np.testing.assert_array_equal(
+                        np.asarray(full_x[1]), np.asarray(full_p[1])
+                    )
+                except AssertionError as e:
+                    print(f"KEYSWITCH PARITY MISMATCH at {shape}: {e}",
+                          file=sys.stderr)
+                    sys.exit(42)
+                ks_rows.append(
+                    (shape, t_kx * 1e3, t_kp * 1e3, t_kx / t_kp)
+                )
         # Packed-quantized parity stage (ISSUE 6, exit-42 contract): the
         # bit-interleaved payload must survive the EXACT integer encode ->
         # (both NTT backends') encrypt/decrypt cores -> exact integer
@@ -268,6 +317,19 @@ def main() -> None:
              "dec_xla_ms": round(dx, 3), "dec_pallas_ms": round(dp, 3),
              "dec_speedup": round(sd, 2)}
         )
+    ks_recs = []
+    if ks_rows:
+        print()
+        print("| keyswitch shape [B, L, N] | XLA (ms) | Pallas (ms) | "
+              "speedup |")
+        print("|---|---|---|---|")
+        for (shape, kx, kp, sk_) in ks_rows:
+            print(f"| {list(shape)} | {kx:.3f} | {kp:.3f} | {sk_:.2f}x |")
+            ks_recs.append(
+                {"shape": list(shape), "keyswitch_xla_ms": round(kx, 3),
+                 "keyswitch_pallas_ms": round(kp, 3),
+                 "keyswitch_speedup": round(sk_, 2)}
+            )
     import json
 
     with open("ntt_bench.json", "w") as f:
@@ -275,14 +337,17 @@ def main() -> None:
             {"device": getattr(dev, "device_kind", str(dev)),
              "backend": jax.default_backend(),
              "pallas_mode": "compiled" if on_tpu else "interpreted",
-             "parity": "bit-exact fwd+inv+enc+dec at all shapes",
+             "parity": "bit-exact fwd+inv+enc+dec at all shapes"
+                       " + fused keyswitch (c0 AND c1) at the gadget shape",
              "timing_method": "device-side fori_loop rep chain "
                               "(one dispatch amortized over all reps)",
-             "rows": recs},
+             "rows": recs,
+             "keyswitch_rows": ks_recs},
             f, indent=2,
         )
     print("parity: bit-exact fwd/inv/fused-enc/fused-dec across backends "
-          "at all shapes; rows saved to ntt_bench.json",
+          "at all shapes + fused keyswitch at the gadget shape; rows "
+          "saved to ntt_bench.json",
           file=sys.stderr)
 
 

@@ -29,6 +29,7 @@ from hefl_tpu.analysis.ranges import (
     FoldCertificate,
     InferenceCertificate,
     Interval,
+    KeyswitchCertificate,
     LoopReport,
     PackingCertificate,
     RangeFinding,
@@ -37,6 +38,7 @@ from hefl_tpu.analysis.ranges import (
     certify_aggregation,
     certify_fold_inductive,
     certify_inference,
+    certify_keyswitch,
     certify_packing,
     certify_transciphering,
     eval_jaxpr_ranges,
@@ -166,14 +168,17 @@ def check_experiment(cfg, ctx=None, say=None):
 
 def check_inference(ctx, say=None):
     """Pre-flight static analysis of one encrypted-inference serving
-    context (ISSUE 12) — the serving twin of :func:`check_experiment`.
+    context (ISSUE 12/13) — the serving twin of :func:`check_experiment`.
 
     Certifies the rotate-and-sum Galois ladder (`certify_inference`) at
     the context's ring geometry — carried residues canonical at any
     ladder depth, gadget digit x key products inside the 2**62 wall —
-    publishes the same `analysis.violations` counter and `analysis_check`
-    event training runs embed, and raises :class:`AnalysisError` naming
-    the offending op on any violation. -> {"inference": certificate}.
+    AND the standalone key-switch gadget contract (`certify_keyswitch`,
+    the fused kernel's digit bounds / Montgomery accumulation headroom /
+    canonical output proof). Publishes the same `analysis.violations`
+    counter and `analysis_check` event training runs embed, and raises
+    :class:`AnalysisError` naming the offending op on any violation.
+    -> {"inference": certificate, "keyswitch": certificate}.
     """
     import numpy as np
 
@@ -181,23 +186,29 @@ def check_inference(ctx, say=None):
     from hefl_tpu.obs import metrics as obs_metrics
 
     max_prime = int(np.asarray(ctx.ntt.p).max())
-    cert = certify_inference(
-        max_prime, int(ctx.ksk_digit_bits), int(ctx.ksk_num_digits)
-    )
-    violations = len(cert.findings)
+    certs = [
+        certify_inference(
+            max_prime, int(ctx.ksk_digit_bits), int(ctx.ksk_num_digits)
+        ),
+        certify_keyswitch(
+            max_prime, int(ctx.ksk_digit_bits), int(ctx.ksk_num_digits)
+        ),
+    ]
+    violations = sum(len(c.findings) for c in certs)
     obs_metrics.counter("analysis.violations").inc(violations)
     obs_events.emit(
         "analysis_check",
         violations=violations,
-        certified=[cert.summary()],
+        certified=[c.summary() for c in certs],
     )
     if violations:
+        bad = next(c for c in certs if not c.ok)
         raise AnalysisError(
-            f"static analysis rejected this serving ring — {cert.summary()}"
+            f"static analysis rejected this serving ring — {bad.summary()}"
         )
     if say is not None:
-        say(f"analysis: {cert.summary()}")
-    return {"inference": cert}
+        say(f"analysis: {'; '.join(c.summary() for c in certs)}")
+    return {"inference": certs[0], "keyswitch": certs[1]}
 
 
 __all__ = [
@@ -214,11 +225,13 @@ __all__ = [
     "AggregationCertificate",
     "FoldCertificate",
     "InferenceCertificate",
+    "KeyswitchCertificate",
     "TranscipherCertificate",
     "certify_packing",
     "certify_aggregation",
     "certify_fold_inductive",
     "certify_inference",
+    "certify_keyswitch",
     "certify_transciphering",
     "certified_max_interleave",
     "eval_jaxpr_ranges",

@@ -266,15 +266,22 @@ def check_hhe_coverage() -> list[LintFinding]:
 
 
 def check_inference_coverage() -> list[LintFinding]:
-    """The encrypted-inference SERVING program (ISSUE 12): the compiled
-    linear scorer — ct x plaintext multiply, the scanned rotate-and-sum
-    Galois ladder, bias add — at both layers, with the serving leaf set
-    (GEMM/conv plus GATHER: the automorphism is the ladder's dominant
-    data movement, and a refactor that hoists it out of its
-    `hefl.serve_rotate` scope must fail here). The scan call itself stays
-    a scope-less container per the obs.scopes annotation rule; the leaf
-    ops INSIDE the loop body attribute through the threaded name-stack
-    prefix."""
+    """The encrypted-inference SERVING programs (ISSUE 12/13): the
+    compiled ladder scorer AND the BSGS scorer — ct x plaintext multiply,
+    the scanned rotation sweeps, bias add — at both layers, with the
+    serving leaf set (GEMM/conv plus GATHER: the automorphism is the
+    sweeps' dominant data movement, and a refactor that hoists it out of
+    its `hefl.serve_rotate` scope must fail here). The scan calls stay
+    scope-less containers per the obs.scopes annotation rule; leaf ops
+    INSIDE the loop bodies attribute through the threaded name-stack
+    prefix.
+
+    On top of the leaf rule, both serving programs must RETAIN the
+    `hefl.serve_keyswitch` scope in their compiled HLO: the key-switch
+    region is pure Montgomery pointwise math (or one fused Pallas custom
+    call) with no gather/dot leaf, so the leaf rule alone cannot see it —
+    the presence check is what guarantees trace attribution sees the
+    kernel as a first-class phase."""
     import numpy as np
 
     import jax
@@ -282,6 +289,8 @@ def check_inference_coverage() -> list[LintFinding]:
     from hefl_tpu import he_inference as hei
     from hefl_tpu.ckks import encoding
     from hefl_tpu.ckks.keys import CkksContext, keygen
+    from hefl_tpu.obs import scopes as obs_scopes
+    from hefl_tpu.obs.trace import metadata_preserving_compile
 
     ctx = CkksContext.create(n=256)
     sk, pk = keygen(ctx, jax.random.key(0))
@@ -295,12 +304,55 @@ def check_inference_coverage() -> list[LintFinding]:
         ctx, pk, rng.normal(0, 0.5, (d,)), jax.random.key(2)
     )
     fn = hei._linear_program(ctx, scorer.pt_scale)
-    return check_fn_coverage(
-        fn, (ct_x, scorer._w_res, scorer._b_res, scorer._ladder),
-        "he_inference.serve[linear]",
-        leaf_prims=INFERENCE_LEAF_PRIMS,
-        leaf_opcodes=INFERENCE_LEAF_OPCODES,
+    ladder_args = (ct_x, scorer._w_res, scorer._b_res, scorer._ladder)
+
+    # BSGS serving program (ISSUE 13) — small d keeps the key bundle and
+    # the gate cheap while exercising every sweep (babies + giants).
+    d_bsgs, num_k = 16, 2
+    plan = hei.bsgs_plan(encoding.num_slots(ctx.ntt), d_bsgs, num_k)
+    bsgs_gks = hei.gen_rotation_keys_for_steps(
+        ctx, sk, jax.random.key(3), plan.rotation_steps_needed
     )
+    bsgs = hei.BsgsLinearScorer(
+        ctx, rng.normal(0, 0.3, (num_k, d_bsgs)),
+        rng.normal(0, 0.2, (num_k,)), bsgs_gks,
+    )
+    bsgs_fn = hei._bsgs_program(ctx, bsgs.plan, bsgs.pt_scale)
+    bsgs_args = (
+        ct_x, bsgs._u_mont, bsgs._b_res, bsgs._baby_tables,
+        bsgs._giant_tables,
+    )
+
+    # Both layers per program, each compiled ONCE: the leaf rule and the
+    # scope-presence gate (serve_keyswitch is pure Montgomery pointwise
+    # math / one fused custom call — no gather/dot leaf, so only the
+    # presence check can see it) share one HLO text.
+    findings: list[LintFinding] = []
+    for name, f, args in (
+        ("he_inference.serve[linear]", fn, ladder_args),
+        ("he_inference.serve[bsgs]", bsgs_fn, bsgs_args),
+    ):
+        findings.extend(jaxpr_scope_findings(
+            jax.make_jaxpr(f)(*args), name,
+            leaf_prims=INFERENCE_LEAF_PRIMS,
+        ))
+        with metadata_preserving_compile():
+            txt = f.lower(*args).compile().as_text()
+        findings.extend(leaf_scope_findings(
+            txt, name, leaf_opcodes=INFERENCE_LEAF_OPCODES
+        ))
+        for scope in (obs_scopes.SERVE_KEYSWITCH, obs_scopes.SERVE_ROTATE,
+                      obs_scopes.SERVE_SCORE):
+            if scope not in txt:
+                findings.append(LintFinding(
+                    rule="missing-scope", where=name,
+                    message=(
+                        f"compiled serving program carries no {scope!r} "
+                        "op_name provenance — the phase would be invisible "
+                        "to trace attribution and the HLO coverage gate"
+                    ),
+                ))
+    return findings
 
 
 __all__ = [

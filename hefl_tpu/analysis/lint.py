@@ -130,6 +130,19 @@ ALLOWLIST: tuple[Allow, ...] = (
         ),
     ),
     Allow(
+        region="ckks.ops.keyswitch_gadget",
+        rule="forbidden-primitive",
+        primitive="rem",
+        reason=(
+            "keyswitch_gadget_probe (ISSUE 13) mirrors the fused "
+            "key-switch kernel's digit x key accumulation with `%` "
+            "standing in for the Montgomery REDC canonical-residue "
+            "contract — a probe traced for range analysis, never executed "
+            "on a device; the REAL key-switch (fused Pallas kernel + XLA "
+            "reference) stays division-free and is bitwise parity-tested"
+        ),
+    ),
+    Allow(
         region="*",
         rule="forbidden-primitive",
         primitive="rem",
@@ -335,15 +348,15 @@ def exact_int_regions() -> dict[str, tuple[Callable, tuple]]:
     """Every declared exact-integer region in the codebase, as the shaped
     jaxpr probes their home modules export."""
     from hefl_tpu import he_inference
-    from hefl_tpu.ckks import encoding, packing, quantize
+    from hefl_tpu.ckks import encoding, ops, packing, quantize
     from hefl_tpu.fl import secure, stream
     from hefl_tpu.hhe import cipher as hhe_cipher
     from hefl_tpu.hhe import transcipher as hhe_transcipher
     from hefl_tpu.parallel import collectives
 
     regions: dict[str, tuple[Callable, tuple]] = {}
-    for mod in (quantize, packing, encoding, secure, stream, collectives,
-                hhe_cipher, hhe_transcipher, he_inference):
+    for mod in (quantize, packing, encoding, ops, secure, stream,
+                collectives, hhe_cipher, hhe_transcipher, he_inference):
         regions.update(mod.exact_int_probes())
     return regions
 

@@ -1350,6 +1350,115 @@ def certify_inference(
 
 
 @dataclasses.dataclass(frozen=True)
+class KeyswitchCertificate:
+    """Static proof (or refutation) of one key-switch gadget geometry
+    (ISSUE 13): the fused kernel's gadget-tensor contract."""
+
+    ok: bool
+    prime_bits: int
+    digit_bits: int
+    num_digits: int
+    findings: tuple
+    checks: tuple
+
+    def summary(self) -> str:
+        head = (
+            f"keyswitch gadget p<2**{self.prime_bits} "
+            f"(w={self.digit_bits} d={self.num_digits})"
+        )
+        if self.ok:
+            return f"{head}: CERTIFIED — " + "; ".join(self.checks)
+        return f"{head}: UNSAFE — " + "; ".join(
+            str(f) for f in self.findings
+        )
+
+
+@functools.lru_cache(maxsize=64)
+def certify_keyswitch(
+    prime: int, digit_bits: int, num_digits: int
+) -> KeyswitchCertificate:
+    """Range-certify the gadget key-switch itself for one geometry — the
+    contract the fused `pallas_ntt.keyswitch_fused_pallas` kernel and the
+    XLA reference both implement (ISSUE 13, the PR-8 follow-on the
+    ROADMAP carried with the fusion item).
+
+    Traces `ckks.ops.keyswitch_gadget_probe` — digit extraction,
+    centering, the digit x key inner product over all L*d+1 gadget
+    components, and the modular tree-sum on the int64 carrier — and
+    proves, for ALL canonical inputs:
+
+      * every gadget digit stays below 2**digit_bits AND below the prime
+        (the kernel's `sub_mod` centering assumes canonical digits — a
+        digit width that can overflow the prime is refuted here);
+      * every digit x key product and Montgomery accumulation term stays
+        inside the declared 2**62 exact-integer ceiling (the REDC
+        carrier contract);
+      * the accumulated (c0, c1) correction pair re-canonicalizes at
+        every step and leaves the gadget in [0, p-1].
+
+    `certify_inference` proves the same arithmetic embedded in the
+    serving ladder's loop; this certificate is the standalone per-switch
+    proof relinearization and single rotations rest on.
+    """
+    import jax
+
+    from hefl_tpu.ckks import ops, quantize
+
+    prime = int(prime)
+    canonical = Interval(0, prime - 1)
+    wall = (1 << quantize.MAX_PACKED_BITS) - 1
+    probe, args = ops.keyswitch_gadget_probe(prime, digit_bits, num_digits)
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(probe)(*args)
+
+    res = eval_jaxpr_ranges(
+        closed,
+        [canonical, canonical, canonical],
+        ceiling=Interval(-wall, wall),
+    )
+    findings = list(res.findings)
+    checks: list[str] = []
+
+    def out_check(idx: int, bound: Interval, what: str):
+        iv = res.out_intervals[idx]
+        if iv.lo < bound.lo or iv.hi > bound.hi:
+            outvar = closed.jaxpr.outvars[idx]
+            op = "input"
+            for eqn in closed.jaxpr.eqns:
+                if outvar in eqn.outvars:
+                    op = eqn.primitive.name
+            findings.append(RangeFinding(
+                kind="output-bound", op=op, eqn_index=-1,
+                interval=iv, bound=bound,
+                message=f"{what}: `{op}` yields {iv}, outside {bound}",
+            ))
+        else:
+            checks.append(f"{what} in {iv} ⊆ {bound}")
+
+    # probe outputs: (stacked digits, c0, c1)
+    out_check(0, Interval(0, (1 << int(digit_bits)) - 1),
+              "gadget digits (base-2**w bound)")
+    out_check(0, canonical,
+              "gadget digits canonical (the kernel's sub_mod precondition)")
+    out_check(1, canonical, "accumulated c0 correction")
+    out_check(2, canonical, "accumulated c1 correction")
+    if not findings:
+        checks.append(
+            f"digit x key products inside the 2**62 wall "
+            f"(w={digit_bits}, d={num_digits})"
+        )
+
+    return KeyswitchCertificate(
+        ok=not findings,
+        prime_bits=prime.bit_length(),
+        digit_bits=int(digit_bits),
+        num_digits=int(num_digits),
+        findings=tuple(findings),
+        checks=tuple(checks),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
 class TranscipherCertificate:
     """Static proof (or refutation) of one HHE transciphering geometry."""
 
@@ -1544,11 +1653,13 @@ __all__ = [
     "AggregationCertificate",
     "FoldCertificate",
     "InferenceCertificate",
+    "KeyswitchCertificate",
     "TranscipherCertificate",
     "certify_packing",
     "certify_aggregation",
     "certify_fold_inductive",
     "certify_inference",
+    "certify_keyswitch",
     "certify_transciphering",
     "certified_max_interleave",
 ]

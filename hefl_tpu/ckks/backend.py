@@ -15,8 +15,9 @@ decision:
 
   * off-TPU, "auto" resolves to "xla" without probing — interpreted Pallas
     is a test vehicle, never a fast path;
-  * on TPU, "auto" micro-times one fused encrypt per backend at the
-    flagship row shape and persists the winner per device kind;
+  * on TPU, "auto" micro-times one fused encrypt (flagship row shape) AND
+    one fused key-switch (gadget geometry) per backend and persists the
+    combined winner per device kind, with both component timings recorded;
   * rings too small for the (>=8, 128) tile always take the XLA path,
     whatever the pin (the kernels cannot tile them).
 """
@@ -48,7 +49,14 @@ def _probe_shapes(ctx) -> tuple:
 
 
 def _autoselect(ctx) -> str:
-    """Micro-time one fused encrypt per backend on the live TPU; persist."""
+    """Micro-time one fused encrypt AND one fused key-switch per backend
+    on the live TPU; persist the combined winner.
+
+    The key-switch probe (ISSUE 13) runs at the gadget geometry the
+    serving path and relinearization actually dispatch ([L*d+1, L, N] key
+    tensors); the persisted record keeps both components so the bench
+    artifacts can show WHY a backend won, not just which.
+    """
     global _AUTO_TIMINGS_MS, _AUTO_PERSISTED
     kind = str(getattr(jax.devices()[0], "device_kind", "unknown"))
     if kind in _AUTO_CHOICE:
@@ -69,14 +77,19 @@ def _autoselect(ctx) -> str:
         # outer jit is tracing — see augment._autoselect_backend).
         b, num_l, n = _probe_shapes(ctx)
         rng = np.random.default_rng(0)
-        p_col = np.asarray(ctx.ntt.p)[:, 0][None, :, None]
-        mk = lambda: jnp.asarray(  # noqa: E731
-            (rng.integers(0, 2**31, size=(b, num_l, n), dtype=np.int64) % p_col)
+        p_col = np.asarray(ctx.ntt.p)[:, 0]
+        mk = lambda *shape: jnp.asarray(  # noqa: E731
+            (rng.integers(0, 2**31, size=shape, dtype=np.int64)
+             % p_col[(None,) * (len(shape) - 2) + (slice(None), None)])
             .astype(np.uint32)
         )
-        m, u, e0, e1 = mk(), mk(), mk(), mk()
-        bk = mk()[0]
-        ak = mk()[0]
+        m, u, e0, e1 = (mk(b, num_l, n) for _ in range(4))
+        bk = mk(num_l, n)
+        ak = mk(num_l, n)
+        num_c = num_l * ctx.ksk_num_digits + 1
+        ks_b = mk(num_c, num_l, n)
+        ks_a = mk(num_c, num_l, n)
+        coeff = mk(b, num_l, n)
         # BOTH candidates jitted: production encrypt runs inside jitted
         # round programs, so an eager per-primitive XLA op chain would time
         # dispatch overhead (~100 dispatches for the 4 stage-unrolled NTTs)
@@ -87,9 +100,28 @@ def _autoselect(ctx) -> str:
             "pallas": jax.jit(lambda mm: pallas_ntt.encrypt_fused_pallas(
                 ctx.ntt, mm, u, e0, e1, bk, ak)[0]),
         }
+        ks_cands = {
+            "xla": jax.jit(lambda cc: ops._keyswitch_coeff_xla(
+                ctx, cc, ks_b, ks_a)[0]),
+            "pallas": jax.jit(lambda cc: pallas_ntt.keyswitch_fused_pallas(
+                ctx.ntt, cc, ks_b, ks_a,
+                digit_bits=ctx.ksk_digit_bits,
+                num_digits=ctx.ksk_num_digits)[0]),
+        }
         timings = {name: steady_seconds(fn, m) for name, fn in cands.items()}
-    _AUTO_TIMINGS_MS = {k: round(v * 1e3, 3) for k, v in timings.items()}
-    winner = min(timings, key=timings.get)
+        ks_timings = {
+            name: steady_seconds(fn, coeff) for name, fn in ks_cands.items()
+        }
+    _AUTO_TIMINGS_MS = {}
+    for name in HE_BACKENDS:
+        _AUTO_TIMINGS_MS[name] = round(
+            (timings[name] + ks_timings[name]) * 1e3, 3
+        )
+        _AUTO_TIMINGS_MS[f"{name}_encrypt"] = round(timings[name] * 1e3, 3)
+        _AUTO_TIMINGS_MS[f"{name}_keyswitch"] = round(
+            ks_timings[name] * 1e3, 3
+        )
+    winner = min(HE_BACKENDS, key=lambda name: _AUTO_TIMINGS_MS[name])
     _AUTO_CHOICE[kind] = winner
     store_winner("he_backend", kind, winner, _AUTO_TIMINGS_MS)
     return winner

@@ -240,10 +240,11 @@ def ct_mul_plain_poly(ctx: CkksContext, a: Ciphertext, m_res: jax.Array, pt_scal
     )
 
 
-def _keyswitch_coeff(
+def _keyswitch_coeff_xla(
     ctx: CkksContext, coeff: jax.Array, b_mont: jax.Array, a_mont: jax.Array
 ) -> tuple[jax.Array, jax.Array]:
-    """Gadget key-switch of a COEFFICIENT-domain polynomial.
+    """Gadget key-switch of a COEFFICIENT-domain polynomial (XLA graph
+    path — the bit-exact semantics reference of the fused Pallas kernel).
 
     Decompose in the digit-refined CRT gadget base: each limb's canonical
     representative splits into base-2**w digits (w = ctx.ksk_digit_bits),
@@ -289,9 +290,52 @@ def _keyswitch_coeff(
     return c0, c1
 
 
+def _keyswitch_coeff(
+    ctx: CkksContext, coeff: jax.Array, b_mont: jax.Array, a_mont: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Backend-dispatched gadget key-switch (ISSUE 13).
+
+    On the Pallas backend (`HEFL_HE`, resolved exactly like encrypt/decrypt
+    via ckks.backend — env pin > auto, untileable rings always XLA) the
+    whole decompose -> NTT -> digit x key accumulation chain runs as ONE
+    Mosaic dispatch per (prime, ciphertext) row
+    (`pallas_ntt.keyswitch_fused_pallas`); the XLA graph stays the
+    bit-exact reference. Per-call (unstacked) key tensors only — callers
+    that batch DIFFERENT keys per row (none today) keep the XLA path.
+    """
+    from hefl_tpu.ckks.backend import resolve_he_backend
+
+    if b_mont.ndim == 3 and resolve_he_backend(ctx) == "pallas":
+        from hefl_tpu.ckks import pallas_ntt
+
+        return pallas_ntt.keyswitch_fused_pallas(
+            ctx.ntt, coeff, b_mont, a_mont,
+            digit_bits=ctx.ksk_digit_bits,
+            num_digits=ctx.ksk_num_digits,
+        )
+    return _keyswitch_coeff_xla(ctx, coeff, b_mont, a_mont)
+
+
 def _keyswitch_d2(ctx: CkksContext, d2: jax.Array, rlk: RelinKey) -> tuple[jax.Array, jax.Array]:
-    """Key-switch the degree-2 component: d2*s^2 -> ct under s."""
-    return _keyswitch_coeff(ctx, ntt_inverse(ctx.ntt, d2), rlk.b_mont, rlk.a_mont)
+    """Key-switch the degree-2 component: d2*s^2 -> ct under s.
+
+    On the Pallas backend the fused kernel runs the inverse NTT in-kernel
+    too (`eval_input=True`) — relinearization is one dispatch end-to-end.
+    """
+    from hefl_tpu.ckks.backend import resolve_he_backend
+
+    if rlk.b_mont.ndim == 3 and resolve_he_backend(ctx) == "pallas":
+        from hefl_tpu.ckks import pallas_ntt
+
+        return pallas_ntt.keyswitch_fused_pallas(
+            ctx.ntt, d2, rlk.b_mont, rlk.a_mont,
+            digit_bits=ctx.ksk_digit_bits,
+            num_digits=ctx.ksk_num_digits,
+            eval_input=True,
+        )
+    return _keyswitch_coeff_xla(
+        ctx, ntt_inverse(ctx.ntt, d2), rlk.b_mont, rlk.a_mont
+    )
 
 
 def ct_apply_galois(ctx: CkksContext, a: Ciphertext, gk: GaloisKey) -> Ciphertext:
@@ -427,3 +471,66 @@ def rescale(ctx: CkksContext, a: Ciphertext) -> tuple["CkksContext", Ciphertext]
     return sub_ctx, Ciphertext(
         c0=_drop(a.c0), c1=_drop(a.c1), scale=a.scale / p_last
     )
+
+
+# ---------------------------------------------------------------------------
+# Shaped jaxpr probe (ISSUE 13): the fused key-switch kernel's gadget-tensor
+# contract, mirrored for the static-analysis gate
+# (analysis.ranges.certify_keyswitch).
+# ---------------------------------------------------------------------------
+
+
+def keyswitch_gadget_probe(prime: int, digit_bits: int, num_digits: int):
+    """The gadget key-switch's carrier arithmetic as a traceable mirror
+    (analysis.ranges.certify_keyswitch).
+
+    Mirrors, per RNS limb, what `_keyswitch_coeff_xla` and the fused
+    `pallas_ntt.keyswitch_fused_pallas` kernel compute on the gadget
+    tensors: base-2**w digit extraction from the canonical representative,
+    digit centering, the digit x key Montgomery inner product over all
+    L*d+1 components (the constant-1 correction row consuming the last),
+    and the modular tree-sum — on the int64 carrier with `%` as the
+    allowlisted probe modulo, which is the REDC canonical-residue CONTRACT
+    (the wrapping uint32 cores are covered by the lint rules and the
+    bitwise parity tests, like every other probe in this tree). The NTT
+    between decompose and inner product is range-preserving (canonical in,
+    canonical out) and is elided, exactly as the ladder probe elides it.
+
+    Returning the raw digits lets the certificate check them against BOTH
+    the 2**w gadget bound and the canonical range [0, p-1] — the fused
+    kernel's `sub_mod` centering assumes canonical digits, so a digit
+    width that overflows the prime is refuted here, statically.
+    Trace under `jax.experimental.enable_x64()`. -> (fn, example_args).
+    """
+    p = int(prime)
+    w = int(digit_bits)
+    half = 1 << max(w - 1, 0)
+    mask = (1 << w) - 1
+    m = 4  # coefficients per probe limb; ranges are per-element anyway
+
+    def probe(coeff, key_b, key_a):
+        digits = []
+        acc0 = jnp.zeros_like(coeff)
+        acc1 = jnp.zeros_like(coeff)
+        for k in range(int(num_digits)):
+            digit = (coeff >> (w * k)) & mask
+            digits.append(digit)
+            centered = (digit + (p - half)) % p    # canonical
+            acc0 = (acc0 + centered * key_b) % p
+            acc1 = (acc1 + centered * key_a) % p
+        # The constant-1 correction digit consumes the last key row.
+        acc0 = (acc0 + key_b) % p
+        acc1 = (acc1 + key_a) % p
+        return jnp.stack(digits), acc0, acc1
+
+    z = np.zeros((m,), np.int64)
+    return probe, (z, z, z)
+
+
+def exact_int_probes() -> dict:
+    """The key-switch gadget as a declared exact-integer region
+    (analysis.lint): digit extraction, centering, and the digit x key
+    accumulation are watched by the no-float / no-stray-div rules (the
+    `%` is the allowlisted probe modulo)."""
+    fn, args = keyswitch_gadget_probe(2**27 - 39, 5, 6)
+    return {"ckks.ops.keyswitch_gadget": (fn, args)}

@@ -12,8 +12,14 @@ encrypted aggregation runs on (ISSUE 4): `encrypt_fused_pallas` runs the
 ENTIRE public-key encrypt per (prime, ciphertext) row — four forward NTTs
 (u, e0, e1, m) plus the pointwise pk·u + e + m combination — as one Mosaic
 dispatch, and `decrypt_fused_pallas` fuses c0 + c1·s with the inverse NTT
-the same way. The XLA graph path (`ops` module) stays the bit-exact
-semantics reference; both paths produce identical canonical residues.
+the same way. `keyswitch_fused_pallas` (ISSUE 13) gives the gadget
+key-switch — the engine under every rotation, relinearization, and Galois
+application — the same treatment: [optional per-limb inverse NTT] ->
+digit decompose -> centering -> per-component forward NTT -> digit x key
+Montgomery inner product, one dispatch per (prime, ciphertext) row over
+the [L*d+1, L, N] gadget tensors. The XLA graph path (`ops` module) stays
+the bit-exact semantics reference; all paths produce identical canonical
+residues.
 
 This replaces the role SEAL's hand-written C++ NTT plays for the reference
 (SURVEY.md §2.12): the hot polynomial transform as a native kernel, but
@@ -140,14 +146,14 @@ def _flat_index(shape) -> jnp.ndarray:
     return row * LANES + lane
 
 
-def _fwd_stages(x, twp_ref, tws_ref, p, logn: int):
+def _fwd_stages(x, twp_ref, tws_ref, p, logn: int, limb: int = 0):
     """All forward butterfly stages on one (S, 128) row, in-register."""
     i_flat = _flat_index(x.shape)
     n = x.shape[0] * LANES
     for s in range(logn):
         t = n >> (s + 1)
-        tw = twp_ref[0, s]
-        tw_sh = tws_ref[0, s]
+        tw = twp_ref[limb, s]
+        tw_sh = tws_ref[limb, s]
         is_lo = (i_flat & t) == 0
         v = shoup_mul(x, tw, tw_sh, p)                 # tw*hi, valid at hi slots
         lo_out = add_mod(x, _read_ahead_flat(v, t), p)
@@ -156,15 +162,15 @@ def _fwd_stages(x, twp_ref, tws_ref, p, logn: int):
     return x
 
 
-def _inv_stages(x, twp_ref, tws_ref, p, logn: int):
+def _inv_stages(x, twp_ref, tws_ref, p, logn: int, limb: int = 0):
     """All inverse butterfly stages (excl. the final N^-1 scaling)."""
     i_flat = _flat_index(x.shape)
     n = x.shape[0] * LANES
     for k in range(logn):
         s = logn - 1 - k
         t = n >> (s + 1)
-        tw = twp_ref[0, k]
-        tw_sh = tws_ref[0, k]
+        tw = twp_ref[limb, k]
+        tw_sh = tws_ref[limb, k]
         is_lo = (i_flat & t) == 0
         lo_out = add_mod(x, _read_ahead_flat(x, t), p)
         diff = sub_mod(_read_ahead_flat(x, -t), x, p)  # lo - hi, valid at hi
@@ -248,6 +254,83 @@ def _dec_kernel(
     d = add_mod(c0_ref[0, 0], mont_mul(c1_ref[0, 0], s_ref[0], p, pinv), p)
     x = _inv_stages(d, twp_ref, tws_ref, p, logn)
     o_ref[0, 0] = shoup_mul(x, ninv_ref[l, 0], ninvs_ref[l, 0], p)
+
+
+def _keyswitch_kernel(
+    p_ref, pinv_ref, ninv_ref, ninvs_ref, x_ref, bk_ref, ak_ref,
+    twf_p_ref, twf_s_ref, *rest, logn: int, num_l: int, digit_bits: int,
+    num_digits: int, eval_input: bool,
+):
+    """The whole gadget key-switch for one (output prime, ciphertext) row
+    as ONE Mosaic dispatch (ISSUE 13): [inverse NTT per limb when the
+    input is eval-domain] -> base-2**w digit decompose of every limb ->
+    digit centering -> forward NTT per gadget component -> digit x key
+    Montgomery inner product -> modular tree-sum, all in VMEM.
+
+    The decompose couples limbs (digit k of limb l is lifted to every
+    output prime), so the kernel for output prime j reads ALL `num_l`
+    coefficient rows of its ciphertext and runs the full component loop —
+    L*d forward NTTs plus the constant-1 correction row — in-register.
+    In `eval_input` mode each limb is first inverse-NTT'd under its OWN
+    prime's tables (indexed by limb, not program_id); across the L output
+    primes that work is recomputed L times, the price of keeping the
+    whole key-switch a single dispatch with no HBM round-trip.
+
+    Bitwise-exact vs `ops._keyswitch_coeff_xla`: same digit extraction,
+    same centering, same Shoup-butterfly NTT stages, same Montgomery
+    products, and modular adds are exact at every step so the
+    accumulation order cannot change the canonical result.
+    """
+    # The inverse-twiddle operands exist only in eval_input mode (the
+    # coefficient-domain path never reads them, so they are not shipped).
+    if eval_input:
+        twi_p_ref, twi_s_ref, c0_ref, c1_ref = rest
+    else:
+        c0_ref, c1_ref = rest
+    j = pl.program_id(0)
+    p = p_ref[j, 0]
+    pinv = pinv_ref[j, 0]
+    half = jnp.uint32(1 << (digit_bits - 1))
+    mask = jnp.uint32((1 << digit_bits) - 1)
+
+    # The component sweep rides nested fori_loops (limbs outer, digits
+    # inner) rather than a static unroll: the NTT stage block appears ONCE
+    # in the kernel body instead of L*d times, which is the difference
+    # between a seconds-scale and a minutes-scale kernel compile. The
+    # sequential accumulation order is identical to the XLA reference's
+    # component walk, and modular adds are exact, so the loop form cannot
+    # change the result. (No scalar div/rem: the component index is
+    # rebuilt as limb*num_digits + k from the two loop counters.)
+    def limb_body(limb, carry):
+        acc0, acc1 = carry
+        row = x_ref[0, limb]
+        if eval_input:
+            p_l = p_ref[limb, 0]
+            row = _inv_stages(row, twi_p_ref, twi_s_ref, p_l, logn, limb=limb)
+            row = shoup_mul(row, ninv_ref[limb, 0], ninvs_ref[limb, 0], p_l)
+
+        def digit_body(k, carry2):
+            a0, a1 = carry2
+            shift = (k * digit_bits).astype(jnp.uint32)
+            digit = (row >> shift) & mask
+            centered = sub_mod(digit, half, p)
+            d_eval = _fwd_stages(centered, twf_p_ref, twf_s_ref, p, logn)
+            c = limb * num_digits + k
+            t0 = mont_mul(d_eval, bk_ref[c, 0], p, pinv)
+            t1 = mont_mul(d_eval, ak_ref[c, 0], p, pinv)
+            return add_mod(a0, t0, p), add_mod(a1, t1, p)
+
+        return jax.lax.fori_loop(0, num_digits, digit_body, (acc0, acc1))
+
+    zero = jnp.zeros(x_ref.shape[2:], jnp.uint32)
+    acc0, acc1 = jax.lax.fori_loop(0, num_l, limb_body, (zero, zero))
+    # Correction row: the constant-1 digit's eval form is all-ones.
+    ones = jnp.ones_like(acc0)
+    c_last = num_l * num_digits
+    acc0 = add_mod(acc0, mont_mul(ones, bk_ref[c_last, 0], p, pinv), p)
+    acc1 = add_mod(acc1, mont_mul(ones, ak_ref[c_last, 0], p, pinv), p)
+    c0_ref[0, 0] = acc0
+    c1_ref[0, 0] = acc1
 
 
 def _resolve_interpret(interpret: bool | None) -> bool:
@@ -438,6 +521,112 @@ def encrypt_fused_pallas(
     unrow = lambda o: jnp.moveaxis(  # noqa: E731
         o.reshape(num_l, b, ctx.n), 0, 1
     ).reshape(*batch, num_l, ctx.n)
+    return unrow(c0), unrow(c1)
+
+
+def keyswitch_fused_pallas(
+    ctx: NTTContext,
+    x: jnp.ndarray,
+    b_mont: jnp.ndarray,
+    a_mont: jnp.ndarray,
+    *,
+    digit_bits: int,
+    num_digits: int,
+    eval_input: bool = False,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The gadget key-switch as ONE fused kernel dispatch (ISSUE 13).
+
+    `x` is the polynomial to switch, uint32[..., L, N] — COEFFICIENT-domain
+    canonical residues by default (the rotation path hands the
+    post-automorphism c1 over in coefficient form), or eval-domain with
+    `eval_input=True` (the relinearization path's d2), in which case the
+    per-limb inverse NTT runs inside the kernel too. `b_mont`/`a_mont` are
+    the gadget key tensors uint32[C, L, N] with C = L*num_digits + 1,
+    shared across the batch. Returns the eval-domain (c0, c1) correction
+    pair, bit-exact vs `ops._keyswitch_coeff_xla`.
+
+    This is the kernel the [18, 3, 4096] bench_ntt shape was waiting for:
+    every rotation, relinearization, and Galois application previously
+    chained ~C separate NTT/mont_mul dispatches over the gadget tensors.
+    """
+    _check_supported(ctx)
+    interpret = _resolve_interpret(interpret)
+    tabs = _tables(ctx)
+    n = ctx.n
+    s_rows = n // LANES
+    batch = x.shape[:-2]
+    num_l = x.shape[-2]
+    num_c = num_l * num_digits + 1
+    if b_mont.shape[-3] != num_c:
+        raise ValueError(
+            f"gadget key has {b_mont.shape[-3]} components, geometry "
+            f"L={num_l} d={num_digits} needs {num_c}"
+        )
+    b = 1
+    for dim in batch:
+        b *= dim
+    # Ciphertext-major input layout: each grid step needs ALL limbs of its
+    # ciphertext (the digit decompose couples limbs), so the polynomial
+    # axis leads and the whole [L, N] block rides as one VMEM window.
+    x_rows = x.reshape(b, num_l, s_rows, LANES)
+    keys = [k.reshape(num_c, num_l, s_rows, LANES) for k in (b_mont, a_mont)]
+    scalars = [
+        jnp.asarray(tabs.p), jnp.asarray(tabs.pinv_neg),
+        jnp.asarray(tabs.n_inv), jnp.asarray(tabs.n_inv_shoup),
+    ]
+    smem = lambda: pl.BlockSpec(  # noqa: E731
+        (num_l, 1), lambda l, i: (0, 0), memory_space=pltpu.SMEM
+    )
+    x_spec = pl.BlockSpec(
+        (1, num_l, s_rows, LANES), lambda l, i: (i, 0, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    key_spec = pl.BlockSpec(
+        (num_c, 1, s_rows, LANES), lambda l, i: (0, l, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    twf_spec = pl.BlockSpec(
+        (1, ctx.logn, s_rows, LANES), lambda l, i: (l, 0, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    # Inverse tables ride WHOLE (all limbs) and ONLY in eval_input mode:
+    # each limb iNTTs under its own tables whatever output prime the grid
+    # step targets; the coefficient-domain path skips the ~1 MB of VMEM.
+    twi_spec = pl.BlockSpec(
+        (num_l, ctx.logn, s_rows, LANES), lambda l, i: (0, 0, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    inv_specs = [twi_spec] * 2 if eval_input else []
+    inv_args = (
+        [jnp.asarray(tabs.tw_inv), jnp.asarray(tabs.tw_inv_shoup)]
+        if eval_input else []
+    )
+    out_spec = pl.BlockSpec(
+        (1, 1, s_rows, LANES), lambda l, i: (l, i, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    out_shape = jax.ShapeDtypeStruct((num_l, b, s_rows, LANES), jnp.uint32)
+    c0, c1 = pl.pallas_call(
+        functools.partial(
+            _keyswitch_kernel, logn=ctx.logn, num_l=num_l,
+            digit_bits=digit_bits, num_digits=num_digits,
+            eval_input=eval_input,
+        ),
+        grid=(num_l, b),
+        in_specs=[smem() for _ in scalars]
+        + [x_spec] + [key_spec] * 2 + [twf_spec] * 2 + inv_specs,
+        out_specs=(out_spec, out_spec),
+        out_shape=(out_shape, out_shape),
+        interpret=interpret,
+    )(
+        *scalars, x_rows, *keys,
+        jnp.asarray(tabs.tw_fwd), jnp.asarray(tabs.tw_fwd_shoup),
+        *inv_args,
+    )
+    unrow = lambda o: jnp.moveaxis(  # noqa: E731
+        o.reshape(num_l, b, n), 0, 1
+    ).reshape(*batch, num_l, n)
     return unrow(c0), unrow(c1)
 
 

@@ -19,11 +19,25 @@ same crypto layer as the FL training loop:
 The client decrypts num_classes scores — the server never sees features and
 the client never sees W. Every step is jit-compatible (rotation count and
 class count are static).
+
+Serving plans (ISSUE 13): the ladder above costs K x log2(slots)
+key-switches per sample. `BsgsLinearScorer` replaces it with a baby-step
+giant-step plan over the model's generalized diagonals (Halevi-Shoup):
+all K class scores ride ONE output ciphertext, the query's inverse NTT is
+hoisted out of the baby-rotation sweep, the automorphism tables and Galois
+keys for every planned step are hoisted (stacked) at build time, and the
+per-score key-switch count drops to ~2*sqrt(d + K) — independent of K.
+Batched serving (`score_many`) pads query batches to power-of-two buckets
+so any batch size hits a small set of compiled programs, each amortizing
+one fused dispatch chain (the Pallas key-switch kernel batches across the
+whole query batch) over every query in it.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import math
 
 import numpy as np
 import jax
@@ -59,6 +73,26 @@ def gen_rotation_keys(
     return keys
 
 
+def gen_rotation_keys_for_steps(
+    ctx: CkksContext, sk: SecretKey, key: jax.Array, steps
+) -> dict[int, GaloisKey]:
+    """Galois keys for an ARBITRARY set of left-rotation steps — the key
+    bundle a BSGS scoring server holds (`BsgsPlan.rotation_steps_needed`,
+    ~2*sqrt(d + K) keys vs the ladder's log2(slots); more key material is
+    the classic BSGS trade for fewer key-switches per score). Key
+    derivation folds in the STEP value, so the same (master key, step)
+    always yields the same Galois key whatever set it is generated in."""
+    out = {}
+    for step in sorted({int(s) for s in steps}):
+        if step == 0:
+            continue
+        out[step] = gen_galois_key(
+            ctx, sk, jax.random.fold_in(key, step),
+            galois.galois_elt_rotation(ctx.n, step),
+        )
+    return out
+
+
 def encrypt_features(
     ctx: CkksContext, pk: PublicKey, x: np.ndarray, key: jax.Array
 ) -> Ciphertext:
@@ -84,13 +118,25 @@ def rotate_and_sum(
     return ct
 
 
-def stack_rotation_ladder(ctx: CkksContext, gks: dict[int, GaloisKey]):
-    """Stack the ladder's per-stage automorphism tables and Galois keys
-    into scan-able arrays: -> (src i32[S, N], flip bool[S, N],
-    b_mont u32[S, C, L, N], a_mont u32[S, C, L, N]) for the S = log2(slots)
-    power-of-two stages. Key/element consistency is checked here once, so
-    the jitted program needs no per-stage validation."""
-    steps = rotation_steps(encoding.num_slots(ctx.ntt))
+def stack_rotation_steps(
+    ctx: CkksContext, gks: dict[int, GaloisKey], steps
+):
+    """Stack automorphism tables and Galois keys for an ARBITRARY rotation
+    step sequence into scan-able arrays: -> (src i32[S, N], flip
+    bool[S, N], b_mont u32[S, C, L, N], a_mont u32[S, C, L, N]). This is
+    the hoisting half of a serving plan: every per-step table lookup and
+    key/element consistency check happens HERE, once per scorer build, so
+    the jitted program sees pure data and needs no per-stage validation."""
+    steps = [int(s) for s in steps]
+    if not steps:
+        num_c = ctx.num_primes * ctx.ksk_num_digits + 1
+        zk = jnp.zeros((0, num_c, ctx.num_primes, ctx.n), jnp.uint32)
+        return (
+            jnp.zeros((0, ctx.n), jnp.int32),
+            jnp.zeros((0, ctx.n), bool),
+            zk,
+            zk,
+        )
     missing = [s for s in steps if s not in gks]
     if missing:
         raise ValueError(f"rotation keys missing for steps {missing}")
@@ -110,6 +156,14 @@ def stack_rotation_ladder(ctx: CkksContext, gks: dict[int, GaloisKey]):
         jnp.asarray(np.stack(flips)),
         jnp.stack([gks[s].b_mont for s in steps]),
         jnp.stack([gks[s].a_mont for s in steps]),
+    )
+
+
+def stack_rotation_ladder(ctx: CkksContext, gks: dict[int, GaloisKey]):
+    """The power-of-two rotate-and-sum ladder's stacked tables — the
+    classic serving plan, `stack_rotation_steps` at steps 1, 2, 4, ...."""
+    return stack_rotation_steps(
+        ctx, gks, rotation_steps(encoding.num_slots(ctx.ntt))
     )
 
 
@@ -136,11 +190,14 @@ def rotate_and_sum_scan(ctx: CkksContext, ct: Ciphertext, ladder) -> Ciphertext:
         c0, c1 = carry
         src, flip, b_mont, a_mont = inp
         # Leaf compute of the serving ladder: the stage body (inside the
-        # scan, so the loop op itself stays a scope-less container).
+        # scan, so the loop op itself stays a scope-less container). The
+        # key-switch gets its own nested scope so trace attribution and
+        # HLO coverage see the fused kernel as a first-class phase.
         with jax.named_scope(obs_scopes.SERVE_ROTATE):
             pc0 = galois.apply_automorphism(ntt_inverse(ntt, c0), p, src, flip)
             pc1 = galois.apply_automorphism(ntt_inverse(ntt, c1), p, src, flip)
-            k0, k1 = _keyswitch_coeff(ctx, pc1, b_mont, a_mont)
+            with jax.named_scope(obs_scopes.SERVE_KEYSWITCH):
+                k0, k1 = _keyswitch_coeff(ctx, pc1, b_mont, a_mont)
             rot0 = add_mod(ntt_forward(ntt, pc0), k0, p)
             return (add_mod(c0, rot0, p), add_mod(c1, k1, p)), None
 
@@ -149,18 +206,29 @@ def rotate_and_sum_scan(ctx: CkksContext, ct: Ciphertext, ladder) -> Ciphertext:
 
 
 def _linear_apply(ctx: CkksContext, pt_scale: float, ct_x: Ciphertext, w_res, b_res, ladder):
-    """Score one encrypted sample against all K classes: vmapped ct x
-    plaintext multiply + the shared scanned rotate-and-sum ladder + bias
-    add."""
+    """Score encrypted samples (any leading batch shape on the ciphertext)
+    against all K classes: broadcast ct x plaintext multiply over the K
+    axis + ONE shared scanned rotate-and-sum ladder over the whole
+    [..., K] block + bias add.
 
-    def one(w, b):
-        with jax.named_scope(obs_scopes.SERVE_SCORE):
-            ct = ops.ct_mul_plain_poly(ctx, ct_x, w, pt_scale)
-        ct = rotate_and_sum_scan(ctx, ct, ladder)   # scan call: scope-less
-        with jax.named_scope(obs_scopes.SERVE_SCORE):
-            return ops.ct_add_plain(ctx, ct, b)
-
-    return jax.vmap(one)(w_res, b_res)
+    Batching rides broadcasting, not `jax.vmap`: the ladder's key-switch
+    then reaches `ops._keyswitch_coeff` with an explicit [..., K, L, N]
+    batch, which the fused Pallas kernel flattens into its (prime, row)
+    grid — one kernel dispatch chain per stage for the entire batch."""
+    with jax.named_scope(obs_scopes.SERVE_SCORE):
+        ct = ops.ct_mul_plain_poly(
+            ctx,
+            Ciphertext(
+                c0=ct_x.c0[..., None, :, :],
+                c1=ct_x.c1[..., None, :, :],
+                scale=ct_x.scale,
+            ),
+            w_res,
+            pt_scale,
+        )
+    ct = rotate_and_sum_scan(ctx, ct, ladder)   # scan call: scope-less
+    with jax.named_scope(obs_scopes.SERVE_SCORE):
+        return ops.ct_add_plain(ctx, ct, b_res)
 
 
 @functools.lru_cache(maxsize=16)
@@ -182,13 +250,13 @@ def _linear_batch_program(ctx: CkksContext, pt_scale: float):
     """The batched-serving variant: ONE jitted program scoring a whole
     batch of encrypted samples (leading axis B on the ciphertext) — the
     throughput shape, amortizing dispatch and letting XLA tile the B×K
-    lanes together."""
+    lanes together. Same `_linear_apply` (broadcast batching handles the
+    extra axis); a separate cache entry only because the jit cache is
+    keyed per program object."""
 
     @jax.jit
     def run(ct_xs: Ciphertext, w_res, b_res, ladder):
-        return jax.vmap(
-            lambda ct: _linear_apply(ctx, pt_scale, ct, w_res, b_res, ladder)
-        )(ct_xs)
+        return _linear_apply(ctx, pt_scale, ct_xs, w_res, b_res, ladder)
 
     return run
 
@@ -342,6 +410,460 @@ def slice_secret_key(sk: SecretKey, num_primes: int) -> SecretKey:
 
 
 # ---------------------------------------------------------------------------
+# Baby-step giant-step serving (ISSUE 13): the diagonal (Halevi-Shoup)
+# linear layer — one output ciphertext for all K classes, ~2*sqrt(d + K)
+# key-switches per score instead of the ladder's K*log2(slots).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BsgsPlan:
+    """A baby-step giant-step rotation plan for one scoring geometry.
+
+    The linear layer is decomposed over generalized diagonals:
+    y = Σ_t u_t ⊙ rot(x, t) with u_t[m] = W_pad[m, (m+t) mod slots], so
+    slot m of the ONE output ciphertext holds class m's score. Only
+    t ≡ t' (mod slots) with t' in [-(K-1), d-1] has a nonzero diagonal
+    (d + K - 1 of them); writing t' = i*baby + j turns the sweep into
+    `baby` rotations of the query x (the baby steps, all of the SAME
+    ciphertext — its inverse NTT is hoisted out of the sweep) plus one
+    rotation per giant block of the cheap plaintext-multiplied partial
+    sums. Key-switches per score: (baby-1) + (#giants-1), independent of
+    the class count K — the structural win over the per-class ladder.
+
+    Plans are static, hashable jit keys; `giants` groups block indices by
+    their rotation step (i*baby mod slots — blocks sharing a step, e.g.
+    the identity pair i=0 / i*baby = -slots reachable when K nears the
+    slot count, merge their diagonal rows and rotate once). The identity
+    group rides FIRST, so the program seeds its accumulator from row 0
+    without a rotation or a step-0 Galois key.
+    """
+
+    slots: int
+    d: int
+    num_classes: int
+    baby: int                       # block size b
+    t_lo: int                       # diagonal window [t_lo, t_hi] — one
+    t_hi: int                       # residue class mod slots at most once
+    giants: tuple[tuple[int, ...], ...]  # block-index groups, one per step;
+                                    # identity (step 0) group first
+    baby_steps: tuple[int, ...]     # rotation steps 1 .. baby-1
+    giant_steps: tuple[int, ...]    # distinct nonzero steps, giants[1:]
+
+    @property
+    def num_keyswitches(self) -> int:
+        """Key-switches one score costs under this plan."""
+        return len(self.baby_steps) + len(self.giant_steps)
+
+    @property
+    def rotation_steps_needed(self) -> tuple[int, ...]:
+        """The Galois-key bundle the serving server must hold."""
+        return tuple(sorted(set(self.baby_steps) | set(self.giant_steps)))
+
+
+def ladder_keyswitches(slots: int, num_classes: int) -> int:
+    """Key-switches one score costs under the rotate-and-sum ladder —
+    the baseline `BsgsPlan.num_keyswitches` is measured against."""
+    return num_classes * len(rotation_steps(slots))
+
+
+def bsgs_plan(
+    slots: int, d: int, num_classes: int, baby: int | None = None
+) -> BsgsPlan:
+    """Plan the BSGS sweep for (slots, d features, K classes).
+
+    Any 1 <= d <= slots works — non-power-of-two feature counts simply
+    change which diagonals are nonzero, unlike the ladder, whose fold
+    depth is pinned to log2(slots) regardless of d. The default block
+    size b = round(sqrt(d + K - 1)) balances baby against giant
+    rotations; pass `baby` to override (b=1 degenerates to pure giants).
+    """
+    if not 1 <= d <= slots:
+        raise ValueError(f"need 1 <= d <= {slots} features, got {d}")
+    if not 1 <= num_classes <= slots:
+        raise ValueError(
+            f"need 1 <= num_classes <= {slots}, got {num_classes}"
+        )
+    t_lo = -(num_classes - 1)
+    # Each residue class mod `slots` may appear at most ONCE: the window
+    # [-(K-1), d-1] has d + K - 1 entries, and when that exceeds `slots`
+    # (full-width d) the wrapped classes would be double-counted — cap the
+    # window at one full cycle. The diagonal builder computes the TRUE
+    # (wrapped) diagonal of each class, so a capped window still covers
+    # every nonzero entry of W.
+    t_hi = min(d - 1, t_lo + slots - 1)
+    n_diag = t_hi - t_lo + 1
+    b = int(baby) if baby else max(1, round(math.sqrt(n_diag)))
+    # Group blocks by rotation step: blocks sharing (i*b) mod slots —
+    # the identity pair i=0 / i*b = -slots, or duplicate nonzero steps
+    # when the window spans a full block cycle — merge their diagonal
+    # rows (diagonals are disjoint residue classes, so the merge is a
+    # plain sum) and rotate once. The identity group always exists
+    # (i = 0) and seeds the accumulator without a key-switch.
+    by_step: dict[int, list[int]] = {}
+    for i in range(t_lo // b, t_hi // b + 1):
+        by_step.setdefault((i * b) % slots, []).append(i)
+    steps = [0] + sorted(s for s in by_step if s != 0)
+    return BsgsPlan(
+        slots=int(slots), d=int(d), num_classes=int(num_classes), baby=b,
+        t_lo=t_lo, t_hi=t_hi,
+        giants=tuple(tuple(by_step[s]) for s in steps),
+        baby_steps=tuple(range(1, b)),
+        giant_steps=tuple(steps[1:]),
+    )
+
+
+def _bsgs_diag_tables(
+    ctx: CkksContext, plan: BsgsPlan, weights: np.ndarray,
+    pt_scale: float, queries_per_ct: int = 1,
+):
+    """Hoisted plaintext half of the plan: the pre-rotated generalized
+    diagonals v_{i,j} = rot(u_{(i*b+j) mod s}, -i*b), slot-encoded at
+    pt_scale and lifted to eval-domain Montgomery form ->
+    uint32[G, baby, L, N]. Blocks whose t' falls outside the nonzero
+    window encode as exact zeros (they contribute nothing and keep the
+    table dense, so the device program is one scan over the baby axis).
+
+    With `queries_per_ct` = q > 1 the scoring matrix becomes
+    block-diagonal with q identical W blocks of size D = slots/q — the
+    slot-packed multi-query layout. Its generalized diagonals are the
+    D-periodic tiling of the single block's (no block ever crosses into
+    its neighbour: every in-window t satisfies |t| < D, and the crossing
+    entries are exactly the zeros of the block diagonal), so q queries
+    ride ONE ciphertext through the UNCHANGED device program — the
+    per-query key-switch count divides by q.
+    """
+    from hefl_tpu.ckks.ntt import ntt_forward, to_mont
+
+    s, b, num_k, d = plan.slots, plan.baby, plan.num_classes, plan.d
+    q = int(queries_per_ct)
+    block = s // q
+    weights = np.asarray(weights, np.float64)
+    vecs = np.zeros((len(plan.giants), b, s))
+    rows = np.arange(num_k)
+    for g_idx, group in enumerate(plan.giants):
+        for i in group:
+            for j in range(b):
+                t = i * b + j
+                if t < plan.t_lo or t > plan.t_hi:
+                    continue
+                if q == 1:
+                    # Single-query: cyclic over the whole slot ring (the
+                    # full-width d == slots window wraps legitimately).
+                    cols = (rows + t) % s
+                    sel = cols < d
+                    u = np.zeros(s)
+                    u[rows[sel]] = weights[rows[sel], cols[sel]]
+                else:
+                    # Packed: per-block coordinates, never wrapping — the
+                    # in-window t always lands inside the D-slot block.
+                    cols = rows + t
+                    sel = (cols >= 0) & (cols < d)
+                    blk = np.zeros(block)
+                    blk[rows[sel]] = weights[rows[sel], cols[sel]]
+                    u = np.tile(blk, q)
+                # host-side hoist of the giant's inverse rotation:
+                # np.roll(u, k)[m] = u[m-k] is the LEFT-rotation by -k.
+                # Blocks in one group share the step mod slots, so their
+                # rolled rows land identically aligned and sum exactly.
+                vecs[g_idx, j] += np.roll(u, i * b)
+    res = jnp.asarray(encoding.encode_slots(ctx.ntt, vecs, pt_scale))
+    return to_mont(ctx.ntt, ntt_forward(ctx.ntt, res))
+
+
+def _bsgs_apply(
+    ctx: CkksContext, plan: BsgsPlan, pt_scale: float, ct_x: Ciphertext,
+    u_mont, b_res, baby_tables, giant_tables,
+):
+    """The BSGS scoring program body (any leading batch shape on ct_x).
+
+    Three scanned sweeps, each body compiled once: baby rotations of the
+    query (inverse NTT hoisted — computed ONCE, outside the sweep),
+    the modular contraction of the pre-rotated diagonals against the
+    rotation stack, and the giant rotate-and-accumulate. All K class
+    scores land in one ciphertext at scale ct_scale * pt_scale.
+    """
+    from hefl_tpu.ckks import modular
+    from hefl_tpu.ckks.modular import add_mod
+    from hefl_tpu.ckks.ntt import ntt_forward, ntt_inverse
+    from hefl_tpu.ckks.ops import _keyswitch_coeff
+
+    ntt = ctx.ntt
+    p = jnp.asarray(ntt.p)
+    pinv = jnp.asarray(ntt.pinv_neg)
+    batch_ndim = ct_x.c0.ndim - 2
+    g_count = len(plan.giants)
+
+    def rotate(c0_coeff, c1_coeff, src, flip, b_mont, a_mont):
+        """One rotation of a COEFFICIENT-domain pair; -> eval-domain."""
+        with jax.named_scope(obs_scopes.SERVE_ROTATE):
+            pc0 = galois.apply_automorphism(c0_coeff, p, src, flip)
+            pc1 = galois.apply_automorphism(c1_coeff, p, src, flip)
+        with jax.named_scope(obs_scopes.SERVE_KEYSWITCH):
+            k0, k1 = _keyswitch_coeff(ctx, pc1, b_mont, a_mont)
+        with jax.named_scope(obs_scopes.SERVE_ROTATE):
+            return add_mod(ntt_forward(ntt, pc0), k0, p), k1
+
+    # Hoisting: ONE inverse NTT of the query feeds every baby rotation.
+    with jax.named_scope(obs_scopes.SERVE_ROTATE):
+        cc0 = ntt_inverse(ntt, ct_x.c0)
+        cc1 = ntt_inverse(ntt, ct_x.c1)
+
+    def baby_stage(carry, inp):
+        return carry, rotate(cc0, cc1, *inp)
+
+    if plan.baby_steps:
+        _, (r0, r1) = jax.lax.scan(baby_stage, 0, baby_tables)
+        rots0 = jnp.concatenate([ct_x.c0[None], r0], axis=0)
+        rots1 = jnp.concatenate([ct_x.c1[None], r1], axis=0)
+    else:
+        rots0 = ct_x.c0[None]
+        rots1 = ct_x.c1[None]
+
+    # Giant partial sums: contract the diagonal table against the baby
+    # rotation stack, mod p, scanning the baby axis (body compiled once).
+    def prod_stage(acc, inp):
+        r0, r1, u_j = inp             # r0/r1 [..., L, N]; u_j [G, L, N]
+        u_exp = u_j.reshape(
+            (g_count,) + (1,) * batch_ndim + u_j.shape[1:]
+        )
+        with jax.named_scope(obs_scopes.SERVE_SCORE):
+            s0 = add_mod(acc[0], modular.mont_mul(r0[None], u_exp, p, pinv), p)
+            s1 = add_mod(acc[1], modular.mont_mul(r1[None], u_exp, p, pinv), p)
+        return (s0, s1), None
+
+    zeros = jnp.zeros((g_count,) + ct_x.c0.shape, jnp.uint32)
+    (s0, s1), _ = jax.lax.scan(
+        prod_stage, (zeros, zeros),
+        (rots0, rots1, jnp.moveaxis(u_mont, 1, 0)),
+    )
+
+    # Giant sweep: the identity-step group seeds the accumulator (no
+    # rotation); every other group rotates by its giant step and adds.
+    y0, y1 = s0[0], s1[0]
+    if plan.giant_steps:
+
+        def giant_stage(carry, inp):
+            a0, a1 = carry
+            sg0, sg1 = inp[0], inp[1]
+            with jax.named_scope(obs_scopes.SERVE_ROTATE):
+                gc0 = ntt_inverse(ntt, sg0)
+                gc1 = ntt_inverse(ntt, sg1)
+            rr0, rr1 = rotate(gc0, gc1, *inp[2:])
+            with jax.named_scope(obs_scopes.SERVE_ROTATE):
+                return (add_mod(a0, rr0, p), add_mod(a1, rr1, p)), None
+
+        (y0, y1), _ = jax.lax.scan(
+            giant_stage, (y0, y1), (s0[1:], s1[1:]) + tuple(giant_tables)
+        )
+
+    out = Ciphertext(c0=y0, c1=y1, scale=ct_x.scale * pt_scale)
+    with jax.named_scope(obs_scopes.SERVE_SCORE):
+        return ops.ct_add_plain(ctx, out, b_res)
+
+
+@functools.lru_cache(maxsize=16)
+def _bsgs_program(ctx: CkksContext, plan: BsgsPlan, pt_scale: float):
+    """ONE jitted BSGS scoring program per (context, plan, scale) — shared
+    by every batch bucket shape through the jit shape cache."""
+
+    @jax.jit
+    def run(ct_x: Ciphertext, u_mont, b_res, baby_tables, giant_tables):
+        return _bsgs_apply(
+            ctx, plan, pt_scale, ct_x, u_mont, b_res, baby_tables,
+            giant_tables,
+        )
+
+    return run
+
+
+def serving_batch_bucket(batch: int) -> int:
+    """Next power-of-two batch bucket. `score_many` pads query batches up
+    to these, so ANY batch size hits one of log2(max_batch) compiled
+    programs instead of compiling per size (the no-new-compile guard)."""
+    return 1 << max(0, (int(batch) - 1).bit_length())
+
+
+class BsgsLinearScorer:
+    """Precompiled BSGS private-inference server for a FIXED linear model
+    (the serving default; `LinearScorer` keeps the per-class ladder as
+    the reference plan).
+
+    Everything per-model is hoisted out of the per-query path at build
+    time: the BSGS plan, the stacked automorphism tables + Galois keys
+    for every planned step, the pre-rotated diagonal encodings (host
+    FFTs), and the bias row. `score` returns ONE ciphertext carrying all
+    K class scores (slot m = class m — decrypt with
+    `decrypt_class_scores`), at plan.num_keyswitches key-switches per
+    sample vs the ladder's K*log2(slots).
+
+    `queries_per_ct` = q > 1 turns on SLOT packing (d and K must fit the
+    D = slots/q block): clients pack q feature vectors into one
+    ciphertext (`encrypt_query_block`), the diagonals tile q-fold, the
+    device program is unchanged, and one pass scores q queries — block r
+    of the output holds query r's scores at slots r*D .. r*D+K-1
+    (decrypt with `decrypt_class_scores(..., queries_per_ct=q)`). The
+    per-QUERY key-switch cost divides by q on top of the BSGS saving.
+    """
+
+    def __init__(
+        self,
+        ctx: CkksContext,
+        weights: np.ndarray,
+        bias: np.ndarray,
+        gks: dict[int, GaloisKey],
+        pt_scale: float = 2.0**14,
+        ct_scale: float | None = None,
+        baby: int | None = None,
+        queries_per_ct: int = 1,
+    ):
+        weights = np.asarray(weights, np.float64)
+        bias = np.asarray(bias, np.float64)
+        slots = encoding.num_slots(ctx.ntt)
+        q = int(queries_per_ct)
+        if q < 1 or slots % q != 0:
+            raise ValueError(
+                f"queries_per_ct must divide slots={slots}, got {q}"
+            )
+        block = slots // q
+        if weights.ndim != 2 or weights.shape[1] > block:
+            raise ValueError(
+                f"weights must be [K, d<= {block}] (slots/queries_per_ct), "
+                f"got {weights.shape}"
+            )
+        if bias.shape != (weights.shape[0],):
+            raise ValueError(
+                f"bias must be [{weights.shape[0]}], got {bias.shape}"
+            )
+        if weights.shape[0] > block:
+            raise ValueError(
+                f"{weights.shape[0]} classes exceed the {block}-slot "
+                "query block"
+            )
+        self.ctx = ctx
+        self.pt_scale = pt_scale
+        self.ct_scale = ctx.scale if ct_scale is None else ct_scale
+        self.queries_per_ct = q
+        self.num_classes, d = weights.shape
+        self.plan = bsgs_plan(slots, d, self.num_classes, baby)
+        self._baby_tables = stack_rotation_steps(
+            ctx, gks, self.plan.baby_steps
+        )
+        self._giant_tables = stack_rotation_steps(
+            ctx, gks, self.plan.giant_steps
+        )
+        self._u_mont = _bsgs_diag_tables(
+            ctx, self.plan, weights, pt_scale, q
+        )
+        bz = np.zeros(slots)
+        bz.reshape(q, block)[:, : self.num_classes] = bias
+        self._b_res = jnp.asarray(
+            encoding.encode_slots(ctx.ntt, bz, self.ct_scale * pt_scale)
+        )
+        self._run = _bsgs_program(ctx, self.plan, pt_scale)
+
+    def _check_scale(self, ct: Ciphertext) -> None:
+        if ct.scale != self.ct_scale:
+            raise ValueError(
+                f"scorer was built for ct scale {self.ct_scale}, got "
+                f"{ct.scale}"
+            )
+
+    def score(self, ct_x: Ciphertext) -> Ciphertext:
+        """All K class scores of one sample as ONE ciphertext."""
+        self._check_scale(ct_x)
+        if ct_x.c0.ndim != 2:
+            raise ValueError(
+                f"score takes one sample [L, N], got {ct_x.c0.shape}; "
+                "use score_many for a batch"
+            )
+        return self._run(
+            ct_x, self._u_mont, self._b_res, self._baby_tables,
+            self._giant_tables,
+        )
+
+    def score_many(self, ct_xs: Ciphertext) -> Ciphertext:
+        """Score a whole batch [B, L, N] -> [B] score ciphertexts in one
+        device dispatch. The batch is padded to the next power-of-two
+        bucket (`serving_batch_bucket`) so arbitrary sizes reuse a small
+        set of compiled programs; pad rows are zero ciphertexts and are
+        sliced away before returning."""
+        self._check_scale(ct_xs)
+        if ct_xs.c0.ndim != 3:
+            raise ValueError(
+                f"score_many needs a batched ciphertext [B, L, N], got "
+                f"limbs of shape {ct_xs.c0.shape}; use score() for a "
+                "single sample"
+            )
+        batch = ct_xs.c0.shape[0]
+        bucket = serving_batch_bucket(batch)
+        if bucket != batch:
+            pad = ((0, bucket - batch), (0, 0), (0, 0))
+            ct_xs = Ciphertext(
+                c0=jnp.pad(ct_xs.c0, pad), c1=jnp.pad(ct_xs.c1, pad),
+                scale=ct_xs.scale,
+            )
+        out = self._run(
+            ct_xs, self._u_mont, self._b_res, self._baby_tables,
+            self._giant_tables,
+        )
+        if bucket != batch:
+            out = Ciphertext(
+                c0=out.c0[:batch], c1=out.c1[:batch], scale=out.scale
+            )
+        return out
+
+
+def encrypt_query_block(
+    ctx: CkksContext,
+    pk: PublicKey,
+    xs: np.ndarray,
+    key: jax.Array,
+    queries_per_ct: int,
+) -> Ciphertext:
+    """Client-side slot packing for multi-query serving: feature vectors
+    [..., q, d] -> one ciphertext per leading index, query r in slots
+    [r*D, r*D + d) with D = slots/q. Short batches (fewer than q queries)
+    zero-pad; their score blocks decrypt to the bias alone."""
+    slots = encoding.num_slots(ctx.ntt)
+    q = int(queries_per_ct)
+    if q < 1 or slots % q != 0:
+        raise ValueError(f"queries_per_ct must divide slots={slots}, got {q}")
+    block = slots // q
+    xs = np.asarray(xs, np.float64)
+    if xs.ndim < 2 or xs.shape[-2] > q or xs.shape[-1] > block:
+        raise ValueError(
+            f"query block must be [..., <= {q}, <= {block}], got {xs.shape}"
+        )
+    z = np.zeros(xs.shape[:-2] + (q, block), np.float64)
+    z[..., : xs.shape[-2], : xs.shape[-1]] = xs
+    z = z.reshape(xs.shape[:-2] + (slots,))
+    res = encoding.encode_slots(ctx.ntt, z, ctx.scale)
+    return ops.encrypt(ctx, pk, jnp.asarray(res), key)
+
+
+def decrypt_class_scores(
+    ctx: CkksContext,
+    sk: SecretKey,
+    ct: Ciphertext,
+    num_classes: int,
+    queries_per_ct: int = 1,
+) -> np.ndarray:
+    """Owner-side decrypt of a BSGS score ciphertext (batched leading
+    axes fine): slots 0..K-1 -> real scores [..., K] in one decrypt.
+    With `queries_per_ct` = q > 1 (slot-packed serving) each D-slot block
+    carries one query's scores -> [..., q, K]."""
+    res = np.asarray(ops.decrypt(ctx, sk, ct))
+    z = encoding.decode_slots(ctx.ntt, res, ct.scale)
+    q = int(queries_per_ct)
+    if q == 1:
+        return np.real(z[..., :num_classes])
+    block = z.shape[-1] // q
+    z = z.reshape(z.shape[:-1] + (q, block))
+    return np.real(z[..., :num_classes])
+
+
+# ---------------------------------------------------------------------------
 # Shaped jaxpr probes (ISSUE 12): the static-analysis gate, extended to the
 # serving side — `analysis.ranges.certify_inference` proves the
 # rotate-and-sum ladder's integer invariants over this mirror.
@@ -456,14 +978,17 @@ def _sliced_context(ctx: CkksContext) -> CkksContext:
 
 
 def _mlp_tail_apply(ctx: CkksContext, pt_scale: float, rescales: int, h, rlk, w2m, b2e):
-    """Everything after the hidden linear layer, for one sample:
-    square activation (batched ct×ct + relin), `rescales` rescale stages,
-    and the full output layer scores_k = Σ_j w2[k,j]·h²_j + b2[k].
+    """Everything after the hidden linear layer (any leading batch shape on
+    the [..., H, L, N] hidden ciphertext): square activation (batched
+    ct×ct + relin), `rescales` rescale stages, and the full output layer
+    scores_k = Σ_j w2[k,j]·h²_j + b2[k].
 
     The output layer exploits that each h²_j already holds its value in
     every slot: multiplying by the CONSTANT w2[k,j] is a Montgomery
     pointwise multiply by the broadcast eval-domain constant — no NTT, no
     rotation — and the Σ_j is a modular contraction over the hidden axis.
+    Batching is broadcast, not `jax.vmap`, so the relinearization's
+    key-switch sees its explicit batch (fused-kernel friendly).
     """
     from hefl_tpu.ckks import modular
 
@@ -474,13 +999,14 @@ def _mlp_tail_apply(ctx: CkksContext, pt_scale: float, rescales: int, h, rlk, w2
             cur, sq = ops.rescale(cur, sq)
         p = jnp.asarray(cur.ntt.p)
         pinv = jnp.asarray(cur.ntt.pinv_neg)
-        # [K,H,L,1] consts × [1,H,L,N] limbs → [K,H,L,N], contract H mod p.
-        t0 = modular.mont_mul(sq.c0[None], w2m, p, pinv)
-        t1 = modular.mont_mul(sq.c1[None], w2m, p, pinv)
-        c0, c1 = t0[:, 0], t1[:, 0]
-        for j in range(1, t0.shape[1]):    # static H: unrolled modular sum
-            c0 = modular.add_mod(c0, t0[:, j], p)
-            c1 = modular.add_mod(c1, t1[:, j], p)
+        # [K,H,L,1] consts × [..., 1,H,L,N] limbs → [..., K,H,L,N],
+        # contract the H axis (-3) mod p.
+        t0 = modular.mont_mul(sq.c0[..., None, :, :, :], w2m, p, pinv)
+        t1 = modular.mont_mul(sq.c1[..., None, :, :, :], w2m, p, pinv)
+        c0, c1 = t0[..., 0, :, :], t1[..., 0, :, :]
+        for j in range(1, t0.shape[-3]):   # static H: unrolled modular sum
+            c0 = modular.add_mod(c0, t0[..., j, :, :], p)
+            c1 = modular.add_mod(c1, t1[..., j, :, :], p)
         c0 = modular.add_mod(c0, jnp.broadcast_to(b2e, c0.shape), p)
     return Ciphertext(c0=c0, c1=c1, scale=sq.scale * pt_scale)
 
@@ -501,13 +1027,11 @@ def _mlp_tail_program(ctx: CkksContext, pt_scale: float, rescales: int):
 @functools.lru_cache(maxsize=16)
 def _mlp_tail_batch_program(ctx: CkksContext, pt_scale: float, rescales: int):
     """Batched-serving MLP tail: one jitted program over a whole batch of
-    hidden-layer ciphertexts (leading axis B)."""
+    hidden-layer ciphertexts (leading axis B, broadcast batching)."""
 
     @jax.jit
     def run(hs: Ciphertext, rlk, w2m, b2e):
-        return jax.vmap(
-            lambda h: _mlp_tail_apply(ctx, pt_scale, rescales, h, rlk, w2m, b2e)
-        )(hs)
+        return _mlp_tail_apply(ctx, pt_scale, rescales, hs, rlk, w2m, b2e)
 
     return run
 

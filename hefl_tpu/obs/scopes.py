@@ -40,7 +40,8 @@ AGGREGATE = "hefl.aggregate"          # plaintext (masked) FedAvg mean + pmean
 DECRYPT = "hefl.decrypt"              # c0 + c1*s, iNTT, decode, unpack
 EVALUATE = "hefl.evaluate"            # test-set forward + softmax
 SERVE_SCORE = "hefl.serve_score"      # inference ct x plain mul + bias
-SERVE_ROTATE = "hefl.serve_rotate"    # rotate-and-sum ladder stage body
+SERVE_ROTATE = "hefl.serve_rotate"    # rotation sweep bodies (ladder/BSGS)
+SERVE_KEYSWITCH = "hefl.serve_keyswitch"  # gadget key-switch (fused kernel)
 
 # HOST-side spans (jax.profiler.TraceAnnotation, not named_scope): driver
 # work that owns wall-clock but runs no device ops. The trace parser
@@ -64,6 +65,7 @@ PHASES = (
     EVALUATE,
     SERVE_SCORE,
     SERVE_ROTATE,
+    SERVE_KEYSWITCH,
 )
 
 

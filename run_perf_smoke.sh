@@ -50,7 +50,13 @@
 #       rings' rotate-and-sum ladders certify (canonical carries at any
 #       ladder depth, gadget products inside the 2**62 wall) and the
 #       bench's analysis_check row must report violations = 0, the same
-#       analysis.violations evidence training artifacts embed.
+#       analysis.violations evidence training artifacts embed;
+#   (m) serving throughput (ISSUE 13): the BENCH_INFER artifact must
+#       carry the QPS + latency-percentile schema (p50/p95/p99) on every
+#       row, the certify_keyswitch gadget certificates alongside the
+#       ladder ones, the he_backend record, and a batched-vs-single
+#       serving speedup (slot-packed + ct-batched BSGS vs single-query)
+#       clearing the >= 1.3x floor on the CPU smoke.
 # Wired into run_tpu_suite.sh as stage 0 (cheap pre-stage, no backend
 # probe needed — both harnesses pin themselves to CPU in smoke mode).
 set -euo pipefail
@@ -93,53 +99,82 @@ JAX_PLATFORMS=cpu python -m hefl_tpu.analysis --fast --json \
   exit 1
 }
 
-# (l) encrypted-inference certification (ISSUE 12): the serving bench at
-# smoke geometry with the certify_inference pre-flight; the analysis_check
-# row must be present with 0 violations (and the scoring rows sane).
-INFERENCE_SMOKE=1 INFERENCE_REPS=2 JAX_PLATFORMS=cpu \
+# (l)+(m) encrypted-inference certification + serving throughput
+# (ISSUE 12/13): the serving bench at smoke geometry with the
+# certify_inference + certify_keyswitch pre-flight; the BENCH_INFER
+# artifact must carry the QPS/percentile schema, 0 violations, the
+# keyswitch gadget certificates, and the >= 1.3x batched-vs-single floor.
+INFERENCE_SMOKE=1 INFERENCE_REPS=3 JAX_PLATFORMS=cpu \
+BENCH_INFER_PATH="$workdir/BENCH_INFER.json" \
 python bench_inference.py > "$workdir/inference_smoke.out" || {
-  echo "PERF SMOKE FAILED: bench_inference (certify_inference pre-flight):"
+  echo "PERF SMOKE FAILED: bench_inference (serving pre-flight):"
   tail -20 "$workdir/inference_smoke.out"
   exit 1
 }
-python - "$workdir/inference_smoke.out" <<'PY'
+python - "$workdir/BENCH_INFER.json" <<'PY'
 import json
 import sys
 
 fail = []
-rows = []
-for line in open(sys.argv[1]):
-    line = line.strip()
-    if line.startswith("{"):
-        try:
-            rows.append(json.loads(line))
-        except ValueError:
-            continue
-check = [r for r in rows if r.get("row") == "analysis_check"]
-score = [r for r in rows if r.get("row") != "analysis_check"]
-if not check:
-    fail.append("bench_inference: no analysis_check row (certify_inference "
-                "pre-flight not wired)")
-else:
-    if check[-1].get("violations") != 0:
-        fail.append(
-            f"bench_inference: analysis.violations = "
-            f"{check[-1].get('violations')} on the smoke serving rings"
-        )
-    certs = check[-1].get("certified") or []
-    if len(certs) < 2 or not all("CERTIFIED" in c for c in certs):
-        fail.append(f"bench_inference: expected 2 CERTIFIED serving-ring "
-                    f"summaries, got {certs}")
-if len(score) < 2 or not all(r.get("argmax_ok") for r in score):
-    fail.append(f"bench_inference: scoring rows missing/!argmax_ok: {score}")
+try:
+    art = json.load(open(sys.argv[1]))
+except (OSError, ValueError) as e:
+    print(f"PERF SMOKE FAILED: BENCH_INFER artifact unreadable: {e}")
+    sys.exit(1)
+
+rows = art.get("rows") or []
+if len(rows) < 5:
+    fail.append(f"BENCH_INFER: expected >= 5 serving rows, got {len(rows)}")
+for r in rows:
+    for field in ("plan", "batch", "keyswitches_per_score", "p50_ms",
+                  "p95_ms", "p99_ms", "qps", "max_abs_err", "argmax_ok"):
+        if r.get(field) is None:
+            fail.append(f"BENCH_INFER row {r.get('row')}: missing {field}")
+    if r.get("argmax_ok") is not True:
+        fail.append(f"BENCH_INFER row {r.get('row')}: argmax_ok false")
+plans = {r.get("plan") for r in rows}
+if not {"ladder", "bsgs", "mlp"} <= plans:
+    fail.append(f"BENCH_INFER: plans {plans} missing ladder/bsgs/mlp rows")
+
+check = art.get("analysis_check") or {}
+if check.get("violations") != 0:
+    fail.append(
+        f"BENCH_INFER: analysis.violations = {check.get('violations')} "
+        "on the smoke serving rings"
+    )
+certs = check.get("certified") or []
+if len(certs) < 4 or not all("CERTIFIED" in c for c in certs):
+    fail.append(
+        f"BENCH_INFER: expected 4 CERTIFIED summaries (ladder + keyswitch "
+        f"gadget per serving ring), got {len(certs)}"
+    )
+if not any("keyswitch gadget" in c for c in certs):
+    fail.append("BENCH_INFER: no certify_keyswitch gadget certificate")
+
+if not isinstance(art.get("he_backend"), dict):
+    fail.append("BENCH_INFER: missing he_backend record")
+
+bvs = art.get("batched_vs_single") or {}
+speedup = bvs.get("speedup")
+if not isinstance(speedup, (int, float)):
+    fail.append("BENCH_INFER: missing batched_vs_single.speedup")
+elif speedup < 1.3:
+    fail.append(
+        f"BENCH_INFER: batched-vs-single serving speedup {speedup}x is "
+        "below the 1.3x floor (slot packing + ct batching should amortize "
+        "far more than this)"
+    )
+
 if fail:
     print("PERF SMOKE FAILED (inference stage):")
     for f in fail:
         print(" -", f)
     sys.exit(1)
-print(f"inference smoke OK: {len(score)} scoring rows, "
-      f"{len(check[-1]['certified'])} serving rings certified, "
-      "analysis.violations=0")
+print(
+    f"inference smoke OK: {len(rows)} serving rows with QPS/p50/p95/p99, "
+    f"{len(certs)} certificates (ladder + keyswitch gadget per ring), "
+    f"analysis.violations=0, batched-vs-single {speedup}x (>= 1.3x)"
+)
 PY
 
 # (k) hybrid-HE uplink (ISSUE 11): wire expansion <= 1.1x + the

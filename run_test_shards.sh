@@ -78,6 +78,18 @@ t0=$SECONDS
 HEFL_NTT=pallas-interpret python -m pytest -q -m "not slow" \
   tests/test_hhe.py
 echo "== hhe shard (pallas-interpret): $((SECONDS - t0))s"
+# Serving shard (ISSUE 13): the encrypted-inference suite — ladder + BSGS
+# plan parity, slot-packed multi-query serving, the batched no-new-compile
+# bucket guard — run under the Pallas-interpret NTT selector with the HE
+# dispatch pinned to pallas, so the serving programs exercise the
+# keyswitch dispatch family (fused kernel on tileable rings, documented
+# XLA fallback on the small test rings) alongside the fast tier's XLA
+# default. The file lives in the slow tier, so this shard runs it
+# explicitly, without the marker filter.
+t0=$SECONDS
+HEFL_NTT=pallas-interpret HEFL_HE=pallas python -m pytest -q \
+  tests/test_he_inference.py
+echo "== serving shard (pallas-interpret, HEFL_HE=pallas): $((SECONDS - t0))s"
 # Journal/durability shard (ISSUE 9): the write-ahead-journal suite —
 # frame codec, torn-tail/chain-break handling, the kill-at-every-boundary
 # recovery matrix — re-run under fsync policy "always", so the maximum-

@@ -72,6 +72,9 @@ _SLOW_PATTERNS = (
     "test_pallas_ntt.py::test_forward_parity",
     "test_pallas_he.py::test_fused_encrypt_parity_production",
     "test_pallas_he.py::test_fused_decrypt_parity_production",
+    "test_pallas_he.py::test_fused_keyswitch_parity_production",
+    "test_pallas_he.py::test_fused_keyswitch_eval_input_parity",
+    "test_pallas_he.py::test_keyswitch_backend_dispatch",
     "test_ntt.py::test_roundtrip_full_size",
     "test_entry.py::test_dryrun",
     "test_experiment.py::test_encrypted_experiment",

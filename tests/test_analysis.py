@@ -439,7 +439,47 @@ def test_check_inference_registers_violations(ring):
     base = obs_metrics.snapshot().get("analysis.violations", 0)
     report = check_inference(ring)
     assert report["inference"].ok
+    assert report["keyswitch"].ok
     assert obs_metrics.snapshot()["analysis.violations"] == base
+
+
+# ------------------------------------------------ keyswitch certification
+
+
+def test_keyswitch_certified_at_production_geometry():
+    from hefl_tpu.analysis import certify_keyswitch
+
+    cert = certify_keyswitch(2**27 - 39, 5, 6)
+    assert cert.ok, cert.summary()
+    assert any("base-2**w" in c for c in cert.checks)
+    assert any("sub_mod precondition" in c for c in cert.checks)
+    assert any("2**62 wall" in c for c in cert.checks)
+
+
+def test_keyswitch_rejects_digit_width_overflowing_prime():
+    """A centering offset 2**(w-1) past the prime breaks the kernel's
+    sub_mod precondition — the accumulated correction pair escapes the
+    canonical range. Refuted statically, before any ciphertext is ever
+    switched under such a geometry."""
+    from hefl_tpu.analysis import certify_keyswitch
+
+    cert = certify_keyswitch(2**27 - 39, 31, 1)
+    assert not cert.ok
+    assert any(
+        f.kind == "output-bound" and "accumulated" in str(f)
+        for f in cert.findings
+    )
+
+
+def test_keyswitch_rejects_oversized_prime_citing_op():
+    """Past 2**31 the digit x key product escapes the 2**62 ceiling."""
+    from hefl_tpu.analysis import certify_keyswitch
+
+    cert = certify_keyswitch((1 << 32) + 15, 9, 4)
+    assert not cert.ok
+    assert any(
+        f.kind == "ceiling" and f.op == "mul" for f in cert.findings
+    )
 
 
 def test_serving_ladder_program_loops_reach_fixpoint(ring):

@@ -115,6 +115,201 @@ def test_score_many_matches_per_sample(setup):
         np.testing.assert_allclose(got[i], one, atol=0.1)
 
 
+def test_bsgs_plan_shape():
+    plan = hei.bsgs_plan(128, 100, 10)
+    # diagonals window [-(K-1), d-1], block size ~sqrt(d+K-1)
+    assert (plan.t_lo, plan.t_hi) == (-9, 99)
+    assert plan.baby_steps == tuple(range(1, plan.baby))
+    assert 0 not in plan.giant_steps
+    assert plan.giants[0] == (0,)
+    # fewer key-switches per score than the per-class ladder — the
+    # structural claim of the BSGS serving plan
+    assert plan.num_keyswitches < hei.ladder_keyswitches(128, 10)
+    # full-width window caps at one cycle of residue classes (no diagonal
+    # double-counted)
+    full = hei.bsgs_plan(128, 128, 10)
+    assert full.t_hi - full.t_lo + 1 == 128
+    with pytest.raises(ValueError, match="features"):
+        hei.bsgs_plan(128, 129, 10)
+    # a giant block whose step wraps to 0 mod slots (K near the slot
+    # count) is an identity rotation: merged into the seed group, never
+    # emitted as a giant step needing a step-0 Galois key
+    wrap = hei.bsgs_plan(128, 2, 128, baby=8)
+    assert len(wrap.giants[0]) == 2
+    assert 0 not in wrap.giant_steps
+    assert all((i * wrap.baby) % 128 == 0 for i in wrap.giants[0])
+    # blocks sharing a nonzero step merge too: every giant step is
+    # distinct, so no score pays a redundant rotation + key-switch
+    dup = hei.bsgs_plan(128, 122, 4, baby=8)
+    assert len(set(dup.giant_steps)) == len(dup.giant_steps)
+    assert any(len(g) > 1 for g in dup.giants)
+
+
+def test_bsgs_identity_giant_scores_correctly(setup):
+    # End-to-end at a geometry where i*baby wraps to 0 mod slots: the
+    # identity block folds into the seed and scores stay exact.
+    ctx, sk, pk, gks = setup
+    rng = np.random.default_rng(12)
+    d, num_classes, baby = 8, 121, 16
+    plan = hei.bsgs_plan(encoding.num_slots(ctx.ntt), d, num_classes, baby)
+    assert plan.giants[0] == (-8, 0)    # i=-8 (step -128 ≡ 0) merged in
+    W = rng.normal(0, 0.3, (num_classes, d))
+    b = rng.normal(0, 0.2, num_classes)
+    bsgs_gks = hei.gen_rotation_keys_for_steps(
+        ctx, sk, jax.random.key(120), plan.rotation_steps_needed
+    )
+    scorer = hei.BsgsLinearScorer(ctx, W, b, bsgs_gks, baby=baby)
+    x = rng.normal(0, 0.5, d)
+    ct = hei.encrypt_features(ctx, pk, x, jax.random.key(121))
+    got = hei.decrypt_class_scores(ctx, sk, scorer.score(ct), num_classes)
+    np.testing.assert_allclose(got, x @ W.T + b, atol=0.05)
+
+    # ...and a geometry where two ROTATED blocks share a step (merged
+    # nonzero-step group): still exact, one fewer key-switch per score.
+    d2, k2, baby2 = 122, 4, 8
+    plan2 = hei.bsgs_plan(encoding.num_slots(ctx.ntt), d2, k2, baby2)
+    assert any(len(g) > 1 for g in plan2.giants[1:])
+    W2 = rng.normal(0, 0.3, (k2, d2))
+    b2 = rng.normal(0, 0.2, k2)
+    gks2 = hei.gen_rotation_keys_for_steps(
+        ctx, sk, jax.random.key(122), plan2.rotation_steps_needed
+    )
+    scorer2 = hei.BsgsLinearScorer(ctx, W2, b2, gks2, baby=baby2)
+    x2 = rng.normal(0, 0.5, d2)
+    ct2 = hei.encrypt_features(ctx, pk, x2, jax.random.key(123))
+    got2 = hei.decrypt_class_scores(ctx, sk, scorer2.score(ct2), k2)
+    np.testing.assert_allclose(got2, x2 @ W2.T + b2, atol=0.05)
+
+
+@pytest.mark.parametrize("d,num_classes", [(37, 3), (100, 10), (128, 10)])
+def test_bsgs_matches_plaintext_and_ladder(setup, d, num_classes):
+    # The BSGS plan must reproduce the ladder's scores (both are the same
+    # inner products; only the rotation schedule — and hence the
+    # key-switch noise path — differs) at power-of-two AND
+    # non-power-of-two feature counts, including full slot width.
+    ctx, sk, pk, gks = setup
+    rng = np.random.default_rng(70 + d)
+    W = rng.normal(0, 0.3, (num_classes, d))
+    b = rng.normal(0, 0.2, num_classes)
+    plan = hei.bsgs_plan(encoding.num_slots(ctx.ntt), d, num_classes)
+    bsgs_gks = hei.gen_rotation_keys_for_steps(
+        ctx, sk, jax.random.key(71), plan.rotation_steps_needed
+    )
+    scorer = hei.BsgsLinearScorer(ctx, W, b, bsgs_gks)
+    ladder = hei.LinearScorer(ctx, W, b, gks)
+    x = rng.normal(0, 0.5, d)
+    ct = hei.encrypt_features(ctx, pk, x, jax.random.key(72))
+    got = hei.decrypt_class_scores(ctx, sk, scorer.score(ct), num_classes)
+    via_ladder = hei.decrypt_scores(ctx, sk, ladder.score(ct))
+    want = x @ W.T + b
+    np.testing.assert_allclose(got, want, atol=0.05)
+    np.testing.assert_allclose(got, via_ladder, atol=0.05)
+    assert np.argmax(got) == np.argmax(via_ladder)
+
+
+def test_bsgs_score_many_matches_single(setup):
+    ctx, sk, pk, gks = setup
+    rng = np.random.default_rng(9)
+    d, num_classes, batch = 48, 4, 3
+    W = rng.normal(0, 0.3, (num_classes, d))
+    b = rng.normal(0, 0.2, num_classes)
+    plan = hei.bsgs_plan(encoding.num_slots(ctx.ntt), d, num_classes)
+    bsgs_gks = hei.gen_rotation_keys_for_steps(
+        ctx, sk, jax.random.key(90), plan.rotation_steps_needed
+    )
+    scorer = hei.BsgsLinearScorer(ctx, W, b, bsgs_gks)
+    xs = rng.normal(0, 0.5, (batch, d))
+    ct_xs = hei.encrypt_features(ctx, pk, xs, jax.random.key(91))
+    got = hei.decrypt_class_scores(
+        ctx, sk, scorer.score_many(ct_xs), num_classes
+    )
+    assert got.shape == (batch, num_classes)
+    np.testing.assert_allclose(got, xs @ W.T + b, atol=0.05)
+    for i in range(batch):
+        ct_i = hei.Ciphertext(
+            c0=ct_xs.c0[i], c1=ct_xs.c1[i], scale=ct_xs.scale
+        )
+        one = hei.decrypt_class_scores(
+            ctx, sk, scorer.score(ct_i), num_classes
+        )
+        # identical ciphertext through the same plan: same ops, same noise
+        np.testing.assert_allclose(got[i], one, atol=1e-9)
+
+
+def test_bsgs_packed_queries_match_per_query(setup):
+    # Slot packing (batch across SLOTS): q queries per ciphertext through
+    # the UNCHANGED device program must score like q separate single-query
+    # passes — the per-query key-switch count divides by q.
+    ctx, sk, pk, gks = setup
+    rng = np.random.default_rng(10)
+    q, d, num_classes = 4, 30, 5        # D = 32 block, non-pow2 d
+    W = rng.normal(0, 0.3, (num_classes, d))
+    b = rng.normal(0, 0.2, num_classes)
+    plan = hei.bsgs_plan(encoding.num_slots(ctx.ntt), d, num_classes)
+    bsgs_gks = hei.gen_rotation_keys_for_steps(
+        ctx, sk, jax.random.key(92), plan.rotation_steps_needed
+    )
+    packed = hei.BsgsLinearScorer(
+        ctx, W, b, bsgs_gks, queries_per_ct=q
+    )
+    single = hei.BsgsLinearScorer(ctx, W, b, bsgs_gks)
+    xs = rng.normal(0, 0.5, (q, d))
+    ct = hei.encrypt_query_block(ctx, pk, xs, jax.random.key(93), q)
+    got = hei.decrypt_class_scores(
+        ctx, sk, packed.score(ct), num_classes, queries_per_ct=q
+    )
+    assert got.shape == (q, num_classes)
+    np.testing.assert_allclose(got, xs @ W.T + b, atol=0.05)
+    for r in range(q):
+        ct_r = hei.encrypt_features(ctx, pk, xs[r], jax.random.key(94 + r))
+        one = hei.decrypt_class_scores(
+            ctx, sk, single.score(ct_r), num_classes
+        )
+        np.testing.assert_allclose(got[r], one, atol=0.05)
+    # geometry guards
+    with pytest.raises(ValueError, match="slots"):
+        hei.BsgsLinearScorer(ctx, W, b, bsgs_gks, queries_per_ct=3)
+    with pytest.raises(ValueError, match="queries_per_ct"):
+        hei.BsgsLinearScorer(
+            ctx, rng.normal(0, 0.3, (num_classes, 64)), b, bsgs_gks,
+            queries_per_ct=4,
+        )
+
+
+def test_bsgs_batched_serving_never_recompiles_within_bucket(setup):
+    # The no-new-compile guard: score_many pads to power-of-two buckets,
+    # so every batch size up to a warmed bucket reuses its compiled
+    # program — serving traffic cannot trigger a recompile storm.
+    ctx, sk, pk, gks = setup
+    rng = np.random.default_rng(11)
+    d, num_classes = 16, 2
+    W = rng.normal(0, 0.3, (num_classes, d))
+    b = rng.normal(0, 0.2, num_classes)
+    plan = hei.bsgs_plan(encoding.num_slots(ctx.ntt), d, num_classes)
+    bsgs_gks = hei.gen_rotation_keys_for_steps(
+        ctx, sk, jax.random.key(95), plan.rotation_steps_needed
+    )
+    scorer = hei.BsgsLinearScorer(ctx, W, b, bsgs_gks)
+    assert hei.serving_batch_bucket(1) == 1
+    assert hei.serving_batch_bucket(3) == 4
+    assert hei.serving_batch_bucket(4) == 4
+    assert hei.serving_batch_bucket(5) == 8
+
+    def score_batch(batch, key):
+        xs = rng.normal(0, 0.5, (batch, d))
+        ct = hei.encrypt_features(ctx, pk, xs, jax.random.key(key))
+        out = scorer.score_many(ct)
+        assert out.c0.shape[0] == batch
+        return hei.decrypt_class_scores(ctx, sk, out, num_classes)
+
+    score_batch(4, 96)                   # warm the 4-bucket
+    warmed = scorer._run._cache_size()
+    score_batch(3, 97)                   # pads to 4: no new compile
+    score_batch(2, 98)                   # its own bucket: new compile ok
+    score_batch(3, 99)
+    assert scorer._run._cache_size() == warmed + 1
+
+
 def test_encrypted_mlp_matches_plaintext():
     # Depth-2 homomorphic circuit: scores = W2 (W1 x + b1)^2 + b2 under
     # encryption (square activation a la CryptoNets: ct x ct + relin, then

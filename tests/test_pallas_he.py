@@ -99,6 +99,92 @@ def test_encrypt_core_backend_dispatch(ctx1024):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def _rand_keys(ctx, seed):
+    num_c = ctx.num_primes * ctx.ksk_num_digits + 1
+    return (_rand_res(ctx, (num_c,), seed), _rand_res(ctx, (num_c,), seed + 1))
+
+
+def test_fused_keyswitch_parity_small(ctx1024):
+    # The fused gadget key-switch (ISSUE 13): digit decompose -> centering
+    # -> per-component fwd NTT -> digit x key inner product as one
+    # dispatch, bitwise vs the XLA reference — c0 AND c1.
+    ctx, _, _ = ctx1024
+    coeff = _rand_res(ctx, (3,), seed=40)
+    bk, ak = _rand_keys(ctx, 41)
+    want = ops._keyswitch_coeff_xla(ctx, coeff, bk, ak)
+    got = pallas_ntt.keyswitch_fused_pallas(
+        ctx.ntt, coeff, bk, ak,
+        digit_bits=ctx.ksk_digit_bits, num_digits=ctx.ksk_num_digits,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+
+def test_fused_keyswitch_eval_input_parity(ctx1024):
+    # Relinearization's shape: eval-domain input, the per-limb inverse NTT
+    # fused into the same dispatch (eval_input=True).
+    from hefl_tpu.ckks.ntt import ntt_inverse
+
+    ctx, _, _ = ctx1024
+    d2 = _rand_res(ctx, (2,), seed=50)
+    bk, ak = _rand_keys(ctx, 51)
+    want = ops._keyswitch_coeff_xla(ctx, ntt_inverse(ctx.ntt, d2), bk, ak)
+    got = pallas_ntt.keyswitch_fused_pallas(
+        ctx.ntt, d2, bk, ak,
+        digit_bits=ctx.ksk_digit_bits, num_digits=ctx.ksk_num_digits,
+        eval_input=True, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+
+@pytest.mark.parametrize("n_ct", [55, 18, 2])
+def test_fused_keyswitch_parity_production(ctx4096, n_ct):
+    # All three production batch shapes over the [L*d+1, L, N] gadget
+    # tensors, incl. the [18, 3, 4096] bench shape that has waited for
+    # this kernel since PR 4 — bitwise c0 AND c1.
+    ctx = ctx4096
+    coeff = _rand_res(ctx, (n_ct,), seed=60 + n_ct)
+    bk, ak = _rand_keys(ctx, 70 + n_ct)
+    want = ops._keyswitch_coeff_xla(ctx, coeff, bk, ak)
+    got = pallas_ntt.keyswitch_fused_pallas(
+        ctx.ntt, coeff, bk, ak,
+        digit_bits=ctx.ksk_digit_bits, num_digits=ctx.ksk_num_digits,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+
+def test_keyswitch_backend_dispatch(ctx1024, monkeypatch):
+    # The ops-level dispatch (ISSUE 13): with HEFL_HE=pallas pinned, the
+    # REAL rotation and relinearization entry points must route their
+    # key-switch through the fused kernel and stay bitwise-identical to
+    # the XLA pin — end-to-end through ct_rotate and ct_mul.
+    from hefl_tpu.ckks import galois
+    from hefl_tpu.ckks.keys import gen_galois_key, gen_relin_key
+
+    ctx, sk, pk = ctx1024
+    m = _rand_res(ctx, (), seed=80)[0]   # drop the broadcast-born lead axis
+    u, e0, e1 = ops.encrypt_samples(ctx, jax.random.key(81))
+    ct = ops.encrypt_core(ctx, pk, m, u, e0, e1, backend="xla")
+    gk = gen_galois_key(
+        ctx, sk, jax.random.key(82), galois.galois_elt_rotation(ctx.n, 1)
+    )
+    rlk = gen_relin_key(ctx, sk, jax.random.key(83))
+
+    monkeypatch.setattr(he_backend, "_ENV", "xla")
+    rot_x = ops.ct_rotate(ctx, ct, gk, 1)
+    mul_x = ops.ct_mul(ctx, ct, ct, rlk)
+    monkeypatch.setattr(he_backend, "_ENV", "pallas")
+    rot_p = ops.ct_rotate(ctx, ct, gk, 1)
+    mul_p = ops.ct_mul(ctx, ct, ct, rlk)
+    for a, b in ((rot_x, rot_p), (mul_x, mul_p)):
+        np.testing.assert_array_equal(np.asarray(b.c0), np.asarray(a.c0))
+        np.testing.assert_array_equal(np.asarray(b.c1), np.asarray(a.c1))
+
+
 def test_backend_resolution_rules(ctx1024, monkeypatch):
     ctx, _, _ = ctx1024
     # Off-TPU auto resolves to xla without probing.
