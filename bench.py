@@ -506,6 +506,22 @@ def main() -> None:
         )
     )
 
+    # --- cohort-only training rows (ISSUE 15) ---------------------------
+    # Full-C-masked vs cohort-gathered upload producer at the FIXED
+    # cohort-2-of-16 smoke geometry (single-sourced with profile_round.py
+    # in fl.stream.cohort_compare_smoke_record — the ROADMAP's "millions
+    # registered, thousands per cohort" shape in miniature), with the
+    # committed-aggregate hash equality shipped as `bitwise_equal`.
+    from hefl_tpu.fl.stream import cohort_compare_smoke_record
+
+    cohort_rec = cohort_compare_smoke_record()
+    log(
+        f"cohort_compare (C=16, cohort=2, bucket {cohort_rec['bucket']}): "
+        f"full-C {cohort_rec['full_c_train_s']:.3f}s vs cohort-only "
+        f"{cohort_rec['cohort_train_s']:.3f}s = {cohort_rec['speedup']}x, "
+        f"bitwise_equal={cohort_rec['bitwise_equal']}"
+    )
+
     obs_metrics.record_device_memory(dev)
     obs_snapshot = obs_metrics.snapshot()
 
@@ -617,6 +633,10 @@ def main() -> None:
                 # per-client uplink bytes.
                 "packing": packing_rec,
                 "bytes_on_wire": bytes_on_wire,
+                # Cohort-only training rows (ISSUE 15): full-C vs
+                # cohort-only producer seconds, bucket chosen, devices
+                # per mesh axis, committed-aggregate hash equality.
+                "cohort_compare": cohort_rec,
                 "device": getattr(dev, "device_kind", str(dev)),
                 "seed": seed,
                 # `accuracy` pairs with `value`: both are the round-0

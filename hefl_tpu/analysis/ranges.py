@@ -1042,7 +1042,13 @@ def certify_aggregation(prime: int) -> AggregationCertificate:
          MAX_PSUM_CLIENTS canonical residues (< p each);
       2. `parallel.collectives.psum_mod`'s fused lazy all-reduce at
          MAX_PSUM_CLIENTS participants per mesh axis (analyzed at the
-         declared worst-case axis size, whatever mesh traced it);
+         declared worst-case axis size, whatever mesh traced it) — on the
+         1-D client mesh AND on the 2-D ("clients", "ct") mesh
+         (ISSUE 15), with worst-case sizes injected on BOTH axes over the
+         trace mesh, so the cohort-bucketed 2-D round's psum bound is
+         proven rather than sampled (the ct axis partitions rows and is
+         never reduced over; analyzing it at the worst case proves the
+         bound is shard-count-independent);
       3. `fl.stream.OnlineAccumulator`'s int64 online fold — proven
          INDUCTIVELY for any arrival count (`certify_fold_inductive`),
          not at one traced fold.
@@ -1084,6 +1090,21 @@ def certify_aggregation(prime: int) -> AggregationCertificate:
         jax.make_jaxpr(fn)(*args),
         [canonical],
         axis_sizes={"clients": MAX_PSUM_CLIENTS},
+    )
+
+    # 2b. the same collective on the 2-D ("clients", "ct") mesh
+    # (ISSUE 15): worst-case sizes injected on BOTH axes over the trace
+    # mesh — proves the cohort-bucketed round's psum bound holds at any
+    # ct shard count (the ct axis only partitions rows).
+    fn, args = collectives.psum_range_probe_2d(prime)
+    run(
+        f"psum_mod 2-D[{MAX_PSUM_CLIENTS} clients x "
+        f"{MAX_PSUM_CLIENTS} ct]",
+        jax.make_jaxpr(fn)(*args),
+        [canonical],
+        axis_sizes={
+            "clients": MAX_PSUM_CLIENTS, "ct": MAX_PSUM_CLIENTS,
+        },
     )
 
     # 3. the streaming engine's int64 online fold: the inductive loop

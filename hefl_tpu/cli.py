@@ -184,6 +184,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="base backoff between delivery retries")
     p.add_argument("--stream-seed", type=int, default=0,
                    help="PRNG seed of cohort sampling and retry jitter")
+    p.add_argument("--full-cohort-train", action="store_true",
+                   help="disable cohort-only training: every registered "
+                        "client slot trains each round with unsampled "
+                        "clients masked (the historical full-C producer; "
+                        "the cohort-only default gathers just the sampled "
+                        "cohort's slots, bitwise the same aggregate)")
+    p.add_argument("--mesh-ct", type=int, default=0, metavar="K",
+                   help="2-D (clients, ct) round mesh: give each client "
+                        "block K devices that split its in-round "
+                        "ciphertext rows (bitwise-identical rounds, HE "
+                        "throughput x K); 0 = the 1-D client mesh")
     # --- hybrid-HE symmetric uplink (hefl_tpu/hhe, README "Hybrid HE
     # uplink") ---
     p.add_argument("--hhe", action="store_true",
@@ -368,9 +379,15 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             "--dp-min-surviving has no effect without --dp-noise; add "
             "--dp-noise SIGMA to enable dp"
         )
+    if args.full_cohort_train and not want_stream:
+        raise SystemExit(
+            "--full-cohort-train has no effect without a streaming knob; "
+            "add --stream (or --cohort-size K) to enable the engine"
+        )
     stream = (
         StreamConfig(
             cohort_size=args.cohort_size,
+            cohort_only=not args.full_cohort_train,
             quorum=args.quorum,
             deadline_s=args.deadline,
             max_retries=args.stream_retries,
@@ -441,6 +458,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         max_round_retries=args.max_round_retries,
         retry_backoff_s=args.retry_backoff,
         events_path=args.events,
+        mesh_ct=args.mesh_ct,
     )
 
 

@@ -58,7 +58,12 @@ from hefl_tpu.models import count_params, create_model
 from hefl_tpu.obs import events as obs_events
 from hefl_tpu.obs import metrics as obs_metrics
 from hefl_tpu.obs import scopes as obs_scopes
-from hefl_tpu.parallel import client_mesh_size, make_mesh
+from hefl_tpu.parallel import (
+    client_mesh_size,
+    ct_shard_count,
+    make_mesh,
+    make_mesh_2d,
+)
 from hefl_tpu.utils import PhaseTimer, load_checkpoint, save_checkpoint, save_params
 from hefl_tpu.utils import roofline
 
@@ -175,6 +180,12 @@ class ExperimentConfig:
     # set with upload_kind=ckks it is rejected loudly (a run the user
     # believes is HHE but is not).
     hhe: "HheConfig | None" = None
+    # 2-D ("clients", "ct") round mesh (ISSUE 15): K > 1 gives every
+    # client block K devices that split its in-round ciphertext rows
+    # (fl.secure._ct_sharded_encrypt_core) — bitwise-identical results,
+    # HE throughput scaled by K. 0/1 keeps the historical 1-D client mesh
+    # (HEFL_MESH_CT can still flip the default at the mesh layer for CI).
+    mesh_ct: int = 0
 
 
 def _train_roofline_inputs(module, params, train_cfg: TrainConfig,
@@ -493,7 +504,14 @@ def run_experiment(
         }
 
     xs, ys = stack_federated(x, y, _partition(cfg, y))
-    mesh = make_mesh(cfg.num_clients)
+    # Round topology: the 1-D client mesh, or — with mesh_ct > 1 — the
+    # 2-D ("clients", "ct") mesh whose ct axis shards the in-round HE
+    # rows within each client block (ISSUE 15; bitwise-identical rounds).
+    mesh = (
+        make_mesh_2d(cfg.num_clients, cfg.mesh_ct)
+        if cfg.mesh_ct > 1
+        else make_mesh(cfg.num_clients)
+    )
     # Hoist the padding gather: pad the federated arrays to the mesh ONCE
     # here (host-side) instead of letting every round re-run the
     # device-side xs[pad_idx] gather; the round wrappers get the real
@@ -1015,6 +1033,13 @@ def run_experiment(
         "stream": (
             dataclasses.asdict(cfg.stream) if cfg.stream is not None else None
         ),
+        # Round-mesh topology (ISSUE 15): devices per axis — ct > 1 means
+        # the in-round HE rows sharded on the 2-D ("clients", "ct") mesh.
+        "mesh": {
+            "axes": [str(a) for a in mesh.axis_names],
+            "clients": client_mesh_size(mesh),
+            "ct": ct_shard_count(mesh),
+        },
         # Hybrid-HE uplink record (None = direct CKKS uploads): key seed +
         # the bytes_on_wire story — symmetric-upload bytes vs the plain
         # quantized baseline (expansion_hhe, the <= 1.1x gate currency)

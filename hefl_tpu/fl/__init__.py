@@ -38,7 +38,12 @@ from hefl_tpu.fl.faults import (
     schedule_arrivals,
     schedule_for_round,
 )
-from hefl_tpu.fl.fedavg import evaluate, fedavg_round, train_clients
+from hefl_tpu.fl.fedavg import (
+    cohort_bucket,
+    evaluate,
+    fedavg_round,
+    train_clients,
+)
 from hefl_tpu.fl.metrics import classification_metrics
 from hefl_tpu.fl.secure import (
     aggregate_encrypted,
@@ -55,6 +60,7 @@ from hefl_tpu.fl.stream import (
     OnlineAccumulator,
     StreamEngine,
     StreamRoundMeta,
+    cohort_compare_record,
     produce_uploads,
     quorum_count,
     sample_cohort,
@@ -87,6 +93,8 @@ __all__ = [
     "produce_uploads",
     "quorum_count",
     "sample_cohort",
+    "cohort_bucket",
+    "cohort_compare_record",
     "local_train",
     "train_centralized",
     "fedavg_round",

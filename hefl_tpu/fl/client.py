@@ -270,22 +270,103 @@ def _epoch_streams(epoch_keys: jax.Array, sp: _TrainSplit):
     return perms, aug_keys
 
 
+def epoch_index_streams(cfg: TrainConfig, client_keys: jax.Array, n_samples: int):
+    """Every client's flattened shuffle/augment streams for one round,
+    derived OUTSIDE the sharded round program (ISSUE 15): -> (perms
+    int32[C, E*S, grp], aug_keys key[C, E*S]).
+
+    The derivation is bitwise `local_train`'s (split(key, epochs) ->
+    `_epoch_streams`, vmapped over clients) — same keys => same streams.
+    It is HOISTED to the un-sharded jit level because
+    `jax.random.permutation`'s sort, lowered inside a `shard_map`
+    (manual-sharding) region, partitions ACROSS devices on some
+    geometries: XLA emits a cross-partition all-reduce over the sort
+    keys (observed on the virtual CPU mesh at e.g. [C=8, n_tr=24]),
+    silently coupling every client's shuffle to every other client's key
+    — training then depends on which device a client lands on, which
+    breaks per-client key isolation and with it every
+    placement-independence property the cohort gather and the 2-D mesh
+    rely on. Outside the manual region the sort lowers per row and each
+    client's stream is a function of its own key alone. The round
+    factories feed these streams in as sharded traced inputs; the
+    in-body derivation remains for unsharded direct callers
+    (`local_train`) and the nested semantics-reference layout.
+    """
+    import types
+
+    n_tr, grp, steps = train_batch_geometry(cfg, int(n_samples))
+    sp = types.SimpleNamespace(n_tr=n_tr, grp=grp, steps=steps)
+    e = int(cfg.epochs)
+
+    def one(k):
+        epoch_keys = jax.random.split(k, e)
+        perms, aug = _epoch_streams(epoch_keys, sp)
+        return perms.reshape(e * steps, grp), aug.reshape(e * steps)
+
+    return jax.vmap(one)(client_keys)
+
+
+def hoist_streams(cfg: TrainConfig, backend: str) -> bool:
+    """SINGLE source of the hoisted-shuffle-streams predicate shared by
+    all three round factories (fedavg/secure/stream): the fused backend
+    always runs the flat layout, the vmap backend hoists when the config
+    does (the nested flat_scan=False layout keeps its in-body derivation
+    as the unsharded semantics reference)."""
+    return backend == "fused" or bool(cfg.flat_scan)
+
+
+def hoisted_streams_jit(
+    fn, cfg: TrainConfig, x_index: int, key_index: int,
+    insert_after: int | None = None,
+):
+    """Wrap a shard_map'd round body in the un-sharded stream hoist and
+    jit it — the ONE wrapper all three round factories share, so the
+    hoist's derivation point cannot drift between them (ISSUE 15).
+
+    `fn`'s signature must accept the two stream arrays (perms, aug_keys)
+    immediately AFTER argument `insert_after` (default: `key_index` —
+    the per-client train-key block the streams derive from; the secure
+    factories insert after their enc-key block instead); `x_index` names
+    the federated data array whose axis 1 is the per-client sample
+    count.
+    """
+    if insert_after is None:
+        insert_after = key_index
+
+    def outer(*args):
+        perms, aug = epoch_index_streams(
+            cfg, args[key_index], args[x_index].shape[1]
+        )
+        head = args[: insert_after + 1]
+        rest = args[insert_after + 1:]
+        return fn(*head, perms, aug, *rest)
+
+    return jax.jit(outer)
+
+
 def _local_train_epochs_flat(
     module, cfg: TrainConfig, global_params, x, y,
     state: ClientState, epoch_keys, track_best_acc: bool,
+    streams=None,
 ):
     """ONE steps-major scan over all E*S SGD steps. Validation + callback
     logic fires under a `lax.cond` on each epoch's final step (the cond
     predicate is an unbatched function of the step index, so it stays a
     real branch — no validation cost on interior steps — even under the
-    cross-client vmap)."""
+    cross-client vmap). `streams` (flat_perm [E*S, grp], flat_aug [E*S])
+    swaps the in-body shuffle derivation for precomputed arrays — the
+    hoisted round-program path (`epoch_index_streams`); the values are
+    identical by construction, only the place the sort lowers changes."""
     sp = _train_split(cfg, x, y)
     e = int(epoch_keys.shape[0])
     with jax.named_scope(obs_scopes.SGD_CORE):
-        # Shuffle/key prologue is SGD machinery: attribute it there.
-        perms, aug_keys = _epoch_streams(epoch_keys, sp)
-        flat_perm = perms.reshape(e * sp.steps, sp.grp)
-        flat_aug = aug_keys.reshape(e * sp.steps)
+        if streams is None:
+            # Shuffle/key prologue is SGD machinery: attribute it there.
+            perms, aug_keys = _epoch_streams(epoch_keys, sp)
+            flat_perm = perms.reshape(e * sp.steps, sp.grp)
+            flat_aug = aug_keys.reshape(e * sp.steps)
+        else:
+            flat_perm, flat_aug = streams
         is_end = (jnp.arange(e * sp.steps) % sp.steps) == sp.steps - 1
     train_step = _make_train_step(module, cfg, global_params, sp)
 
@@ -384,6 +465,7 @@ def local_train_epochs(
     state: ClientState,
     epoch_keys: jax.Array,
     track_best_acc: bool = True,
+    streams=None,
 ):
     """Advance the client program by `len(epoch_keys)` epochs from `state`.
 
@@ -397,11 +479,19 @@ def local_train_epochs(
     resident copy of the carry instead of input+output.
     -> (state, metrics f32[len(epoch_keys), 4]).
     """
-    impl = (
-        _local_train_epochs_flat if cfg.flat_scan else _local_train_epochs_nested
+    if cfg.flat_scan:
+        return _local_train_epochs_flat(
+            module, cfg, global_params, x, y, state, epoch_keys,
+            track_best_acc, streams=streams,
+        )
+    if streams is not None:
+        raise ValueError(
+            "precomputed shuffle streams are a flat-scan feature; the "
+            "nested semantics-reference layout derives its own in-body"
+        )
+    return _local_train_epochs_nested(
+        module, cfg, global_params, x, y, state, epoch_keys, track_best_acc
     )
-    return impl(module, cfg, global_params, x, y, state, epoch_keys,
-                track_best_acc)
 
 
 # Donated jitted entry for chunk-resume drivers: the incoming ClientState
@@ -443,18 +533,24 @@ def local_train(
     x: jax.Array,
     y: jax.Array,
     key: jax.Array,
+    streams=None,
 ):
     """Train one client from the global weights.
 
     x: uint8[m, H, W, C]; y: int32[m]; -> (shipped_params, metrics
     f32[E, 4]) with metrics columns (val_loss, val_acc, lr_scale,
     stopped). `shipped_params` follows `client_shipped_params`.
+    `streams` is the hoisted shuffle/augment stream pair this client's
+    round program precomputed (`epoch_index_streams` row; flat layout
+    only) — same values as the in-body derivation, sort lowered outside
+    the sharded region.
     """
     epoch_keys = jax.random.split(key, cfg.epochs)
     final, metrics = local_train_epochs(
         module, cfg, global_params, x, y,
         init_client_state(global_params), epoch_keys,
         track_best_acc=False,   # clients never read the ModelCheckpoint copy
+        streams=streams,
     )
     return client_shipped_params(final), metrics
 

@@ -136,6 +136,21 @@ class StreamConfig:
                       missed upload may carry forward before it is
                       excluded as "stale" (0 = synchronous semantics:
                       missed means dropped with cause "timeout").
+    cohort_only:      train ONLY the sampled cohort's client slots
+                      (ISSUE 15): the engine gathers the cohort's data/
+                      key/mask rows before the fused GEMM stream, padded
+                      up a small power-of-two bucket ladder
+                      (fl.fedavg.cohort_bucket) so the no-new-compile
+                      guarantee holds within a bucket, and scatters the
+                      trained slots back — the committed aggregate is
+                      BITWISE equal to the historical full-C masked path
+                      at the same cohort, but compute scales with the
+                      cohort instead of the registry. False restores the
+                      full-C producer (every registered slot trains,
+                      unsampled ones masked) — the reference the equality
+                      gates and the cohort_compare bench row run against.
+                      Unsampled clients carry zero metrics rows under
+                      cohort-only (they trained nothing).
     seed:             PRNG seed of cohort sampling and retry jitter
                       (independent of both the experiment seed and the
                       fault-schedule seed).
@@ -157,6 +172,7 @@ class StreamConfig:
     """
 
     cohort_size: int = 0
+    cohort_only: bool = True
     quorum: float = 1.0
     deadline_s: float = 0.0
     max_retries: int = 0

@@ -36,21 +36,32 @@ from hefl_tpu.parallel import (
 )
 
 
-def vmapped_train(module, cfg: TrainConfig, gp, x_blk, y_blk, k_blk):
+def vmapped_train(
+    module, cfg: TrainConfig, gp, x_blk, y_blk, k_blk, streams_blk=None
+):
     """Train one device's block of clients from the shared global weights.
 
     x_blk: [cpd, m, ...] — this device's clients; vmap trains them
     "concurrently" (XLA interleaves). The semantics REFERENCE backend of
-    `train_block` (client_fusion="vmap").
+    `train_block` (client_fusion="vmap"). `streams_blk` is the block's
+    slice of the hoisted shuffle/augment streams
+    (`client.epoch_index_streams`; the round factories always pass it on
+    the flat layout so the shuffle sort never lowers inside the sharded
+    region — see that docstring).
     -> (stacked weight trees [cpd, ...], metrics [cpd, E, 4]).
     """
-    train_one = lambda x, y, k: local_train(module, cfg, gp, x, y, k)  # noqa: E731
-    return jax.vmap(train_one)(x_blk, y_blk, k_blk)
+    if streams_blk is None:
+        train_one = lambda x, y, k: local_train(module, cfg, gp, x, y, k)  # noqa: E731
+        return jax.vmap(train_one)(x_blk, y_blk, k_blk)
+    train_one = lambda x, y, k, pm, ag: local_train(  # noqa: E731
+        module, cfg, gp, x, y, k, streams=(pm, ag)
+    )
+    return jax.vmap(train_one)(x_blk, y_blk, k_blk, *streams_blk)
 
 
 def train_block(
     module, cfg: TrainConfig, gp, x_blk, y_blk, k_blk,
-    m_blk=None, backend: str | None = None,
+    m_blk=None, backend: str | None = None, streams_blk=None,
 ):
     """Train one device's block of clients through the configured
     cross-client backend (TrainConfig.client_fusion; fl.fusion). The
@@ -76,9 +87,12 @@ def train_block(
         from hefl_tpu.fl.fusion import fused_train
 
         return fused_train(
-            module, cfg, gp, x_blk, y_blk, k_blk, participation=m_blk
+            module, cfg, gp, x_blk, y_blk, k_blk, participation=m_blk,
+            streams_blk=streams_blk,
         )
-    return vmapped_train(module, cfg, gp, x_blk, y_blk, k_blk)
+    return vmapped_train(
+        module, cfg, gp, x_blk, y_blk, k_blk, streams_blk=streams_blk
+    )
 
 
 def masked_mean_tree(gp, p_out, keep, axes, total: int):
@@ -136,11 +150,22 @@ def _build_round_fn(
     from hefl_tpu.fl.fusion import resolve_fusion_backend
 
     backend = resolve_fusion_backend(cfg.client_fusion, module)
+    # Hoisted shuffle streams (ISSUE 15, client.epoch_index_streams): the
+    # per-client permutation sort must lower OUTSIDE the manual-sharding
+    # region or XLA couples it across devices on some geometries.
+    from hefl_tpu.fl.client import hoist_streams, hoisted_streams_jit
 
-    def body(gp, x_blk, y_blk, k_blk, m_blk=None, po_blk=None):
+    hoist = hoist_streams(cfg, backend)
+
+    def body(gp, x_blk, y_blk, k_blk, *rest):
+        i = 0
+        streams_blk = None
+        if hoist:
+            streams_blk, i = (rest[0], rest[1]), 2
+        m_blk, po_blk = (rest[i], rest[i + 1]) if masked else (None, None)
         p_out, mets = train_block(
             module, cfg, gp, x_blk, y_blk, k_blk,
-            m_blk=m_blk, backend=backend,
+            m_blk=m_blk, backend=backend, streams_blk=streams_blk,
         )
         if stacked:
             return p_out, mets
@@ -161,6 +186,8 @@ def _build_round_fn(
         return new_gp, mets, bits
 
     in_specs = (P(), P(axes), P(axes), P(axes))
+    if hoist:
+        in_specs = in_specs + (P(axes), P(axes))
     out_specs = (P(axes) if stacked else P(), P(axes))
     if masked:
         in_specs = in_specs + (P(axes), P(axes))
@@ -172,7 +199,70 @@ def _build_round_fn(
         out_specs=out_specs,
         check_vma=False,
     )
-    return jax.jit(fn)
+    if not hoist:
+        return jax.jit(fn)
+    # Un-sharded region: per-client streams from per-client keys, the
+    # sort lowered sanely, then fed into the manual region sharded
+    # alongside the keys they derive from (one shared wrapper —
+    # client.hoisted_streams_jit — so the factories cannot drift).
+    return hoisted_streams_jit(fn, cfg, x_index=1, key_index=3)
+
+
+def cohort_bucket(cohort_size: int, num_clients: int, n_dev: int) -> int:
+    """Client-slot count a cohort of `cohort_size` trains at (ISSUE 15).
+
+    Cohort-only training gathers the sampled clients' slots before the
+    fused GEMM stream, but tracing a fresh program per cohort size would
+    void the no-new-compile guarantee — so cohorts pad up a small LADDER
+    of power-of-two buckets (the PR-13 serving-batch idiom), each rounded
+    to a multiple of the mesh's client axis so the SPMD shape stays even,
+    and capped at the full registry's padded shape (a bucket can never
+    cost more than the historical full-C program). Every cohort size
+    inside one bucket reuses one executable; crossing a bucket compiles
+    exactly once per bucket per process. An oversized cohort (more
+    clients than registered) is a caller bug and fails loudly.
+
+    Bitwise floor: when the full-C program trains >= 2 client slots per
+    device, the bucket keeps >= 2 per device too. Per-client float math
+    is identical at ANY per-device vmap width >= 2 (the conv batching
+    rule lowers every width to the grouped form, whose per-group math is
+    width-independent; the fused backend's client-batched dot_generals
+    likewise) — but width 1 takes XLA's UNgrouped lowering, a different
+    algorithm with different rounding. Pinning both sides of the
+    cohort-vs-full gates to the grouped form is what makes "bitwise-equal
+    to the full-C reference" a structural property, not a fluke
+    (tests/test_cohort.py pins it on both backends).
+    """
+    if cohort_size < 1:
+        raise ValueError(
+            f"cohort_bucket: cohort_size={cohort_size} must be >= 1"
+        )
+    if cohort_size > num_clients:
+        raise ValueError(
+            f"cohort_bucket: cohort of {cohort_size} exceeds the "
+            f"{num_clients} registered clients — the sampler cannot have "
+            "produced this; refusing to train phantom slots"
+        )
+    bucket = 1 << (int(cohort_size) - 1).bit_length()   # next power of two
+    bucket = -(-bucket // n_dev) * n_dev                # mesh-divisible
+    full = -(-num_clients // n_dev) * n_dev             # full-C padded shape
+    if full > n_dev:
+        # Full-C width >= 2: keep the bucket in the grouped lowering too.
+        bucket = max(bucket, 2 * n_dev)
+    return min(bucket, full)
+
+
+def cohort_gather_index(cohort, bucket: int) -> np.ndarray:
+    """Gather index [bucket] into the REAL client rows: the sampled
+    cohort first, then client 0's slot repeated for the bucket padding
+    (padding slots are scheduled out of training and never fold — the
+    same masked-dummy idiom as `pad_index`, so dummy padding and cohort
+    padding share one masking story and cannot double-count in
+    `RoundMeta.surviving`)."""
+    cohort = np.asarray(cohort, dtype=np.int64)
+    idx = np.zeros(int(bucket), np.int64)
+    idx[: len(cohort)] = cohort
+    return idx
 
 
 def pad_index(num_clients: int, n_dev: int) -> np.ndarray | None:

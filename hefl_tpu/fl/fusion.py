@@ -104,6 +104,7 @@ def fused_train(
     y_blk: jax.Array,
     k_blk: jax.Array,
     participation: jax.Array | None = None,
+    streams_blk=None,
 ):
     """Train one device's block of clients through the client-folded path.
 
@@ -113,6 +114,14 @@ def fused_train(
     0-masked client's data still flows through every fused GEMM (static
     shape), but its parameter/optimizer/callback updates are masked to
     no-ops, so it ships the round's global weights unchanged.
+    `streams_blk` ((perms [cpd, E*S, grp], aug_keys [cpd, E*S]) from
+    `client.epoch_index_streams`) swaps the in-body shuffle derivation
+    for the hoisted arrays — identical values, but the permutation sort
+    lowers OUTSIDE the sharded round program (ISSUE 15; the round
+    factories always pass it). With cohort-only training the gather that
+    feeds this block — the sampled cohort's data/key/stream slots, padded
+    to the power-of-two bucket — happened BEFORE this fused GEMM stream,
+    so the [cpd*B] batches below are cohort-sized, not registry-sized.
     -> (shipped stacked weight trees [cpd, ...], metrics [cpd, E, 4]).
     """
     cpd = int(x_blk.shape[0])
@@ -138,16 +147,22 @@ def fused_train(
 
     e = int(cfg.epochs)
     with jax.named_scope(obs_scopes.SGD_CORE):
-        epoch_keys = jax.vmap(lambda k: jax.random.split(k, e))(k_blk)  # [cpd, E]
-        # Per-client shuffles + augment keys from the SAME derivation as the
-        # vmap path (client._epoch_streams), vmapped over the block — same
-        # keys => same index/augment streams by construction. The split's
-        # static geometry is shared across clients, so client 0's split
-        # describes the whole block (the throwaway one-hot it builds is DCE'd).
-        sp0 = _train_split(cfg, x_blk[0], y_blk[0])
-        perms, aug_keys = jax.vmap(lambda ek: _epoch_streams(ek, sp0))(epoch_keys)
-        flat_perm = perms.reshape(cpd, e * steps, grp).swapaxes(0, 1)  # [T,cpd,grp]
-        flat_aug = aug_keys.reshape(cpd, e * steps).swapaxes(0, 1)     # [T,cpd]
+        if streams_blk is None:
+            epoch_keys = jax.vmap(lambda k: jax.random.split(k, e))(k_blk)  # [cpd, E]
+            # Per-client shuffles + augment keys from the SAME derivation as
+            # the vmap path (client._epoch_streams), vmapped over the block —
+            # same keys => same index/augment streams by construction. The
+            # split's static geometry is shared across clients, so client 0's
+            # split describes the whole block (the throwaway one-hot it
+            # builds is DCE'd).
+            sp0 = _train_split(cfg, x_blk[0], y_blk[0])
+            perms, aug_keys = jax.vmap(lambda ek: _epoch_streams(ek, sp0))(epoch_keys)
+            flat_perm = perms.reshape(cpd, e * steps, grp).swapaxes(0, 1)  # [T,cpd,grp]
+            flat_aug = aug_keys.reshape(cpd, e * steps).swapaxes(0, 1)     # [T,cpd]
+        else:
+            pm, ag = streams_blk          # [cpd, T, grp], [cpd, T]
+            flat_perm = pm.swapaxes(0, 1)                          # [T,cpd,grp]
+            flat_aug = ag.swapaxes(0, 1)                           # [T,cpd]
         is_end = (jnp.arange(e * steps) % steps) == steps - 1
 
     params0 = stack_params(global_params, cpd)

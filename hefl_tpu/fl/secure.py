@@ -148,7 +148,55 @@ def lazy_sum_chunk_probe(chunk: int = MAX_PSUM_CLIENTS):
     return probe, (jnp.zeros((int(chunk), 8), jnp.uint32),)
 
 
-def encrypt_stack(ctx: CkksContext, pk: PublicKey, p_out, enc_keys) -> Ciphertext:
+def _ct_sharded_encrypt_core(
+    ctx: CkksContext, pk: PublicKey, m_res, u, e0, e1, ct_shards: int
+) -> Ciphertext:
+    """The stacked encrypt core with the ciphertext-row axis (axis 1 of
+    [C, n_ct, ...]) sharded over the mesh's ``"ct"`` axis (ISSUE 15).
+
+    Only callable inside a `shard_map` body on a 2-D ("clients", "ct")
+    mesh. Encode and sampling already ran at the LOGICAL [n_ct] shape
+    (replicated over ct — they are elementwise and cheap; the historical
+    key derivation is untouched, so ciphertexts stay bitwise stable);
+    here each device keeps its `n_ct / ct_shards` row slice, runs the
+    NTT-heavy encrypt core on that slice only, and an all-gather over the
+    ``"ct"`` axis reassembles the full [C, n_ct, ...] stack — bitwise the
+    replicated result (sharding partitions rows, every row's math is
+    identical), so everything downstream (masking, lazy sums, the psum
+    tail, the owner decrypt) is untouched. ct_shards == 1 is the
+    historical path, same compiled program.
+    """
+    if ct_shards <= 1:
+        return ops.encrypt_core(ctx, pk, m_res, u, e0, e1)
+    from hefl_tpu.parallel import CT_AXIS
+
+    n_ct = int(m_res.shape[1])
+    per = -(-n_ct // ct_shards)
+    pad = per * ct_shards - n_ct
+
+    def local_rows(t):
+        if pad:
+            t = jnp.concatenate(
+                [t, jnp.zeros((t.shape[0], pad) + t.shape[2:], t.dtype)],
+                axis=1,
+            )
+        start = jax.lax.axis_index(CT_AXIS) * per
+        return jax.lax.dynamic_slice_in_dim(t, start, per, axis=1)
+
+    ct = ops.encrypt_core(
+        ctx, pk, local_rows(m_res), local_rows(u),
+        local_rows(e0), local_rows(e1),
+    )
+    c0 = jax.lax.all_gather(ct.c0, CT_AXIS, axis=1, tiled=True)
+    c1 = jax.lax.all_gather(ct.c1, CT_AXIS, axis=1, tiled=True)
+    if pad:
+        c0, c1 = c0[:, :n_ct], c1[:, :n_ct]
+    return Ciphertext(c0=c0, c1=c1, scale=ct.scale)
+
+
+def encrypt_stack(
+    ctx: CkksContext, pk: PublicKey, p_out, enc_keys, ct_shards: int = 1
+) -> Ciphertext:
     """Encrypt stacked per-client weight trees (leaves [C, ...]) into one
     [C, n_ct, L, N]-batched Ciphertext — the encrypt half of the round for
     weights that are already materialized (bench.py's cell-6 artifact, the
@@ -169,7 +217,7 @@ def encrypt_stack(ctx: CkksContext, pk: PublicKey, p_out, enc_keys) -> Ciphertex
     u, e0, e1 = jax.vmap(
         lambda k: ops.encrypt_samples(ctx, k, (n_ct,))
     )(enc_keys)
-    return ops.encrypt_core(ctx, pk, m_res, u, e0, e1)
+    return _ct_sharded_encrypt_core(ctx, pk, m_res, u, e0, e1, ct_shards)
 
 
 def encrypt_stack_packed(
@@ -179,6 +227,7 @@ def encrypt_stack_packed(
     base_params,
     enc_keys,
     spec: PackedSpec,
+    ct_shards: int = 1,
 ) -> tuple[Ciphertext, jax.Array]:
     """The packed-quantized twin of `encrypt_stack`: each client's UPDATE
     (trained weights minus `base_params`, the round's global weights) is
@@ -203,7 +252,7 @@ def encrypt_stack_packed(
     u, e0, e1 = jax.vmap(
         lambda k: ops.encrypt_samples(ctx, k, (n_ct,))
     )(enc_keys)
-    ct = ops.encrypt_core(ctx, pk, m_res, u, e0, e1)
+    ct = _ct_sharded_encrypt_core(ctx, pk, m_res, u, e0, e1, ct_shards)
     return (
         Ciphertext(c0=ct.c0, c1=ct.c1, scale=spec.guard_scale),
         sat,
@@ -633,7 +682,8 @@ def client_upload_body(
     module, cfg, backend, ctx, dp, dp_k, packing, want_bits,
     gp, pk, x_blk, y_blk, kt_blk, ke_blk,
     kd_blk=None, m_blk=None, po_blk=None,
-    hhe_keys_blk=None, hhe_round=None,
+    hhe_keys_blk=None, hhe_round=None, ct_shards: int = 1,
+    streams_blk=None,
 ):
     """The per-client half of BOTH round programs: train -> dp sanitize
     (shares calibrated to dp_k) -> poison -> pack/encode/encrypt (+
@@ -654,10 +704,16 @@ def client_upload_body(
     the server-side transcipher consumes, everything else — training, dp,
     poison, saturation, exclusion bits — is traced identically, which is
     what makes the HHE-vs-direct parity gate hold by construction.
+    `ct_shards > 1` (the 2-D ("clients", "ct") mesh, ISSUE 15) shards the
+    CKKS encrypt core's ciphertext rows over the ``"ct"`` axis
+    (`_ct_sharded_encrypt_core`) — bitwise-identical uploads, NTT work
+    divided by the shard count; the HHE symmetric cipher has no NTTs, so
+    its leg ignores the knob.
     -> (cts, mets, overflow, bits | None, p_out).
     """
     p_out, mets = train_block(
-        module, cfg, gp, x_blk, y_blk, kt_blk, m_blk=m_blk, backend=backend
+        module, cfg, gp, x_blk, y_blk, kt_blk, m_blk=m_blk, backend=backend,
+        streams_blk=streams_blk,
     )
     if dp is not None:
         from hefl_tpu.fl.dp import dp_sanitize
@@ -690,7 +746,7 @@ def client_upload_body(
             # rows; `overflow` carries the quantizer saturation count
             # (same slot, same on_overflow machinery).
             cts, overflow = encrypt_stack_packed(
-                ctx, pk, p_out, gp, ke_blk, packing
+                ctx, pk, p_out, gp, ke_blk, packing, ct_shards=ct_shards
             )                                          # [cpd, n_ct/k, ...]
         else:
             # Saturation diagnostic on exactly what gets encoded (the
@@ -700,7 +756,9 @@ def client_upload_body(
                 pack_pytree(prm, ctx.n), ctx.scale
             )
             overflow = jax.vmap(ov_one)(p_out)         # [cpd] int32
-            cts = encrypt_stack(ctx, pk, p_out, ke_blk)  # [cpd, n_ct, L, N]
+            cts = encrypt_stack(
+                ctx, pk, p_out, ke_blk, ct_shards=ct_shards
+            )                                          # [cpd, n_ct, L, N]
     bits = None
     if want_bits:
         with jax.named_scope(obs_scopes.SANITIZE):
@@ -740,6 +798,12 @@ def _build_secure_round_fn(
 
     axes = client_axes(mesh)   # ("clients",) or ("hosts", "clients")
     n_dev = client_mesh_size(mesh)
+    # In-round HE sharding (ISSUE 15): on a 2-D ("clients", "ct") mesh the
+    # encrypt core's ciphertext rows split over the ct axis — bitwise the
+    # replicated result (see _ct_sharded_encrypt_core); 1 elsewhere.
+    from hefl_tpu.parallel import ct_shard_count
+
+    ct_shards = ct_shard_count(mesh)
     # Cross-client backend resolved once per factory call (concrete
     # context; the auto micro-timing probe runs eagerly) — see
     # fedavg._build_round_fn.
@@ -747,17 +811,26 @@ def _build_secure_round_fn(
 
     backend = resolve_fusion_backend(cfg.client_fusion, module)
     dp_k = calibration_clients(dp, num_clients) if dp is not None else 0
+    # Hoisted shuffle streams (ISSUE 15): the permutation sort must lower
+    # OUTSIDE the manual-sharding region — see client.epoch_index_streams.
+    from hefl_tpu.fl.client import hoist_streams, hoisted_streams_jit
+
+    hoist = hoist_streams(cfg, backend)
 
     def body(gp, pk, x_blk, y_blk, kt_blk, ke_blk, *rest):
         i = 0
+        streams_blk = None
+        if hoist:
+            streams_blk, i = (rest[0], rest[1]), 2
         kd_blk = None
         if dp is not None:
-            kd_blk, i = rest[0], 1
+            kd_blk, i = rest[i], i + 1
         m_blk, po_blk = (rest[i], rest[i + 1]) if masked else (None, None)
         cts, mets, overflow, bits, p_out = client_upload_body(
             module, cfg, backend, ctx, dp, dp_k, packing, masked,
             gp, pk, x_blk, y_blk, kt_blk, ke_blk,
             kd_blk=kd_blk, m_blk=m_blk, po_blk=po_blk,
+            ct_shards=ct_shards, streams_blk=streams_blk,
         )
         with jax.named_scope(obs_scopes.PSUM_AGGREGATE):
             if masked:
@@ -808,6 +881,8 @@ def _build_secure_round_fn(
     if with_plain_reference:
         out_specs = out_specs + (P(),)
     in_specs = (P(), P(), P(axes), P(axes), P(axes), P(axes))
+    if hoist:
+        in_specs = in_specs + (P(axes), P(axes))  # hoisted shuffle streams
     if dp is not None:
         in_specs = in_specs + (P(axes),)   # per-client dp noise keys
     if masked:
@@ -819,4 +894,8 @@ def _build_secure_round_fn(
         out_specs=out_specs,
         check_vma=False,
     )
-    return jax.jit(fn)
+    if not hoist:
+        return jax.jit(fn)
+    # Streams derive from the train keys (arg 4) and insert after the
+    # enc keys (arg 5) — one shared wrapper, see client.hoisted_streams_jit.
+    return hoisted_streams_jit(fn, cfg, x_index=2, key_index=4, insert_after=5)

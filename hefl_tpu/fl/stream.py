@@ -12,7 +12,12 @@ CKKS addition is associative and commutative over exact residues mod p,
 so neither assumption is load-bearing. This module replaces them:
 
   * `sample_cohort` — per-round cohorts drawn by a deterministic PRNG:
-    partial participation is the DEFAULT regime, not a fault.
+    partial participation is the DEFAULT regime, not a fault. With
+    `StreamConfig.cohort_only` (the default, ISSUE 15) compute follows:
+    only the sampled cohort's client slots are gathered and trained
+    (power-of-two bucket ladder, no-new-compile within a bucket), and
+    the committed aggregate stays BITWISE equal to the full-C masked
+    producer at the same cohort.
   * `OnlineAccumulator` — each arriving encrypted update folds into a
     running modular sum: O(1) memory in cohort size, and — because every
     fold is exact arithmetic mod p — BITWISE equal to the batched
@@ -78,12 +83,19 @@ from hefl_tpu.fl.faults import (
 from hefl_tpu.fl.fedavg import (
     _mask_inputs,
     _round_geometry,
+    cohort_bucket,
+    cohort_gather_index,
     replicate_on,
 )
 from hefl_tpu.obs import events as obs_events
 from hefl_tpu.obs import metrics as obs_metrics
 from hefl_tpu.obs import scopes as obs_scopes
-from hefl_tpu.parallel import client_axes, client_mesh_size, shard_map
+from hefl_tpu.parallel import (
+    client_axes,
+    client_mesh_size,
+    ct_shard_count,
+    shard_map,
+)
 
 # In-program sanitization causes: an upload whose bits carry any of these
 # ARRIVES but is rejected at the accumulator (the sanitizer's verdict is
@@ -333,14 +345,25 @@ def _build_upload_fn(
     from hefl_tpu.fl.secure import client_upload_body
 
     axes = client_axes(mesh)
+    # 2-D ("clients", "ct") mesh (ISSUE 15): the per-client encrypt core
+    # shards its ciphertext rows over the ct axis, bitwise-identical.
+    ct_shards = ct_shard_count(mesh)
     backend = resolve_fusion_backend(cfg.client_fusion, module)
     dp_k = calibration_clients(dp, num_clients) if dp is not None else 0
+    # Hoisted shuffle streams (ISSUE 15): the permutation sort must lower
+    # OUTSIDE the manual-sharding region — see client.epoch_index_streams.
+    from hefl_tpu.fl.client import hoist_streams, hoisted_streams_jit
+
+    hoist = hoist_streams(cfg, backend)
 
     def body(gp, pk, x_blk, y_blk, kt_blk, ke_blk, *rest):
         i = 0
+        streams_blk = None
+        if hoist:
+            streams_blk, i = (rest[0], rest[1]), 2
         kd_blk = None
         if dp is not None:
-            kd_blk, i = rest[0], 1
+            kd_blk, i = rest[i], i + 1
         m_blk, po_blk = rest[i], rest[i + 1]
         hk_blk = hhe_round = None
         if hhe:
@@ -349,11 +372,14 @@ def _build_upload_fn(
             module, cfg, backend, ctx, dp, dp_k, packing, True,
             gp, pk, x_blk, y_blk, kt_blk, ke_blk,
             kd_blk=kd_blk, m_blk=m_blk, po_blk=po_blk,
-            hhe_keys_blk=hk_blk, hhe_round=hhe_round,
+            hhe_keys_blk=hk_blk, hhe_round=hhe_round, ct_shards=ct_shards,
+            streams_blk=streams_blk,
         )
         return cts, mets, overflow, bits
 
     in_specs = (P(), P(), P(axes), P(axes), P(axes), P(axes))
+    if hoist:
+        in_specs = in_specs + (P(axes), P(axes))  # hoisted shuffle streams
     if dp is not None:
         in_specs = in_specs + (P(axes),)
     in_specs = in_specs + (P(axes), P(axes))
@@ -368,7 +394,11 @@ def _build_upload_fn(
         out_specs=(P(axes), P(axes), P(axes), P(axes)),
         check_vma=False,
     )
-    return jax.jit(fn)
+    if not hoist:
+        return jax.jit(fn)
+    # Streams derive from the train keys (arg 4) and insert after the
+    # enc keys (arg 5) — one shared wrapper, see client.hoisted_streams_jit.
+    return hoisted_streams_jit(fn, cfg, x_index=2, key_index=4, insert_after=5)
 
 
 def produce_uploads(
@@ -388,6 +418,7 @@ def produce_uploads(
     packing=None,
     hhe=None,
     round_index: int = 0,
+    cohort=None,
 ):
     """Train every client and return its ENCRYPTED upload, per client.
 
@@ -397,6 +428,20 @@ def produce_uploads(
     secure_fedavg_round's (train/enc[/dp] streams), so a cohort's
     trainings match what the batched round would have computed for the
     same key.
+
+    `cohort` (sorted REAL client indices, ISSUE 15) switches to
+    COHORT-ONLY production: the sampled clients' data/key/mask rows are
+    gathered BEFORE the fused GEMM stream and padded up the power-of-two
+    bucket ladder (`fedavg.cohort_bucket` — masked-out client-0 dummies,
+    the `pad_index` idiom, so bucket padding can never fold or count as
+    surviving), and only that bucket trains/encrypts. Per-client keys are
+    still split at the FULL registry count and gathered per client, so
+    every cohort client's training, dp noise, and ciphertext are BITWISE
+    what the full-C producer computes for it — the cohort-vs-full
+    equality gates hold by construction. Outputs are then COHORT-ROWED
+    ([len(cohort), ...], cohort order); the engine scatters. A cohort
+    covering every client falls back to the historical full-C path (same
+    shapes, same executables, bit-for-bit).
 
     `hhe` (an `fl.config.HheConfig`, ISSUE 11) switches the wire format to
     upload_kind=hhe: each client's packed quantized update is encrypted
@@ -430,10 +475,13 @@ def produce_uploads(
     else:
         k_train, k_enc, k_dp = jax.random.split(key, 3)
         dp_keys = jax.random.split(k_dp, num_clients)
+    # Per-client key streams ALWAYS derive at the full registry count —
+    # a cohort gather below picks rows out of this split, so client c's
+    # streams are independent of who else was sampled (the bitwise
+    # cohort-vs-full-C contract).
     train_keys = jax.random.split(k_train, num_clients)
     enc_keys = jax.random.split(k_enc, num_clients)
     gp = replicate_on(mesh, global_params)
-    part, pois = _mask_inputs(num_clients, participation, poison, pad_idx)
     hhe_keys = None
     if hhe is not None:
         from hefl_tpu.hhe.cipher import derive_client_keys
@@ -441,6 +489,96 @@ def produce_uploads(
         hhe_keys = jnp.asarray(
             derive_client_keys(hhe.key_seed, num_clients)
         )
+    if cohort is not None:
+        cohort = np.asarray(cohort, dtype=np.int64)
+        if len(cohort) > num_clients or (
+            len(cohort)
+            and (int(cohort.min()) < 0 or int(cohort.max()) >= num_clients)
+        ):
+            # An oversized or out-of-range cohort cannot have come from
+            # the sampler — training phantom client slots would silently
+            # corrupt the aggregate's denominator; fail loudly instead.
+            raise ValueError(
+                f"produce_uploads: cohort of {len(cohort)} with indices in "
+                f"[{cohort.min() if len(cohort) else 0}, "
+                f"{cohort.max() if len(cohort) else 0}] does not fit the "
+                f"{num_clients} registered clients"
+            )
+    if cohort is not None and len(cohort) < num_clients:
+        # Cohort-only training (ISSUE 15): gather the sampled slots, pad
+        # to the bucket, train ONLY those. `gidx` indexes REAL client
+        # rows (< num_clients), so it is valid on pre-padded federated
+        # arrays too — the dummy-padding rows at the tail are never
+        # touched and the two padding schemes cannot interact.
+        from hefl_tpu.fl.client import hoist_streams
+        from hefl_tpu.fl.fusion import resolve_fusion_backend
+
+        if not hoist_streams(
+            cfg, resolve_fusion_backend(cfg.client_fusion, module)
+        ):
+            # The nested flat_scan=False layout derives its shuffle sort
+            # INSIDE the sharded region, where XLA can couple it across
+            # devices (see client.epoch_index_streams) — a cohort gather
+            # changes client placement, so the committed aggregate could
+            # silently diverge bitwise from the full-C reference. Refuse
+            # rather than diverge.
+            raise ValueError(
+                "cohort-only training requires the hoisted shuffle "
+                "streams (TrainConfig.flat_scan=True — the default — or "
+                "the fused backend): the nested scan layout's in-body "
+                "shuffle sort is placement-coupled under sharding; set "
+                "flat_scan=True or StreamConfig.cohort_only=False"
+            )
+        n_c = len(cohort)
+        bucket = cohort_bucket(n_c, num_clients, n_dev)
+        gidx = cohort_gather_index(cohort, bucket)
+        part_full = (
+            np.ones(num_clients, np.int32)
+            if participation is None
+            else np.asarray(participation).astype(np.int32).reshape(
+                num_clients
+            )
+        )
+        pois_full = (
+            np.zeros(num_clients, np.int32)
+            if poison is None
+            else np.asarray(poison).astype(np.int32).reshape(num_clients)
+        )
+        part_g = part_full[gidx].copy()
+        pois_g = pois_full[gidx].copy()
+        part_g[n_c:] = 0    # bucket padding: scheduled out, never ships
+        pois_g[n_c:] = 0
+        train_keys, enc_keys = train_keys[gidx], enc_keys[gidx]
+        if dp_keys is not None:
+            dp_keys = dp_keys[gidx]
+        if hhe_keys is not None:
+            hhe_keys = hhe_keys[gidx]
+        xs, ys = xs[gidx], ys[gidx]
+        fn = _build_upload_fn(
+            module, cfg, mesh, ctx, dp, num_clients, packing, hhe is not None
+        )
+        args = (gp, pk, xs, ys, train_keys, enc_keys)
+        if dp is not None:
+            args = args + (dp_keys,)
+        args = args + (jnp.asarray(part_g), jnp.asarray(pois_g))
+        if hhe is not None:
+            args = args + (hhe_keys, jnp.uint32(round_index))
+        cts, mets, overflow, bits = fn(*args)
+        if hhe is not None:
+            w_hi, w_lo = cts
+            return (
+                (w_hi[:n_c], w_lo[:n_c]),
+                mets[:n_c],
+                overflow[:n_c],
+                bits[:n_c],
+            )
+        return (
+            Ciphertext(c0=cts.c0[:n_c], c1=cts.c1[:n_c], scale=cts.scale),
+            mets[:n_c],
+            overflow[:n_c],
+            bits[:n_c],
+        )
+    part, pois = _mask_inputs(num_clients, participation, poison, pad_idx)
     if pad_idx is not None:
         train_keys, enc_keys = train_keys[pad_idx], enc_keys[pad_idx]
         if dp_keys is not None:
@@ -474,6 +612,107 @@ def produce_uploads(
         mets[:num_clients],
         overflow[:num_clients],
         bits[:num_clients],
+    )
+
+
+def cohort_compare_record(
+    module,
+    cfg: TrainConfig,
+    mesh,
+    ctx,
+    pk,
+    global_params,
+    xs,
+    ys,
+    key,
+    num_clients: int,
+    cohort_size: int,
+    seed: int = 0,
+) -> dict:
+    """Timed full-C-vs-cohort-only producer comparison (ISSUE 15) — the
+    `cohort_compare` record bench.py / profile_round.py artifacts embed
+    and run_perf_smoke.sh schema-gates.
+
+    Both runs produce the SAME sampled cohort's uploads: the full-C run
+    trains every registered slot with unsampled clients masked (the
+    historical path), the cohort run gathers the cohort bucket first.
+    Speedup is warm steady-state wall clock; `bitwise_equal` folds the
+    cohort's uploads from both producers into `OnlineAccumulator`s and
+    hash-compares the sums — the committed-aggregate equality shipped as
+    artifact evidence, not just a test assertion.
+    """
+    from hefl_tpu.fl.fedavg import cohort_bucket as _bucket
+    from hefl_tpu.utils.roofline import steady_seconds
+
+    s = StreamConfig(cohort_size=cohort_size, seed=seed)
+    cohort = sample_cohort(s, 0, num_clients)
+    in_cohort = np.zeros(num_clients, dtype=bool)
+    in_cohort[cohort] = True
+    part = in_cohort.astype(np.int32)
+
+    last: dict = {}   # the timed closures' final outputs, kept for the
+                      # hash gate below — no extra producer executions
+
+    def run(tag, cohort_arg):
+        cts = produce_uploads(
+            module, cfg, mesh, ctx, pk, global_params, xs, ys, key,
+            participation=part, cohort=cohort_arg,
+        )[0]
+        last[tag] = cts
+        return cts.c0
+
+    t_full = steady_seconds(lambda: run("full", None))
+    t_cohort = steady_seconds(lambda: run("cohort", cohort))
+    cts_full = last["full"]
+    cts_coh = last["cohort"]
+    acc_full = OnlineAccumulator(ctx.ntt.p)
+    acc_coh = OnlineAccumulator(ctx.ntt.p)
+    f0, f1 = np.asarray(cts_full.c0), np.asarray(cts_full.c1)
+    g0, g1 = np.asarray(cts_coh.c0), np.asarray(cts_coh.c1)
+    for i, c in enumerate(cohort):
+        acc_full.fold((int(c), 0), f0[c], f1[c])
+        acc_coh.fold((int(c), 0), g0[i], g1[i])
+    bitwise_equal = ct_hash(*acc_full.value()) == ct_hash(*acc_coh.value())
+    n_dev = client_mesh_size(mesh)
+    return {
+        "num_clients": int(num_clients),
+        "cohort_size": int(len(cohort)),
+        "bucket": int(_bucket(len(cohort), num_clients, n_dev)),
+        "full_c_train_s": round(t_full, 6),
+        "cohort_train_s": round(t_cohort, 6),
+        "speedup": round(t_full / t_cohort, 3),
+        "devices_per_axis": {
+            "clients": int(n_dev),
+            "ct": int(ct_shard_count(mesh)),
+        },
+        "bitwise_equal": bool(bitwise_equal),
+    }
+
+
+def cohort_compare_smoke_record() -> dict:
+    """The FIXED cohort_compare geometry bench.py and profile_round.py
+    both embed and run_perf_smoke.sh stage (n) gates: 16 registered
+    clients, cohort of 2, mnist/smallcnn on a tiny ring (the record
+    measures TRAIN scaling, not HE ring cost). Single-sourced here so
+    the two drivers cannot silently measure different configurations."""
+    import jax
+
+    from hefl_tpu.ckks.keys import CkksContext, keygen
+    from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+    from hefl_tpu.models import create_model
+    from hefl_tpu.parallel import make_mesh
+
+    module, params = create_model("smallcnn", rng=jax.random.key(7))
+    (x, y), _, _ = make_dataset("mnist", seed=0, n_train=64, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(len(x), 16))
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(77))
+    cfg = TrainConfig(epochs=1, batch_size=8, num_classes=10,
+                      augment=False, val_fraction=0.25)
+    return cohort_compare_record(
+        module, cfg, make_mesh(16), ctx, pk, params,
+        jnp.asarray(xs), jnp.asarray(ys), jax.random.key(78),
+        num_clients=16, cohort_size=2,
     )
 
 
@@ -622,7 +861,7 @@ class StreamEngine:
 
     def _transcipher_round(
         self, ctx, pk, packing, uploads, key, round_index, num_clients,
-        dp, hhe, journaled: bool,
+        dp, hhe, journaled: bool, client_ids=None,
     ):
         """Provision pads + transcipher the round's symmetric uploads.
 
@@ -633,10 +872,15 @@ class StreamEngine:
         journaled symmetric bodies re-transcipher to bitwise the live
         residues. The _HheRound host copies (symmetric words + pad
         residues, a full round-sized transfer) exist only for the journal;
-        `journaled=False` skips them and returns None. Runs under the
-        public key only: the authority wraps client master keys, the
-        server sees ciphertexts of keystreams, and nobody outside the
-        client holds its key in the clear (README "Hybrid HE uplink")."""
+        `journaled=False` skips them and returns None. `client_ids`
+        (cohort-only rounds, ISSUE 15) maps each upload row to its REAL
+        client index: per-client master keys and pad randomness are
+        derived at the full registry count and gathered, so a cohort
+        row's pad is bitwise the full-C round's — the transcipher parity
+        holds under cohort gathering too. Runs under the public key only:
+        the authority wraps client master keys, the server sees
+        ciphertexts of keystreams, and nobody outside the client holds
+        its key in the clear (README "Hybrid HE uplink")."""
         from hefl_tpu.hhe import cipher as hhe_cipher
         from hefl_tpu.hhe import transcipher as hhe_transcipher
 
@@ -647,6 +891,10 @@ class StreamEngine:
         else:
             _, k_enc, _ = jax.random.split(key, 3)
         enc_keys = jax.random.split(k_enc, num_clients)
+        if client_ids is not None:
+            ids = np.asarray(client_ids, dtype=np.int64)
+            keys = np.asarray(keys)[ids]
+            enc_keys = enc_keys[jnp.asarray(ids)]
         tc, pad = hhe_transcipher.transcipher_batch(
             ctx, packing, pk, jnp.asarray(w_hi_dev), jnp.asarray(w_lo_dev),
             keys, round_index, enc_keys,
@@ -659,7 +907,7 @@ class StreamEngine:
                 ctx=ctx,
             )
         obs_metrics.counter("hhe.uploads_transciphered").inc(
-            int(num_clients)
+            int(np.asarray(w_hi_dev).shape[0])
         )
         obs_metrics.gauge("hhe.upload_bytes").set(
             hhe_cipher.sym_wire_bytes(packing)
@@ -689,7 +937,9 @@ class StreamEngine:
         """-> (Ciphertext sum, metrics [C, E, 4], overflow [C],
         StreamRoundMeta). meta.meta.surviving is the decode denominator;
         0 (or committed=False) means nothing was released this round and
-        the driver keeps the global model.
+        the driver keeps the global model. Under cohort-only training
+        (StreamConfig.cohort_only, the default) metrics/overflow rows of
+        unsampled clients are zeros — those clients trained nothing.
 
         `session` (fl.journal.RoundSession, optional) is the durability
         hook: every engine transition is journaled through it (live mode)
@@ -809,12 +1059,23 @@ class StreamEngine:
             else None
         )
 
-        cts, mets, overflow, bits_dev = produce_uploads(
+        # Cohort-only training (ISSUE 15, StreamConfig.cohort_only): when
+        # the cohort is a strict subset of the registry, only its client
+        # slots are gathered and trained (bucket-padded — see
+        # produce_uploads); outputs come back COHORT-ROWED and `row_of`
+        # maps client index -> upload row. A full cohort (cohort_size=0 /
+        # >= C) keeps the historical full-C shapes bit-for-bit.
+        use_cohort = bool(s.cohort_only) and len(cohort) < num_clients
+        cts, mets_dev, overflow_dev, bits_dev = produce_uploads(
             module, cfg, mesh, ctx, pk, global_params, xs, ys, key,
             participation=part, poison=pois, dp=dp,
             num_real_clients=num_real_clients, packing=packing,
             hhe=hhe if hhe_mode else None, round_index=round_index,
+            cohort=cohort if use_cohort else None,
         )
+        rows = cohort if use_cohort else np.arange(num_clients)
+        row_of = np.full(num_clients, -1, dtype=np.int64)
+        row_of[rows] = np.arange(len(rows))
         hhe_rd = None
         if hhe_mode:
             # Server-side transciphering (hhe.transcipher): the arrived
@@ -824,8 +1085,29 @@ class StreamEngine:
             hhe_rd, cts = self._transcipher_round(
                 ctx, pk, packing, cts, key, round_index, num_clients, dp,
                 hhe, journaled=session is not None,
+                client_ids=rows if use_cohort else None,
             )
-        bits = np.asarray(bits_dev).astype(np.int64).copy()
+        if use_cohort:
+            # Scatter the cohort rows back to registry-indexed metadata:
+            # metrics/overflow/bits for unsampled clients are zeros (they
+            # trained nothing — that is the point), and `surviving` can
+            # only ever count folded cohort rows, so cohort padding and
+            # mesh dummy padding cannot double-count.
+            m_rows = np.asarray(mets_dev)
+            mets = np.zeros(
+                (num_clients,) + m_rows.shape[1:], m_rows.dtype
+            )
+            mets[rows] = m_rows
+            ov_rows = np.asarray(overflow_dev)
+            overflow = np.zeros(
+                (num_clients,) + ov_rows.shape[1:], ov_rows.dtype
+            )
+            overflow[rows] = ov_rows
+            bits = np.zeros(num_clients, np.int64)
+            bits[rows] = np.asarray(bits_dev).astype(np.int64)
+        else:
+            mets, overflow = mets_dev, overflow_dev
+            bits = np.asarray(bits_dev).astype(np.int64).copy()
         # The program's sanitizer verdict, immutable: the arrival-time
         # reject predicate must read THIS, not the attribution copy below
         # (a stale fold clears a client's attribution, and that must never
@@ -835,7 +1117,7 @@ class StreamEngine:
         # client "scheduled"; a client that simply was not sampled this
         # round is attributed "unsampled" instead (not a fault).
         bits[~in_cohort] = EXCLUDED_UNSAMPLED
-        c0 = np.asarray(cts.c0)
+        c0 = np.asarray(cts.c0)     # cohort-rowed when use_cohort
         c1 = np.asarray(cts.c1)
         row_shape = c0.shape[1:]
 
@@ -972,12 +1254,13 @@ class StreamEngine:
                     session.reject(round_index, ev.seq, c, ev.nonce)
                 rejected += 1
                 continue
+            row = int(row_of[c])    # upload row (== c on the full-C path)
             if (
                 committed_at is None
                 and (ev.t <= deadline or ev.retried)
                 and headroom_ok
             ):
-                fc0, fc1 = c0[c], c1[c]
+                fc0, fc1 = c0[row], c1[row]
                 if session is not None:
                     # Persist the arrived upload; on replay the session
                     # hands back the JOURNAL's bytes (content-hash
@@ -991,17 +1274,17 @@ class StreamEngine:
                         # against the re-derived pad: bitwise the live
                         # fold's residues (deterministic pads + the
                         # backend parity gate).
-                        wh, wl = hhe_rd.w_hi[c], hhe_rd.w_lo[c]
+                        wh, wl = hhe_rd.w_hi[row], hhe_rd.w_lo[row]
                         rh, rl = session.fold(
                             round_index, ev.seq, "fresh", c, ev.nonce, 0,
                             ev.t, wh, wl, persist=True,
                         )
                         if rh is not wh:
-                            fc0, fc1 = hhe_rd.retranscipher(c, rh, rl)
+                            fc0, fc1 = hhe_rd.retranscipher(row, rh, rl)
                     else:
                         fc0, fc1 = session.fold(
                             round_index, ev.seq, "fresh", c, ev.nonce, 0,
-                            ev.t, c0[c], c1[c], persist=True,
+                            ev.t, c0[row], c1[row], persist=True,
                         )
                 acc.fold(ev.nonce, fc0, fc1)
                 fresh += 1
@@ -1018,7 +1301,7 @@ class StreamEngine:
                         round_index, ev.seq, "fresh", c, ev.nonce, ev.t, 0
                     )
                 missed.append((
-                    "fresh", c, ev.t, 0, c0[c], c1[c], ev.nonce,
+                    "fresh", c, ev.t, 0, c0[row], c1[row], ev.nonce,
                 ))
         committed = committed_at is not None
         commit_s = (
@@ -1101,10 +1384,11 @@ class StreamEngine:
             for c, t in fresh_used:
                 bits[c] |= EXCLUDED_TIMEOUT
                 if tau >= 1:
+                    r_c = int(row_of[c])
                     pending_next.append(PendingUpload(
                         client=int(c), origin_round=int(round_index),
                         nonce=(int(c), int(round_index)),
-                        c0=np.array(c0[c]), c1=np.array(c1[c]),
+                        c0=np.array(c0[r_c]), c1=np.array(c1[r_c]),
                         lands_at=max(0.0, float(t) - float(commit_s)),
                         lateness=1,
                     ))

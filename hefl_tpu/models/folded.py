@@ -30,6 +30,17 @@ batched GEMM never mixes batch groups), so fused-vs-vmap equivalence is a
 float-tolerance property, not an approximation (tests/test_perf.py pins
 it).
 
+Width stability (ISSUE 15): at any client count >= 2 these primitives —
+and the grouped-conv forms the vmap backend lowers to — produce BITWISE
+identical per-client floats regardless of how many clients share the
+batch (the per-group/per-batch-entry math is width-independent), while a
+width of exactly 1 takes XLA's ungrouped lowering, a different algorithm
+with different rounding. The cohort bucket ladder
+(`fl.fedavg.cohort_bucket`) floors buckets at 2 slots per device so
+cohort-only training and the full-C reference always sit on the same
+side of that line — the structural half of the cohort-vs-full bitwise
+equality gates (tests/test_cohort.py pins it on both backends).
+
 Layout contract shared by every primitive:
 
   * folded activations: [C*B, ...] with client c owning the contiguous

@@ -16,10 +16,12 @@ from hefl_tpu.parallel.mesh import (
     HOST_AXIS,
     client_axes,
     client_mesh_size,
+    ct_shard_count,
     local_client_count,
     make_ct_mesh,
     make_host_mesh,
     make_mesh,
+    make_mesh_2d,
     shard_map,
 )
 from hefl_tpu.parallel.collectives import (
@@ -36,7 +38,9 @@ __all__ = [
     "make_ct_mesh",
     "client_axes",
     "client_mesh_size",
+    "ct_shard_count",
     "make_mesh",
+    "make_mesh_2d",
     "make_host_mesh",
     "shard_map",
     "local_client_count",

@@ -58,12 +58,14 @@ def psum_mod(residues: jax.Array, p: jax.Array, axis_name: str) -> jax.Array:
 
 
 def exact_int_probes() -> dict:
-    """Shaped jaxpr probe of the modular all-reduce (ISSUE 8,
+    """Shaped jaxpr probes of the modular all-reduce (ISSUE 8,
     analysis.lint): the whole collective — psum plus the Barrett
-    canonicalization — must stay rem/div- and float-free."""
+    canonicalization — must stay rem/div- and float-free, on the 1-D
+    client mesh AND on the 2-D ("clients", "ct") mesh (ISSUE 15), where
+    the same collective runs on ct-sharded ciphertext rows."""
     import numpy as np
 
-    from hefl_tpu.parallel import make_mesh, shard_map
+    from hefl_tpu.parallel import make_mesh, make_mesh_2d, shard_map
     from jax.sharding import PartitionSpec as P
 
     p = jnp.asarray(np.full((1, 1), 2**27 - 39, np.uint32))
@@ -75,8 +77,19 @@ def exact_int_probes() -> dict:
         out_specs=P(),
         check_vma=False,
     )
+    mesh2d = make_mesh_2d(1, 1)
+    fn2d = shard_map(
+        lambda x: psum_mod(x, p, "clients"),
+        mesh=mesh2d,
+        in_specs=P("clients", "ct"),
+        out_specs=P(None, "ct"),
+        check_vma=False,
+    )
     x = jnp.zeros((1, 1, 8), jnp.uint32)
-    return {"parallel.collectives.psum_mod": (fn, (x,))}
+    return {
+        "parallel.collectives.psum_mod": (fn, (x,)),
+        "parallel.collectives.psum_mod_2d": (fn2d, (x,)),
+    }
 
 
 def psum_range_probe(prime: int):
@@ -97,6 +110,32 @@ def psum_range_probe(prime: int):
         mesh=mesh,
         in_specs=P("clients"),
         out_specs=P(),
+        check_vma=False,
+    )
+    x = jnp.zeros((1, 1, 8), jnp.uint32)
+    return fn, (x,)
+
+
+def psum_range_probe_2d(prime: int):
+    """Range probe of the 2-D round's aggregation tail (ISSUE 15): the
+    SAME lazy psum accumulation as `psum_range_probe`, traced over a
+    ("clients", "ct") mesh with the ciphertext-row axis sharded over
+    ``"ct"`` — the shape `analysis.ranges.certify_aggregation` analyzes
+    with worst-case sizes injected on BOTH axes, so the cohort-bucketed
+    psum bound is proven on the topology the 2-D round actually runs, not
+    extrapolated from the 1-D trace. Only the ``"clients"`` axis is
+    reduced over; the injected ``"ct"`` worst case proves the bound is
+    ct-shard-count-independent (sharding partitions rows, it never adds
+    summands)."""
+    from hefl_tpu.parallel import make_mesh_2d, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh_2d(1, 1)
+    fn = shard_map(
+        lambda x: jax.lax.psum(x, "clients"),
+        mesh=mesh,
+        in_specs=P("clients", "ct"),
+        out_specs=P(None, "ct"),
         check_vma=False,
     )
     x = jnp.zeros((1, 1, 8), jnp.uint32)

@@ -1,6 +1,6 @@
 """Mesh construction for the one-client-per-device FL topology.
 
-Two shapes:
+Three shapes:
 
   * `make_mesh` — the flat 1-D "clients" mesh (one pod slice, clients over
     ICI). This is the default topology for every single-host experiment.
@@ -10,9 +10,24 @@ Two shapes:
     hop per round. The reference's analog of "many machines exchanging
     pickle files" (SURVEY.md §2.13) — here the exchange IS the hierarchical
     collective.
+  * `make_mesh_2d` — a 2-D ("clients", "ct") mesh (ISSUE 15): the client
+    axis shards the cohort's training blocks, and the ``"ct"`` axis shards
+    the [n_ct, L, N] ciphertext rows of the in-round encrypt core *within*
+    each client block (fl.secure's `_ct_sharded_encrypt_core`). With
+    cohort-only training the client axis is small (the cohort bucket, not
+    the registry), so the leftover devices go to HE row throughput instead
+    of idling. The client axis is laid out outer/slowest so a multi-host
+    `pjit` deployment keeps each host's client block local (host-local
+    cohort gather) and crosses DCN only for the psum of ciphertext sums.
+
+`HEFL_MESH_CT=K` (K > 1) makes `make_mesh` return the 2-D shape with K
+ct-shards per client block — the CI knob that re-runs whole suites on the
+(clients, ct) topology without touching each call site.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import numpy as np
@@ -67,10 +82,54 @@ def make_mesh(num_clients: int, devices: list | None = None) -> Mesh:
     mesh is fine: the round engines pad the client axis with masked-out
     dummy clients (fl.fedavg.pad_index), so any client count runs on any
     mesh.
+
+    With `HEFL_MESH_CT=K` (K > 1) the same call returns the 2-D
+    ("clients", "ct") mesh instead — every round program built through
+    here then shards its in-round HE rows K ways (bitwise-identical
+    results; see `make_mesh_2d`). The env knob exists so CI can re-run the
+    stream/secure suites on the 2-D topology unmodified.
     """
     devs = list(devices if devices is not None else jax.devices())
+    ct = int(os.environ.get("HEFL_MESH_CT", "0") or 0)
+    if ct > 1:
+        return make_mesh_2d(num_clients, ct, devices=devs)
     n = min(num_clients, len(devs))
     return Mesh(np.array(devs[:n]), (CLIENT_AXIS,))
+
+
+def make_mesh_2d(
+    num_clients: int, ct_shards: int, devices: list | None = None
+) -> Mesh:
+    """2-D ("clients", "ct") mesh: client blocks x in-round ciphertext
+    shards (ISSUE 15).
+
+    Rows (the client axis) take min(num_clients, n_devices // ct_shards)
+    devices; each row's `ct_shards` devices split that block's [n_ct, L, N]
+    ciphertext rows inside the round program (`fl.secure`). Training is
+    sharded over the client axis only — each ct column of a row computes
+    the same (deterministic) training block, so the wall-clock cost equals
+    the row-count 1-D mesh while the NTT-heavy encrypt core runs
+    `ct_shards`-way parallel. A `ct_shards` that exceeds the device count
+    is clamped (never fail on a smaller box); at least one client row
+    always exists.
+    """
+    if ct_shards < 1:
+        raise ValueError(f"make_mesh_2d: ct_shards={ct_shards} must be >= 1")
+    devs = list(devices if devices is not None else jax.devices())
+    ct = min(int(ct_shards), len(devs))
+    rows = max(1, min(num_clients, len(devs) // ct))
+    need = rows * ct
+    return Mesh(
+        np.array(devs[:need]).reshape(rows, ct), (CLIENT_AXIS, CT_AXIS)
+    )
+
+
+def ct_shard_count(mesh: Mesh) -> int:
+    """In-round ciphertext shards this mesh provides (1 on the 1-D and
+    ("hosts", "clients") meshes — the historical replicated-HE layout)."""
+    if CT_AXIS in mesh.axis_names:
+        return int(mesh.shape[CT_AXIS])
+    return 1
 
 
 def make_host_mesh(

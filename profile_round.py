@@ -268,6 +268,23 @@ def main(argv: list[str] | None = None) -> None:
         f"{t_decrypt_core_packed:.3f}s "
         f"({t_decrypt_core / t_decrypt_core_packed:.2f}x)")
 
+    # Cohort-only vs full-C training producer (ISSUE 15): the
+    # `cohort_compare` record at the FIXED cohort-2-of-16 smoke geometry
+    # (single-sourced with bench.py in
+    # fl.stream.cohort_compare_smoke_record) — full-C-masked vs
+    # cohort-gathered train seconds, bucket chosen, devices per axis,
+    # and the committed-aggregate hash equality as `bitwise_equal`.
+    # run_perf_smoke.sh gates the schema and a >= 2x speedup floor.
+    from hefl_tpu.fl.stream import cohort_compare_smoke_record
+
+    cohort_rec = cohort_compare_smoke_record()
+    log(
+        f"cohort_compare (C=16, cohort=2, bucket {cohort_rec['bucket']}): "
+        f"full-C {cohort_rec['full_c_train_s']:.3f}s vs cohort-only "
+        f"{cohort_rec['cohort_train_s']:.3f}s = {cohort_rec['speedup']}x, "
+        f"bitwise_equal={cohort_rec['bitwise_equal']}"
+    )
+
     # Augment backend shootout at the training batch shape (always the
     # flagship 256x256 image — augment cost is what this PR attacks, so
     # the row must stay comparable across configs). The per-device winner
@@ -522,6 +539,10 @@ def main(argv: list[str] | None = None) -> None:
         # unpacked he_in_round / standalone HE timings + uplink bytes.
         "packing": packing_rec,
         "bytes_on_wire": bytes_on_wire,
+        # Cohort-only training rows (ISSUE 15): full-C-masked vs
+        # cohort-gathered producer seconds, the bucket chosen, devices
+        # per mesh axis, and the committed-aggregate hash equality.
+        "cohort_compare": cohort_rec,
         # Process-wide observability counters (obs.metrics): compile
         # count, autoselect outcomes, memory high-water.
         "obs_metrics": obs_metrics.snapshot(),

@@ -49,9 +49,17 @@
 #       hhe wire record must show <= 1.1x expansion, final params must be
 #       finite and the accuracy within tolerance of the synchronous
 #       faulted run.
+#   (j) cohort-only training twin (ISSUE 15): the streaming fault
+#       schedule with a sampled cohort of 6-of-8, run through the
+#       cohort-only producer (just the sampled slots gathered + trained)
+#       AND the full-C producer. Every round must commit in both, the
+#       unsampled exclusions must equal C - cohort each round, and the
+#       two runs' final params must be BITWISE equal — the cohort gather
+#       cannot change a single committed bit under the full chaos
+#       schedule.
 # Artifact: CHAOS_SMOKE.json (accuracy curves + per-round exclusions
-# + the events.jsonl cross-checks, streaming + crash-recovery + HHE
-# twins included).
+# + the events.jsonl cross-checks, streaming + crash-recovery + HHE +
+# cohort-only twins included).
 # Wired into run_tpu_suite.sh as stage 0b (CPU-only, no TPU probe needed).
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -500,6 +508,87 @@ if hevs:
         ),
     }
 
+# (j) cohort-only streaming twin (ISSUE 15): the SAME streaming fault
+# schedule with a sampled cohort (6 of 8; quorum scales to the cohort),
+# run cohort-only (the default: just the cohort's slots gathered and
+# trained) AND with the full-C producer (--full-cohort-train semantics).
+# Gates: every round commits in both, the per-round unsampled exclusions
+# equal C - cohort, and the two runs' final params are BITWISE equal —
+# the committed-aggregate equality of the cohort gather, at experiment
+# level, under the full chaos schedule.
+from hefl_tpu.fl import StreamConfig as _SC15
+
+cohort_stream = _SC15(
+    cohort_size=6, quorum=0.3, deadline_s=2.0, max_retries=1,
+    staleness_rounds=1, seed=0, cohort_only=True,
+)
+cohort_cfg = dataclasses.replace(
+    stream_cfg, events_path="", stream=cohort_stream,
+)
+fullc_cfg = dataclasses.replace(
+    cohort_cfg,
+    stream=dataclasses.replace(cohort_stream, cohort_only=False),
+)
+print("chaos smoke: cohort-only streaming twin (cohort 6/8) ...", flush=True)
+cohort_run = run_experiment(cohort_cfg, verbose=False)
+print("chaos smoke: full-C-trained cohort twin ...", flush=True)
+fullc_run = run_experiment(fullc_cfg, verbose=False)
+
+cohort_summary = {}
+cohort_bitwise = True
+for a, b in zip(
+    _jax_s.tree_util.tree_leaves(cohort_run["params"]),
+    _jax_s.tree_util.tree_leaves(fullc_run["params"]),
+):
+    if not np.array_equal(np.asarray(a), np.asarray(b)):
+        cohort_bitwise = False
+        fail.append(
+            "cohort-only twin's final params differ bitwise from the "
+            "full-C-trained twin at the same sampled cohorts"
+        )
+        break
+for r, (rec_c, rec_f) in enumerate(
+    zip(cohort_run["history"], fullc_run["history"])
+):
+    for name, rec_ in (("cohort-only", rec_c), ("full-C", rec_f)):
+        st = rec_.get("stream") or {}
+        if not st.get("committed"):
+            fail.append(f"cohort twin ({name}) round {r}: did not commit")
+    rob = rec_c.get("robust") or {}
+    unsampled = (rob.get("excluded") or {}).get("unsampled")
+    # Exactly C - cohort in round 0; later rounds may be lower because a
+    # STALE fold from a client outside the current cohort legitimately
+    # clears its unsampled attribution (it participated via its carry).
+    want_unsampled = cfg.num_clients - 6
+    bad = (
+        unsampled != want_unsampled if r == 0 else
+        unsampled is None or unsampled > want_unsampled
+    )
+    if bad:
+        fail.append(
+            f"cohort twin round {r}: unsampled exclusions {unsampled} "
+            f"inconsistent with C - cohort = {want_unsampled}"
+        )
+    if rec_c.get("stream") != rec_f.get("stream"):
+        fail.append(
+            f"cohort twin round {r}: stream record diverged between the "
+            "cohort-only and full-C producers"
+        )
+for leaf in _jax_s.tree_util.tree_leaves(cohort_run["params"]):
+    if not np.all(np.isfinite(np.asarray(leaf))):
+        fail.append("cohort-only twin's final params contain non-finite values")
+        break
+cohort_summary = {
+    "cohort_size": 6,
+    "num_clients": cfg.num_clients,
+    "bitwise_equal_to_full_c": cohort_bitwise,
+    "acc_cohort_by_round": [h["accuracy"] for h in cohort_run["history"]],
+    "rounds_committed": [
+        r for r, h in enumerate(cohort_run["history"])
+        if (h.get("stream") or {}).get("committed")
+    ],
+}
+
 # (h) crash-recovery twin (ISSUE 9): the streaming schedule under the
 # write-ahead journal, killed mid-journal-append in round 1 (leaving a
 # REAL torn record), then recovered by simply re-running the config. No
@@ -632,6 +721,9 @@ artifact = {
     # The hybrid-HE twin's cross-check (stream counters vs the schedule
     # + the wire-expansion record).
     "hhe_check": hhe_summary,
+    # The cohort-only twin's cross-check (bitwise equality vs the full-C
+    # producer + unsampled attribution, ISSUE 15).
+    "cohort_check": cohort_summary,
     "passed": not fail,
     "failures": fail,
 }
@@ -652,8 +744,10 @@ print(
     "fault schedule, streaming rounds all committed at quorum, the "
     "mid-append-killed server recovered to the bitwise state of its "
     "uninterrupted twin (commit sha chain + params identical, recovery "
-    "counters == injected schedule), and the hybrid-HE twin committed "
+    "counters == injected schedule), the hybrid-HE twin committed "
     f"every round at {hrec.get('expansion_hhe') if isinstance(hrec, dict) else '?'}x "
-    "wire expansion with counters matching the same schedule"
+    "wire expansion with counters matching the same schedule, and the "
+    "cohort-only twin (6/8) committed every round bitwise-equal to its "
+    "full-C-trained twin"
 )
 PY

@@ -56,7 +56,13 @@
 #       row, the certify_keyswitch gadget certificates alongside the
 #       ladder ones, the he_backend record, and a batched-vs-single
 #       serving speedup (slot-packed + ct-batched BSGS vs single-query)
-#       clearing the >= 1.3x floor on the CPU smoke.
+#       clearing the >= 1.3x floor on the CPU smoke;
+#   (n) cohort-only training (ISSUE 15): the cohort_compare record
+#       (full-C vs cohort-only producer seconds, bucket chosen, devices
+#       per mesh axis) must be present with bitwise_equal true — the
+#       committed aggregate of the cohort-gathered producer hash-equal to
+#       the full-C masked path — and the cohort-only speedup at
+#       cohort 2-of-16 must clear the >= 2x floor on the CPU smoke.
 # Wired into run_tpu_suite.sh as stage 0 (cheap pre-stage, no backend
 # probe needed — both harnesses pin themselves to CPU in smoke mode).
 set -euo pipefail
@@ -495,6 +501,36 @@ else:
                 "the interleave factor promises"
             )
 
+    # (n) cohort-only training (ISSUE 15): schema + bitwise equality +
+    # the >= 2x cohort 2-of-16 speedup floor.
+    cc = rec.get("cohort_compare")
+    if not isinstance(cc, dict):
+        fail.append("profile: missing cohort_compare record")
+    else:
+        for field in ("num_clients", "cohort_size", "bucket",
+                      "full_c_train_s", "cohort_train_s", "speedup",
+                      "devices_per_axis", "bitwise_equal"):
+            if cc.get(field) is None:
+                fail.append(f"profile: cohort_compare.{field} missing/null")
+        if cc.get("bitwise_equal") is not True:
+            fail.append(
+                "profile: cohort-only committed aggregate is NOT hash-equal "
+                "to the full-C masked path (cohort_compare.bitwise_equal)"
+            )
+        sp_c = cc.get("speedup")
+        if isinstance(sp_c, (int, float)) and sp_c < 2.0:
+            fail.append(
+                f"profile: cohort-only speedup {sp_c}x at cohort 2-of-16 is "
+                "below the 2x floor (training 2 slots instead of 16 should "
+                "amortize far more than this)"
+            )
+        dpa = cc.get("devices_per_axis")
+        if not isinstance(dpa, dict) or not {"clients", "ct"} <= set(dpa):
+            fail.append(
+                "profile: cohort_compare.devices_per_axis missing the "
+                "clients/ct axes"
+            )
+
     # (g) no unflagged utilization > 1.0 anywhere in the artifact.
     def scan_utils(node, path="rec"):
         if isinstance(node, dict):
@@ -563,7 +599,8 @@ print(
     "trace_attribution from one program agrees with the traced wall "
     "clock, no unflagged utilization > 1, events.jsonl schema valid, "
     "packing + bytes_on_wire rows present with the k-fold reduction and "
-    ">=1.5x HE speedups, hefl-lint clean with analysis.violations=0 "
+    ">=1.5x HE speedups, cohort_compare bitwise-equal with the >=2x "
+    "cohort-only floor, hefl-lint clean with analysis.violations=0 "
     "embedded in the run metrics"
 )
 PY

@@ -90,6 +90,17 @@ t0=$SECONDS
 HEFL_NTT=pallas-interpret HEFL_HE=pallas python -m pytest -q \
   tests/test_he_inference.py
 echo "== serving shard (pallas-interpret, HEFL_HE=pallas): $((SECONDS - t0))s"
+# 2-D mesh shard (ISSUE 15): the stream + secure suites (and the cohort
+# suite itself) re-run on the virtual 8-device ("clients", "ct") = (2, 4)
+# topology via the HEFL_MESH_CT knob — every bitwise gate (streaming-vs-
+# batched hash equality, masked-round parity, cohort-only equality) then
+# exercises the ct-sharded encrypt core and the 2-D psum tail. The fast
+# tier covers the 1-D mesh everywhere, so both topologies get CI coverage
+# without doubling the suite.
+t0=$SECONDS
+HEFL_MESH_CT=4 python -m pytest -q -m "not slow" \
+  tests/test_stream.py tests/test_secure.py tests/test_cohort.py
+echo "== 2-D (2 clients, 4 ct) mesh shard: $((SECONDS - t0))s"
 # Journal/durability shard (ISSUE 9): the write-ahead-journal suite —
 # frame codec, torn-tail/chain-break handling, the kill-at-every-boundary
 # recovery matrix — re-run under fsync policy "always", so the maximum-

@@ -1,0 +1,532 @@
+"""Cohort-only training + 2-D (clients, ct) mesh tests (ISSUE 15):
+
+  * the power-of-two cohort bucket ladder (mesh-divisible, capped at the
+    full-C padded shape, loud on oversized cohorts)
+  * cohort-only streaming rounds BITWISE equal to the full-C masked
+    producer at the same sampled cohort — unpacked, packed (k=4), and
+    through the hybrid-HE transcipher — with identical RoundMeta
+    attribution and no padding double-count under `pad_federated`
+  * bucket-ladder compile behavior: cohorts inside one bucket reuse one
+    executable (jax.new_executables == 0 after warmup), crossing a bucket
+    compiles exactly one round's worth, an oversized cohort fails loudly
+  * the 2-D ("clients", "ct") round mesh: secure round + upload producer
+    bitwise-equal to the replicated path at the same client layout,
+    packed and unpacked, on the virtual 8-device mesh
+  * `certify_aggregation`'s 2-D leg (worst-case sizes on both axes) and
+    the `cohort_compare` artifact record
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.ckks.keys import CkksContext, keygen
+from hefl_tpu.ckks.packing import PackedSpec
+from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+from hefl_tpu.fl import (
+    PackingConfig,
+    StreamConfig,
+    StreamEngine,
+    TrainConfig,
+    cohort_bucket,
+    cohort_compare_record,
+    produce_uploads,
+    secure_fedavg_round,
+)
+from hefl_tpu.fl.faults import EXCLUDED_UNSAMPLED
+from hefl_tpu.fl.fedavg import cohort_gather_index, pad_federated
+from hefl_tpu.fl.stream import ct_hash
+from hefl_tpu.models import SmallCNN
+from hefl_tpu.parallel import (
+    ct_shard_count,
+    make_mesh,
+    make_mesh_2d,
+)
+
+CFG = TrainConfig(
+    epochs=1, batch_size=4, num_classes=10, augment=False, val_fraction=0.25
+)
+
+
+def _setup(num_clients, per_client=8, seed=0):
+    n = num_clients * per_client
+    (x, y), _, _ = make_dataset("mnist", seed=seed, n_train=n, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(n, num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    return model, params, jnp.asarray(xs), jnp.asarray(ys)
+
+
+# ------------------------------------------------------------- bucket ladder
+
+
+def test_cohort_bucket_ladder():
+    # next power of two, rounded to a mesh multiple, floored at 2 slots
+    # per device (the grouped-lowering bitwise floor), capped at the
+    # full-C padded shape
+    assert cohort_bucket(1, 16, 1) == 2   # width floor: grouped lowering
+    assert cohort_bucket(2, 16, 1) == 2
+    assert cohort_bucket(3, 16, 1) == 4
+    assert cohort_bucket(5, 16, 1) == 8
+    assert cohort_bucket(9, 16, 1) == 16
+    assert cohort_bucket(15, 16, 1) == 16
+    # mesh-divisible + width floor: a 4-device client axis keeps >= 2
+    # slots per device (8 total) while the full program runs width 4
+    assert cohort_bucket(2, 16, 4) == 8
+    assert cohort_bucket(5, 16, 4) == 8
+    assert cohort_bucket(9, 16, 4) == 16
+    # capped: a bucket never exceeds the full registry's padded shape
+    assert cohort_bucket(3, 6, 4) == 8   # full padded = 8 on 4 devices
+    assert cohort_bucket(6, 6, 4) == 8
+    # width-1 full program (C == n_dev): bucket == full, widths equal
+    assert cohort_bucket(2, 8, 8) == 8
+    with pytest.raises(ValueError, match="registered"):
+        cohort_bucket(17, 16, 1)
+    with pytest.raises(ValueError, match=">= 1"):
+        cohort_bucket(0, 16, 1)
+    # gather index: cohort rows first, client-0 padding after
+    idx = cohort_gather_index([3, 5, 9], 4)
+    np.testing.assert_array_equal(idx, [3, 5, 9, 0])
+
+
+def test_cohort_gather_refuses_unhoisted_nested_layout():
+    # flat_scan=False (the nested semantics-reference layout) derives its
+    # shuffle sort inside the sharded region, where placement coupling is
+    # possible — a cohort gather there must refuse loudly instead of
+    # silently diverging bitwise from the full-C reference.
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(7))
+    nested = dataclasses.replace(CFG, flat_scan=False, client_fusion="vmap")
+    with pytest.raises(ValueError, match="flat_scan"):
+        produce_uploads(
+            model, nested, mesh, ctx, pk, params, xs, ys, jax.random.key(8),
+            cohort=np.array([0, 2]),
+        )
+    # the full-C producer still accepts the nested layout
+    produce_uploads(
+        model, nested, mesh, ctx, pk, params, xs, ys, jax.random.key(8)
+    )
+
+
+def test_oversized_cohort_fails_loudly():
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(7))
+    with pytest.raises(ValueError, match="registered"):
+        produce_uploads(
+            model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(8),
+            cohort=np.arange(6),
+        )
+    with pytest.raises(ValueError, match="registered"):
+        produce_uploads(
+            model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(8),
+            cohort=np.array([1, 9]),
+        )
+
+
+# ------------------------------------------- cohort-only bitwise equality
+
+
+@pytest.mark.parametrize("interleave", [0, 4])
+def test_cohort_only_round_bitwise_equals_full_c(interleave):
+    # The tentpole gate: a cohort-only round (gather + bucket + train the
+    # cohort only) commits BITWISE the same aggregate as the full-C
+    # masked producer at the same sampled cohort, with identical
+    # RoundMeta attribution — unpacked and packed (k=4).
+    num_clients = 8
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(11))
+    packing = None
+    if interleave:
+        pcfg = PackingConfig(
+            bits=8, interleave=interleave, clip=0.5, guard_bits=12
+        )
+        packing = PackedSpec.for_params(params, ctx, pcfg, num_clients)
+    key = jax.random.key(12)
+    outs = {}
+    for cohort_only in (True, False):
+        eng = StreamEngine(
+            StreamConfig(cohort_size=3, seed=4, cohort_only=cohort_only),
+            None,
+        )
+        ct, mets, ov, smeta = eng.run_round(
+            model, CFG, mesh, ctx, pk, params, xs, ys, key, 0,
+            packing=packing,
+        )
+        outs[cohort_only] = (ct_hash(ct.c0, ct.c1), smeta)
+    assert outs[True][0] == outs[False][0]
+    a, b = outs[True][1], outs[False][1]
+    assert a.meta.bits == b.meta.bits
+    assert a.meta.participation == b.meta.participation
+    assert a.meta.surviving == b.meta.surviving == 3
+    assert a.meta.excluded["unsampled"] == num_clients - 3
+    assert a.cohort == b.cohort
+
+
+def test_cohort_only_hhe_transcipher_bitwise():
+    # The hybrid-HE leg: per-client master keys + pad randomness are
+    # derived at the registry count and gathered per cohort row, so the
+    # transciphered fold is bitwise the full-C round's.
+    from hefl_tpu.fl import HheConfig
+
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(21))
+    pcfg = PackingConfig(bits=8, interleave=2, clip=0.5)
+    pspec = PackedSpec.for_params(params, ctx, pcfg, num_clients)
+    key = jax.random.key(22)
+    hashes = {}
+    for cohort_only in (True, False):
+        eng = StreamEngine(
+            StreamConfig(
+                cohort_size=2, seed=3, cohort_only=cohort_only,
+                upload_kind="hhe",
+            ),
+            None,
+        )
+        ct, _, _, smeta = eng.run_round(
+            model, CFG, mesh, ctx, pk, params, xs, ys, key, 0,
+            packing=pspec, hhe=HheConfig(key_seed=0),
+        )
+        assert smeta.committed and smeta.meta.surviving == 2
+        hashes[cohort_only] = ct_hash(ct.c0, ct.c1)
+    assert hashes[True] == hashes[False]
+
+
+def test_cohort_only_prepadded_no_double_count():
+    # ISSUE 15 satellite (f): cohort padding + pad_federated dummy padding
+    # must not double-count. C=6 on a 4-device mesh pre-pads the arrays
+    # to 8 rows; the cohort gather indexes REAL rows only, its bucket
+    # padding is scheduled out, and surviving counts exactly the folded
+    # cohort — bitwise the full-C reference.
+    from hefl_tpu.parallel import client_mesh_size
+
+    num_clients = 6
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients, devices=jax.devices()[:4])
+    # pad_federated pads to the CLIENT axis size (what the round geometry
+    # validates) — 6 clients -> 8 rows on the 4-device mesh.
+    xs_p, ys_p, num_real = pad_federated(
+        np.asarray(xs), np.asarray(ys), client_mesh_size(mesh)
+    )
+    assert num_real == 6
+    xs_p, ys_p = jnp.asarray(xs_p), jnp.asarray(ys_p)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(31))
+    key = jax.random.key(32)
+    metas = {}
+    for cohort_only in (True, False):
+        eng = StreamEngine(
+            StreamConfig(cohort_size=3, seed=9, cohort_only=cohort_only),
+            None,
+        )
+        ct, _, _, smeta = eng.run_round(
+            model, CFG, mesh, ctx, pk, params, xs_p, ys_p, key, 0,
+            num_real_clients=num_real,
+        )
+        metas[cohort_only] = (ct_hash(ct.c0, ct.c1), smeta)
+    assert metas[True][0] == metas[False][0]
+    sm = metas[True][1]
+    assert sm.meta.surviving == 3 == sm.fresh
+    assert sm.meta.num_clients == 6
+    assert sm.meta.excluded["unsampled"] == 3
+    unsampled = [
+        c for c in range(6) if sm.meta.bits[c] & EXCLUDED_UNSAMPLED
+    ]
+    assert len(unsampled) == 3
+
+
+@pytest.mark.parametrize("backend", ["vmap", "fused"])
+def test_round_training_is_placement_invariant(backend):
+    # Regression (ISSUE 15, client.epoch_index_streams): permuting which
+    # device trains which client must permute the per-client results
+    # BITWISE. Before the shuffle-stream hoist this failed at exactly
+    # this geometry (C=8, m=64 -> n_tr=48): jax.random.permutation's
+    # sort, lowered inside the shard_map region, emitted a
+    # cross-partition all-reduce that coupled every client's shuffle to
+    # every other client's key.
+    num_clients = 8
+    model, params, xs, ys = _setup(num_clients, per_client=64)
+    cfg = dataclasses.replace(CFG, batch_size=8, client_fusion=backend)
+    mesh = make_mesh(num_clients)
+    from hefl_tpu.fl.fedavg import _build_round_fn, replicate_on
+
+    fn = _build_round_fn(model, cfg, mesh, stacked=True)
+    gp = replicate_on(mesh, params)
+    keys = jax.random.split(jax.random.key(42), num_clients)
+    out1, _ = fn(gp, xs, ys, keys)
+    w1 = np.asarray(jax.tree_util.tree_leaves(out1)[0])
+    perm = np.array([1, 0, 3, 2, 5, 4, 7, 6])
+    pj = jnp.asarray(perm)
+    out2, _ = fn(gp, xs[pj], ys[pj], keys[pj])
+    w2 = np.asarray(jax.tree_util.tree_leaves(out2)[0])
+    for i in range(num_clients):
+        np.testing.assert_array_equal(
+            w1[perm[i]], w2[i],
+            err_msg=f"client {perm[i]} trained differently at position {i}",
+        )
+
+
+# ------------------------------------------------- bucket compile behavior
+
+
+def test_cohort_bucket_compile_reuse():
+    # Cohorts inside one bucket reuse one executable; crossing a bucket
+    # compiles once; coming back re-uses. Measured by the
+    # jax.new_executables obs counter (the no-new-compile currency).
+    from hefl_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.install_jax_listeners()
+    num_clients = 16
+    model, params, xs, ys = _setup(num_clients, per_client=4)
+    mesh = make_mesh(num_clients, devices=jax.devices()[:1])
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(41))
+    eng = StreamEngine(StreamConfig(cohort_size=2, seed=1), None)
+
+    def round_at(size, r):
+        eng.stream = dataclasses.replace(eng.stream, cohort_size=size)
+        eng.run_round(
+            model, CFG, mesh, ctx, pk, params, xs, ys,
+            jax.random.key(100 + r), r,
+        )
+
+    round_at(2, 0)   # warm bucket 2
+    base = obs_metrics.snapshot().get("jax.new_executables", 0)
+    round_at(2, 1)   # same bucket, different cohort -> same executable
+    assert obs_metrics.snapshot().get("jax.new_executables", 0) == base
+    round_at(3, 2)   # crosses into bucket 4: compiles
+    crossed = obs_metrics.snapshot().get("jax.new_executables", 0)
+    assert crossed > base
+    round_at(4, 3)   # still bucket 4 -> no new executable
+    assert obs_metrics.snapshot().get("jax.new_executables", 0) == crossed
+    round_at(3, 4)   # back inside bucket 4 -> still warm
+    assert obs_metrics.snapshot().get("jax.new_executables", 0) == crossed
+
+
+# ---------------------------------------------------------- 2-D (clients, ct)
+
+
+def test_make_mesh_2d_shapes_and_env_knob(monkeypatch):
+    # The 2-D CI shard exports HEFL_MESH_CT; neutralize it so the 1-D
+    # assertions below hold in any shard.
+    monkeypatch.delenv("HEFL_MESH_CT", raising=False)
+    mesh = make_mesh_2d(8, 4)
+    assert mesh.axis_names == ("clients", "ct")
+    assert dict(mesh.shape) == {"clients": 2, "ct": 4}
+    assert ct_shard_count(mesh) == 4
+    assert ct_shard_count(make_mesh(8)) == 1
+    # clamped, never failing, on a small box
+    small = make_mesh_2d(1, 64)
+    assert dict(small.shape)["clients"] == 1
+    with pytest.raises(ValueError, match="ct_shards"):
+        make_mesh_2d(2, 0)
+    # the CI env knob flips make_mesh itself
+    monkeypatch.setenv("HEFL_MESH_CT", "4")
+    mesh_env = make_mesh(8)
+    assert ct_shard_count(mesh_env) == 4
+    assert dict(mesh_env.shape) == {"clients": 2, "ct": 4}
+
+
+@pytest.mark.parametrize("interleave", [0, 4])
+def test_secure_round_2d_mesh_bitwise_matches_replicated(interleave):
+    # The 2-D acceptance gate: the (2 clients, 4 ct) round — encrypt core
+    # rows sharded over the ct axis — is BITWISE the replicated path at
+    # the same client layout (a 1-D 2-device mesh), packed (k=4) and
+    # unpacked, on the virtual 8-device mesh.
+    num_clients = 8
+    model, params, xs, ys = _setup(num_clients)
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(51))
+    packing = None
+    if interleave:
+        pcfg = PackingConfig(
+            bits=8, interleave=interleave, clip=0.5, guard_bits=12
+        )
+        packing = PackedSpec.for_params(params, ctx, pcfg, num_clients)
+    key = jax.random.key(52)
+    mesh_rep = make_mesh(num_clients, devices=jax.devices()[:2])
+    mesh_2d = make_mesh_2d(num_clients, 4)
+    assert dict(mesh_2d.shape) == {"clients": 2, "ct": 4}
+    kw = {} if packing is None else {"packing": packing}
+    ct_rep = secure_fedavg_round(
+        model, CFG, mesh_rep, ctx, pk, params, xs, ys, key, **kw
+    )[0]
+    ct_2d = secure_fedavg_round(
+        model, CFG, mesh_2d, ctx, pk, params, xs, ys, key, **kw
+    )[0]
+    assert ct_hash(ct_2d.c0, ct_2d.c1) == ct_hash(ct_rep.c0, ct_rep.c1)
+
+
+def test_upload_producer_2d_mesh_bitwise():
+    # The streaming producer on the 2-D mesh: per-client ciphertext rows
+    # bitwise the replicated path's (same client layout).
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(61))
+    key = jax.random.key(62)
+    mesh_rep = make_mesh(num_clients, devices=jax.devices()[:2])
+    mesh_2d = make_mesh_2d(num_clients, 4)
+    cts_rep = produce_uploads(
+        model, CFG, mesh_rep, ctx, pk, params, xs, ys, key
+    )[0]
+    cts_2d = produce_uploads(
+        model, CFG, mesh_2d, ctx, pk, params, xs, ys, key
+    )[0]
+    np.testing.assert_array_equal(
+        np.asarray(cts_2d.c0), np.asarray(cts_rep.c0)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cts_2d.c1), np.asarray(cts_rep.c1)
+    )
+
+
+def test_certify_aggregation_2d_leg():
+    from hefl_tpu.analysis.ranges import certify_aggregation
+
+    cert = certify_aggregation(2**27 - 39)
+    assert cert.ok
+    assert any("2-D" in c for c in cert.checks)
+    # the 2-D leg rejects an unsafe prime like the 1-D one
+    assert not certify_aggregation((1 << 31) - 1).ok
+
+
+# ------------------------------------------------------ artifact machinery
+
+
+def test_cohort_compare_record_schema_and_equality():
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(71))
+    rec = cohort_compare_record(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(72),
+        num_clients=num_clients, cohort_size=2,
+    )
+    for field in ("num_clients", "cohort_size", "bucket", "full_c_train_s",
+                  "cohort_train_s", "speedup", "devices_per_axis",
+                  "bitwise_equal"):
+        assert rec.get(field) is not None, field
+    assert rec["bitwise_equal"] is True
+    assert rec["cohort_size"] == 2 and rec["num_clients"] == 4
+    assert rec["speedup"] > 0
+    assert set(rec["devices_per_axis"]) == {"clients", "ct"}
+
+
+def test_cli_mesh_and_cohort_flags():
+    from hefl_tpu.cli import build_parser, config_from_args
+
+    cfg = config_from_args(build_parser().parse_args(
+        ["--cohort-size", "2", "--mesh-ct", "4"]
+    ))
+    assert cfg.mesh_ct == 4
+    assert cfg.stream is not None and cfg.stream.cohort_only is True
+    cfg2 = config_from_args(build_parser().parse_args(
+        ["--cohort-size", "2", "--full-cohort-train"]
+    ))
+    assert cfg2.stream.cohort_only is False
+    with pytest.raises(SystemExit):
+        config_from_args(build_parser().parse_args(["--full-cohort-train"]))
+
+
+def test_cohort_only_journal_sha_and_replay(tmp_path):
+    # The acceptance criterion's journal half: a cohort-only journaled
+    # run's per-round commit shas equal the full-C producer's (same
+    # sampled cohorts), and crash recovery REPLAYS a cohort-only round —
+    # the re-derived cohort-gathered uploads content-hash-verify against
+    # the journal's persisted bytes and the recovered params are bitwise
+    # the uninterrupted run's.
+    from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
+    from hefl_tpu.fl import CrashConfig, SimulatedCrash
+    from hefl_tpu.fl import journal as jr
+
+    train = TrainConfig(epochs=1, batch_size=8, num_classes=10,
+                        augment=False, val_fraction=0.25)
+    base = ExperimentConfig(
+        model="smallcnn", dataset="mnist", num_clients=4, rounds=2,
+        train=train, he=HEConfig(n=256), n_train=64, n_test=32, seed=5,
+        stream=StreamConfig(cohort_size=2, quorum=1.0, seed=2),
+        journal_path=str(tmp_path / "cohort.wal"),
+    )
+    out_a = run_experiment(base, verbose=False)
+    full = dataclasses.replace(
+        base,
+        journal_path=str(tmp_path / "fullc.wal"),
+        stream=dataclasses.replace(base.stream, cohort_only=False),
+    )
+    run_experiment(full, verbose=False)
+    sha_a = {
+        e["round"]: e["sum_sha"]
+        for e in jr.read_journal(base.journal_path)
+        if e["kind"] == "commit"
+    }
+    sha_b = {
+        e["round"]: e["sum_sha"]
+        for e in jr.read_journal(full.journal_path)
+        if e["kind"] == "commit"
+    }
+    assert sha_a and sha_a == sha_b
+    # crash mid-round 1, then recover by re-running: the replay re-folds
+    # the journal's bytes against the re-derived cohort uploads.
+    crash_cfg = dataclasses.replace(
+        base,
+        journal_path=str(tmp_path / "crash.wal"),
+        crash=CrashConfig(round=1, at="post_fold", after_folds=1),
+    )
+    with pytest.raises(SimulatedCrash):
+        run_experiment(crash_cfg, verbose=False)
+    recovered = run_experiment(
+        dataclasses.replace(crash_cfg, crash=None), verbose=False
+    )
+    sha_c = {
+        e["round"]: e["sum_sha"]
+        for e in jr.read_journal(crash_cfg.journal_path)
+        if e["kind"] == "commit"
+    }
+    assert sha_c == sha_a
+    for a, b in zip(
+        jax.tree_util.tree_leaves(out_a["params"]),
+        jax.tree_util.tree_leaves(recovered["params"]),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_experiment_2d_mesh_cohort_only_smoke():
+    # Driver-level: a 2-round cohort-only streaming experiment on the 2-D
+    # mesh — history finite, mesh record present, unsampled clients carry
+    # zero metrics rows.
+    from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
+
+    train = TrainConfig(epochs=1, batch_size=8, num_classes=10,
+                        augment=False, val_fraction=0.25)
+    cfg = ExperimentConfig(
+        model="smallcnn", dataset="mnist", num_clients=4, rounds=2,
+        train=train, he=HEConfig(n=256), n_train=64, n_test=32, seed=3,
+        stream=StreamConfig(cohort_size=2, quorum=1.0),
+        mesh_ct=2,
+    )
+    out = run_experiment(cfg, verbose=False)
+    # 8 virtual devices at ct=2 -> 4 client rows x 2 ct shards
+    assert out["mesh"]["ct"] == 2 and out["mesh"]["clients"] == 4
+    assert out["mesh"]["axes"] == ["clients", "ct"]
+    assert len(out["history"]) == 2
+    for rec in out["history"]:
+        assert rec["stream"]["committed"]
+        assert rec["robust"]["surviving"] == 2
+        assert rec["robust"]["excluded"]["unsampled"] == 2
+    for leaf in jax.tree_util.tree_leaves(out["params"]):
+        assert np.all(np.isfinite(np.asarray(leaf)))

@@ -9,7 +9,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from hefl_tpu.parallel import (
     CLIENT_AXIS,
-    make_mesh,
     psum_mod,
     ring_psum_mod,
     shard_map,
@@ -17,7 +16,12 @@ from hefl_tpu.parallel import (
 
 
 def _mesh8():
-    return make_mesh(8)
+    # An EXPLICIT flat 8-device mesh: these tests' reference sums assume
+    # one client row per device, so they must not pick up the 2-D
+    # ("clients", "ct") topology the HEFL_MESH_CT CI shard injects into
+    # make_mesh (the 2-D collective itself is covered by
+    # tests/test_cohort.py and the env-shard reruns of stream/secure).
+    return Mesh(np.asarray(jax.devices()[:8]), (CLIENT_AXIS,))
 
 
 def _sharded_reduce(fn, mesh, x, p):
