@@ -522,6 +522,22 @@ def main() -> None:
         f"bitwise_equal={cohort_rec['bitwise_equal']}"
     )
 
+    # --- hierarchical-aggregation DCN rows (ISSUE 16) -------------------
+    # Flat O(cohort) vs two-tier O(hosts) cross-host bytes at the fixed
+    # cohort-8-of-16 / 4-host smoke geometry, with the bitwise equality
+    # of the committed aggregates across every tested arrival order
+    # (single-sourced with `python -m hefl_tpu.fl.hierarchy`).
+    from hefl_tpu.fl.hierarchy import dcn_compare_smoke_record
+
+    dcn_rec = dcn_compare_smoke_record()
+    log(
+        f"dcn_compare (cohort={dcn_rec['cohort_size']}, "
+        f"hosts={dcn_rec['num_hosts']}): flat {dcn_rec['flat_dcn_bytes']}B "
+        f"vs hier {dcn_rec['hier_dcn_bytes']}B = "
+        f"{dcn_rec['bytes_ratio']}x (floor {dcn_rec['ratio_floor']}), "
+        f"bitwise_equal={dcn_rec['bitwise_equal']}"
+    )
+
     obs_metrics.record_device_memory(dev)
     obs_snapshot = obs_metrics.snapshot()
 
@@ -637,6 +653,10 @@ def main() -> None:
                 # cohort-only producer seconds, bucket chosen, devices
                 # per mesh axis, committed-aggregate hash equality.
                 "cohort_compare": cohort_rec,
+                # Hierarchical-aggregation DCN rows (ISSUE 16): flat vs
+                # two-tier cross-host bytes, per-uplink breakdown, ratio
+                # vs the cohort/hosts floor, arrival-order bitwise gate.
+                "dcn_compare": dcn_rec,
                 "device": getattr(dev, "device_kind", str(dev)),
                 "seed": seed,
                 # `accuracy` pairs with `value`: both are the round-0

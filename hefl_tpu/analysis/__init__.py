@@ -37,6 +37,7 @@ from hefl_tpu.analysis.ranges import (
     certified_max_interleave,
     certify_aggregation,
     certify_fold_inductive,
+    certify_fold_tree,
     certify_inference,
     certify_keyswitch,
     certify_packing,
@@ -230,6 +231,7 @@ __all__ = [
     "certify_packing",
     "certify_aggregation",
     "certify_fold_inductive",
+    "certify_fold_tree",
     "certify_inference",
     "certify_keyswitch",
     "certify_transciphering",

@@ -1248,6 +1248,46 @@ def certify_fold_inductive(
     )
 
 
+@functools.lru_cache(maxsize=64)
+def certify_fold_tree(prime: int) -> FoldCertificate:
+    """Certify the TWO-TIER fold tree (ISSUE 16: hierarchical multi-host
+    aggregation) on top of the inductive single-loop proof.
+
+    The hierarchical aggregator (fl.hierarchy) runs the SAME certified
+    fold loop twice: once per host over its local block (the tier fold),
+    then once at the root over the shipped per-host partials. The tree
+    introduces no new arithmetic, so the certificate is the inductive one
+    plus two derived facts it makes checkable:
+
+      * tier partials are canonical — the loop post-fixpoint proves every
+        tier accumulator ends in [0, p-1], which is exactly the canonical-
+        residue precondition the root fold's base/step cases assume, so
+        the root loop is ANOTHER instance of the certified loop, not a new
+        region;
+      * tree == flat bitwise — every fold is an exact canonical addition
+        mod p (int64 carrier, proven wrap-free), and modular addition is
+        associative and commutative, so any bracketing of the same upload
+        multiset — flat, per-host-then-root, any arrival order — yields
+        the same canonical residues bit for bit. This is the identity the
+        BENCH_DCN / chaos flat-vs-hierarchical hash gates then measure.
+
+    Unsafe base certificate => unsafe tree (no tree claim is made on top
+    of a broken loop invariant).
+    """
+    base = certify_fold_inductive(int(prime))
+    if not base.ok:
+        return base
+    checks = base.checks + (
+        "tier partials canonical: each host fold ends in the loop "
+        "post-fixpoint [0, p-1], satisfying the root fold's canonical-"
+        "input precondition — the root is the same certified loop",
+        "fold-tree = flat fold bitwise: exact canonical add mod p is "
+        "associative+commutative, so any bracketing/arrival order of the "
+        "same uploads yields identical residues",
+    )
+    return dataclasses.replace(base, checks=checks)
+
+
 @dataclasses.dataclass(frozen=True)
 class InferenceCertificate:
     """Static proof (or refutation) of the rotate-and-sum serving program
@@ -1679,6 +1719,7 @@ __all__ = [
     "certify_packing",
     "certify_aggregation",
     "certify_fold_inductive",
+    "certify_fold_tree",
     "certify_inference",
     "certify_keyswitch",
     "certify_transciphering",

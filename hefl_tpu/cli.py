@@ -155,6 +155,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--permanent-clients", type=int, default=0, metavar="K",
                    help="fault injection: clients per round for whom every "
                         "delivery fails (excluded as unreachable)")
+    p.add_argument("--outage-hosts", type=int, default=0, metavar="K",
+                   help="fault injection: host rows per round whose whole "
+                        "contiguous client block is scheduled out (a "
+                        "regional outage); requires --num-hosts H >= 2")
     p.add_argument("--fault-seed", type=int, default=0,
                    help="PRNG seed of the fault schedule")
     # --- streaming quorum aggregation (fl/stream.py, README "Streaming
@@ -190,6 +194,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "clients masked (the historical full-C producer; "
                         "the cohort-only default gathers just the sampled "
                         "cohort's slots, bitwise the same aggregate)")
+    p.add_argument("--num-hosts", type=int, default=0, metavar="H",
+                   help="hierarchical multi-host aggregation (>= 2): each "
+                        "host folds its contiguous client block locally "
+                        "and ships ONE partial ciphertext across the "
+                        "simulated DCN per round — O(hosts) cross-host "
+                        "bytes, bitwise the flat fold; 0 = flat "
+                        "single-root aggregation; implies --stream")
     p.add_argument("--mesh-ct", type=int, default=0, metavar="K",
                    help="2-D (clients, ct) round mesh: give each client "
                         "block K devices that split its in-round "
@@ -294,8 +305,14 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         or args.duplicate_clients > 0
         or args.transient_clients > 0
         or args.permanent_clients > 0
+        or args.outage_hosts > 0
         or fail_rounds
     )
+    if args.outage_hosts > 0 and args.num_hosts < 2:
+        raise SystemExit(
+            "--outage-hosts darkens host rows of the hierarchical "
+            "topology; add --num-hosts H (>= 2) to define the rows"
+        )
     faults = (
         FaultConfig(
             seed=args.fault_seed,
@@ -309,6 +326,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             duplicate_clients=args.duplicate_clients,
             transient_fail_clients=args.transient_clients,
             permanent_fail_clients=args.permanent_clients,
+            outage_hosts=args.outage_hosts,
+            num_hosts=args.num_hosts if args.outage_hosts > 0 else 0,
         )
         if any_fault
         else None
@@ -321,7 +340,13 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         or args.deadline > 0
         or args.staleness > 0
         or args.stream_retries > 0
+        or args.num_hosts > 0
     )
+    if args.num_hosts == 1:
+        raise SystemExit(
+            "--num-hosts 1 is the flat single-root fold; use 0 (flat) or "
+            ">= 2 (hierarchical multi-host aggregation)"
+        )
     if args.hhe and args.pack_bits <= 0:
         # The symmetric cipher lives in the packed integer domain; without
         # packing there is nothing for the keystream to add to. Fail at
@@ -394,6 +419,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             retry_backoff_s=args.stream_backoff,
             staleness_rounds=args.staleness,
             seed=args.stream_seed,
+            num_hosts=args.num_hosts,
             upload_kind="hhe" if args.hhe else "ckks",
         )
         if want_stream

@@ -54,6 +54,11 @@ from hefl_tpu.fl.secure import (
     encrypt_stack_packed,
     secure_fedavg_round,
 )
+from hefl_tpu.fl.hierarchy import (
+    HierarchicalAggregator,
+    TierCrash,
+    dcn_compare_record,
+)
 from hefl_tpu.fl.server import AggregationServer
 from hefl_tpu.fl.stream import (
     DedupWindow,
@@ -87,6 +92,9 @@ __all__ = [
     "clip_by_global_norm",
     "dp_sanitize",
     "epsilon_spent",
+    "HierarchicalAggregator",
+    "TierCrash",
+    "dcn_compare_record",
     "OnlineAccumulator",
     "StreamEngine",
     "StreamRoundMeta",

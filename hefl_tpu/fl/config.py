@@ -159,6 +159,18 @@ class StreamConfig:
                       TraceAnnotation). 0 = fully virtual clock: the
                       arrival timeline is simulated exactly but the driver
                       never sleeps — the CI/chaos default.
+    num_hosts:        host rows of the simulated multi-host deployment
+                      (ISSUE 16). 0 or 1 = the flat single-root fold
+                      (the historical engine); >= 2 makes the engine
+                      aggregate through `fl.hierarchy`'s two-tier fold
+                      tree — each host folds its contiguous client block
+                      locally and ships ONE partial ciphertext across the
+                      simulated DCN, so cross-host traffic is O(hosts)
+                      instead of O(cohort). The committed aggregate is
+                      BITWISE equal to the flat fold (certified by
+                      analysis.certify_fold_tree, measured by the
+                      BENCH_DCN / chaos gates). Part of the journal's
+                      config echo.
     upload_kind:      what the clients put on the wire (ISSUE 11):
                       "ckks" (the historical packed/float CKKS ciphertext)
                       or "hhe" — a symmetric stream-cipher encryption of
@@ -181,6 +193,7 @@ class StreamConfig:
     staleness_rounds: int = 0
     seed: int = 0
     time_scale: float = 0.0
+    num_hosts: int = 0
     upload_kind: str = "ckks"
 
     def __post_init__(self):
@@ -194,9 +207,15 @@ class StreamConfig:
                 f"StreamConfig.quorum={self.quorum}: must be in (0, 1]"
             )
         for name in ("cohort_size", "deadline_s", "max_retries",
-                     "retry_backoff_s", "staleness_rounds", "time_scale"):
+                     "retry_backoff_s", "staleness_rounds", "time_scale",
+                     "num_hosts"):
             if getattr(self, name) < 0:
                 raise ValueError(f"StreamConfig.{name} must be >= 0")
+        if self.num_hosts == 1:
+            raise ValueError(
+                "StreamConfig.num_hosts=1: one host IS the flat fold — "
+                "use 0 (flat) or >= 2 (hierarchical)"
+            )
         if not 0.0 <= self.retry_jitter <= 1.0:
             raise ValueError(
                 f"StreamConfig.retry_jitter={self.retry_jitter}: must be "

@@ -165,6 +165,21 @@ class FaultConfig:
     permanent_fail_clients:  clients per round for whom EVERY delivery
                              attempt fails (a crashed client) — excluded
                              as "unreachable" once retries are exhausted.
+
+    Regional (host-level) faults (ISSUE 16 — the multi-host topology's
+    failure domain; require num_hosts >= 2):
+
+    outage_hosts:            host rows per round whose ENTIRE contiguous
+                             client block (parallel.host_of_clients) is
+                             scheduled out — a datacenter/region outage.
+                             Drawn from an independent PRNG stream
+                             (seed, round, 5) AFTER the dropout draw, so
+                             an existing schedule is bit-identical when
+                             outage_hosts=0.
+    num_hosts:               host rows the outage draw partitions the
+                             registry into (must match the deployment's
+                             StreamConfig.num_hosts to darken real host
+                             blocks).
     """
 
     seed: int = 0
@@ -178,6 +193,8 @@ class FaultConfig:
     duplicate_clients: int = 0
     transient_fail_clients: int = 0
     permanent_fail_clients: int = 0
+    outage_hosts: int = 0
+    num_hosts: int = 0
 
     def __post_init__(self):
         # Negative knobs would crash deep inside the numpy draws
@@ -187,10 +204,22 @@ class FaultConfig:
             "drop_fraction", "nan_clients", "huge_clients",
             "straggler_fraction", "straggler_delay_s", "arrival_delay_s",
             "duplicate_clients", "transient_fail_clients",
-            "permanent_fail_clients",
+            "permanent_fail_clients", "outage_hosts", "num_hosts",
         ):
             if getattr(self, name) < 0:
                 raise ValueError(f"FaultConfig.{name} must be >= 0")
+        if self.outage_hosts > 0 and self.num_hosts < 2:
+            raise ValueError(
+                f"FaultConfig.outage_hosts={self.outage_hosts} needs "
+                "num_hosts >= 2: an outage darkens one host row of a "
+                "multi-host topology"
+            )
+        if self.outage_hosts >= self.num_hosts > 0:
+            raise ValueError(
+                f"FaultConfig.outage_hosts={self.outage_hosts} with "
+                f"num_hosts={self.num_hosts}: at least one host row must "
+                "survive or no round can ever commit"
+            )
 
     def max_scheduled_exclusions(self, num_clients: int) -> int:
         """Worst-case per-round exclusion count this schedule can cause —
@@ -200,9 +229,15 @@ class FaultConfig:
         retries. Sanitization causes outside the schedule (norm bound,
         encoder saturation on organic updates) are NOT modeled here; a
         round that exceeds this bound under dp fails loudly downstream."""
+        outage = 0
+        if self.outage_hosts > 0:
+            # A darkened host row scheds out its whole contiguous block.
+            per_host = -(-int(num_clients) // int(self.num_hosts))
+            outage = int(self.outage_hosts) * per_host
         return min(
             int(num_clients),
             int(round(self.drop_fraction * num_clients))
+            + outage
             + int(self.nan_clients)
             + int(self.huge_clients)
             + int(self.permanent_fail_clients)
@@ -241,6 +276,20 @@ def schedule_for_round(
     n_drop = min(int(round(fc.drop_fraction * num_clients)), num_clients)
     if n_drop:
         dropped[rng.choice(num_clients, n_drop, replace=False)] = True
+    if fc.outage_hosts > 0:
+        # Regional outage (ISSUE 16): darken whole host rows — every
+        # client of the picked hosts' contiguous blocks is scheduled out.
+        # An independent PRNG stream (seed, round, 5), applied after the
+        # dropout draw and before the poison draws, keeps every existing
+        # schedule bit-identical when outage_hosts=0.
+        from hefl_tpu.parallel import host_of_clients
+
+        org = np.random.default_rng([int(fc.seed), int(round_index), 5])
+        dark = org.choice(int(fc.num_hosts), int(fc.outage_hosts),
+                          replace=False)
+        dropped |= np.isin(
+            host_of_clients(num_clients, int(fc.num_hosts)), dark
+        )
     poison = np.zeros(num_clients, dtype=np.int32)
     alive = np.flatnonzero(~dropped)
     n_nan = min(int(fc.nan_clients), len(alive))

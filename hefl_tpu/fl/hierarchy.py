@@ -1,0 +1,568 @@
+"""Hierarchical multi-host aggregation: fold locally, ship ONE ciphertext
+per host over DCN (ISSUE 16).
+
+The flat aggregation service folds every cohort upload at one root, so the
+cross-host (DCN) link carries O(cohort) ciphertexts per round — the wall
+that keeps 10^6-client cohorts from being schedulable. Modular addition is
+associative and commutative over canonical residues, so nothing forces
+that shape: each host can fold its LOCAL block of the cohort with the same
+`OnlineAccumulator` the flat service uses and ship exactly one partial
+ciphertext sum upward, making DCN traffic O(hosts).
+
+`HierarchicalAggregator` is that two-tier fold tree, duck-typed to the
+engine's accumulator contract (`fold(nonce, c0, c1)`, `folded`,
+`duplicates`, `value(like_shape)`) so `StreamEngine.run_round` swaps it in
+per `StreamConfig.num_hosts` without touching the round lifecycle:
+
+  * **Client -> host placement** is `parallel.host_of_clients` — the same
+    contiguous-block layout `make_host_mesh` gives a ("hosts", "clients")
+    mesh, so "a host's cohort block is host-local" means the same clients
+    in the mesh layout, the fault model, and this tier.
+  * **Certified equality.** Construction refuses to run unless
+    `analysis.ranges.certify_fold_tree` holds: the inductive fold-loop
+    certificate plus the derived tree facts (tier partials canonical =>
+    the root fold is the same certified loop; exact mod-p addition =>
+    any bracketing/arrival order is bitwise the flat fold). The BENCH_DCN
+    and chaos gates then MEASURE the identity the certificate proves.
+  * **Per-tier journals.** With a `journal_dir`, every tier fold appends a
+    `tier_fold` record (ciphertext body + sha) to that host's own
+    `tier{h}.wal` BEFORE the in-memory fold, the upward ship appends
+    `tier_ship` (partial sha) there and `root_fold` to `root.wal` — so a
+    sub-aggregator crash recovers from ITS journal alone, independent of
+    the root: construction re-folds the journaled bodies (nonce dedup
+    makes replay idempotent — re-fold, never double-count), verifies a
+    shipped partial's sha against the journal, and re-ships a partial
+    whose `tier_ship` landed but whose `root_fold` did not.
+  * **Simulated-DCN accounting.** Each ship increments the per-uplink
+    byte counter `dcn.link.h{h}_root.bytes` and `dcn.hier.bytes`; every
+    fold increments `dcn.flat.bytes` by the bytes the FLAT topology would
+    have shipped for that upload. `report()` returns the round's traffic
+    summary (the `BENCH_DCN` row), matching `parallel.dcn_traffic_model`.
+
+`dcn_compare_record` / `dcn_compare_smoke_record` are the artifact
+producers bench.py embeds and run_perf_smoke.sh gates: flat-vs-hierarchical
+bytes-per-round ratio >= cohort/hosts * 0.8 and bitwise-equal committed
+aggregates in every tested arrival order (identity, reversed, shuffled,
+each with duplicate redeliveries). `python -m hefl_tpu.fl.hierarchy` writes
+the standalone BENCH_DCN.json (run_tpu_suite.sh stage 9).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+
+import numpy as np
+
+from hefl_tpu.fl import journal as jr
+from hefl_tpu.fl.faults import SimulatedCrash
+from hefl_tpu.fl.stream import OnlineAccumulator, ct_hash
+from hefl_tpu.obs import events as obs_events
+from hefl_tpu.obs import metrics as obs_metrics
+from hefl_tpu.parallel import dcn_link_names, host_of_clients
+
+# The injectable tier-crash boundaries, in tier-lifecycle order:
+# "mid_fold" dies MID-write of the Nth tier_fold frame (a REAL torn record
+# on that tier's journal — the truncated-mid-fold recovery case);
+# "post_fold" dies after that frame landed but before the next transition;
+# "pre_ship" dies between the tier's last local fold and its upward ship
+# (no tier_ship record — recovery must re-fold and ship fresh);
+# "post_ship" dies after tier_ship landed but BEFORE the root saw the
+# partial (recovery must re-ship without double-folding the tier).
+TIER_CRASH_POINTS = ("mid_fold", "post_fold", "pre_ship", "post_ship")
+
+
+@dataclasses.dataclass(frozen=True)
+class TierCrash:
+    """Deterministic crash injection for one sub-aggregator tier (the
+    hierarchical analog of fl.faults.CrashConfig): raise SimulatedCrash at
+    the configured boundary of host `host`'s tier lifecycle, after writing
+    any torn prefix. A recovering process constructs the aggregator over
+    the same journal_dir with crash=None and must reach the bitwise state
+    of an uninterrupted run."""
+
+    host: int = 0
+    at: str = "pre_ship"
+    after_folds: int = 1
+    torn_bytes: int = 24
+
+    def __post_init__(self):
+        if self.at not in TIER_CRASH_POINTS:
+            raise ValueError(
+                f"TierCrash.at={self.at!r}: must be one of {TIER_CRASH_POINTS}"
+            )
+        if self.host < 0:
+            raise ValueError("TierCrash.host must be >= 0")
+        if self.after_folds < 1:
+            raise ValueError("TierCrash.after_folds must be >= 1")
+        if self.torn_bytes < 1:
+            raise ValueError("TierCrash.torn_bytes must be >= 1")
+
+
+class HierarchicalAggregator:
+    """Two-tier fold tree: per-host `OnlineAccumulator`s + a root fold.
+
+    Engine-compatible accumulator (see module doc): `fold` routes each
+    upload to its client's host tier (nonce[-2] is the client index for
+    both fresh `(client, round)` and stale `("stale", client, round)`
+    nonces); `folded` counts uploads across every tier; `value()` ships
+    each nonempty tier's single partial upward (sealing the tree — the
+    committed aggregate must not drift after its hash is journaled) and
+    returns the root sum, bitwise the flat fold of the same uploads.
+    """
+
+    def __init__(
+        self,
+        p,
+        num_hosts: int,
+        num_clients: int,
+        journal_dir: str | None = None,
+        fsync_policy: str | None = None,
+        crash: TierCrash | None = None,
+    ):
+        if num_hosts < 2:
+            raise ValueError(
+                f"HierarchicalAggregator: num_hosts={num_hosts} — a "
+                "hierarchy needs >= 2 hosts (use OnlineAccumulator flat)"
+            )
+        # Fold-tree certificate (ISSUE 16, riding ISSUE 12's inductive
+        # proof): refuse to aggregate unless the tier AND root folds are
+        # the certified loop and the tree is provably the flat fold.
+        from hefl_tpu.analysis.ranges import certify_fold_tree
+
+        cert = certify_fold_tree(int(np.asarray(p).max()))
+        if not cert.ok:
+            raise ValueError(
+                "hierarchical fold tree rejected by static range analysis "
+                f"— {cert.summary()}"
+            )
+        self.num_hosts = int(num_hosts)
+        self.num_clients = int(num_clients)
+        self._host_map = host_of_clients(num_clients, num_hosts)
+        self._tiers = [OnlineAccumulator(p) for _ in range(self.num_hosts)]
+        self._root = OnlineAccumulator(p)
+        self.duplicates = 0        # engine-owned dedup hits += here, plus
+                                   # tier-level nonce rejections
+        self._shipped = [False] * self.num_hosts
+        self._ship_sha: list[str | None] = [None] * self.num_hosts
+        self._sealed = False
+        self._link_bytes = [0] * self.num_hosts
+        self._flat_bytes = 0       # what the flat topology would have
+                                   # shipped cross-host for the same folds
+        self.crash = crash
+        self._writers: list[jr.JournalWriter | None] = [None] * self.num_hosts
+        self._root_writer: jr.JournalWriter | None = None
+        self.refolded = 0          # uploads recovered from tier journals
+        if journal_dir is not None:
+            self._recover(journal_dir, fsync_policy)
+
+    # -- engine accumulator contract ----------------------------------------
+
+    @property
+    def folded(self) -> int:
+        """Uploads folded across every tier (the surviving count / dp and
+        headroom currency — NOT the root's host-partial count)."""
+        return sum(t.folded for t in self._tiers)
+
+    def fold(self, nonce, c0, c1) -> bool:
+        """Fold one upload into its client's host tier; False (counting a
+        duplicate) if that tier already folded the nonce."""
+        if self._sealed:
+            raise RuntimeError(
+                "HierarchicalAggregator: fold after the tree was sealed "
+                "(value()/ship_all() already committed the partials)"
+            )
+        nonce = tuple(nonce)
+        client = int(nonce[-2])
+        h = int(self._host_map[client])
+        if self._shipped[h]:
+            raise RuntimeError(
+                f"HierarchicalAggregator: tier {h} already shipped its "
+                "partial; a later upload must carry to the next round"
+            )
+        tier = self._tiers[h]
+        if nonce in tier._nonces:
+            self.duplicates += 1
+            return False
+        c0 = np.asarray(c0, dtype=np.uint32)
+        c1 = np.asarray(c1, dtype=np.uint32)
+        w = self._writers[h]
+        if w is not None:
+            body = jr.ct_body(c0, c1)
+            fields = dict(
+                host=h, client=client,
+                nonce=[x if isinstance(x, str) else int(x) for x in nonce],
+                shape=list(c0.shape),
+                sha=hashlib.sha256(body).hexdigest(),
+            )
+            c = self.crash
+            if (
+                c is not None and c.host == h
+                and tier.folded + 1 == c.after_folds
+            ):
+                if c.at == "mid_fold":
+                    w.append_torn("tier_fold", fields, body, c.torn_bytes)
+                    raise SimulatedCrash(
+                        f"tier crash injection: torn tier_fold append "
+                        f"{c.after_folds} on host {h}"
+                    )
+                if c.at == "post_fold":
+                    w.append("tier_fold", fields, body)
+                    raise SimulatedCrash(
+                        f"tier crash injection: after tier_fold "
+                        f"{c.after_folds} landed on host {h}"
+                    )
+            w.append("tier_fold", fields, body)
+        tier.fold(nonce, c0, c1)
+        # Flat-topology model: this upload would have crossed DCN whole.
+        self._flat_bytes += c0.nbytes + c1.nbytes
+        obs_metrics.counter("dcn.flat.bytes").inc(c0.nbytes + c1.nbytes)
+        return True
+
+    def ship_all(self) -> None:
+        """Ship each nonempty tier's ONE partial ciphertext to the root
+        (the per-round DCN traffic — O(hosts), counted per uplink) and
+        seal the tree. Idempotent; crash-safe via the tier_ship /
+        root_fold WAL ordering (see _recover)."""
+        if self._sealed:
+            return
+        links = dcn_link_names(self.num_hosts)
+        for h, tier in enumerate(self._tiers):
+            if self._shipped[h] or tier.folded == 0:
+                continue
+            c = self.crash
+            if c is not None and c.host == h and c.at == "pre_ship":
+                raise SimulatedCrash(
+                    f"tier crash injection: host {h} died between its "
+                    "local folds and the upward ship"
+                )
+            pc0, pc1 = tier.value()
+            sha = ct_hash(pc0, pc1)
+            w = self._writers[h]
+            if w is not None:
+                w.append(
+                    "tier_ship", dict(host=h, sha=sha, folded=tier.folded)
+                )
+            if c is not None and c.host == h and c.at == "post_ship":
+                raise SimulatedCrash(
+                    f"tier crash injection: host {h} died after tier_ship "
+                    "landed, before the root saw the partial"
+                )
+            self._ship_partial(h, pc0, pc1, sha, links[h])
+        self._sealed = True
+
+    def _ship_partial(self, h, pc0, pc1, sha, link) -> None:
+        if self._root_writer is not None:
+            self._root_writer.append("root_fold", dict(host=h, sha=sha))
+        self._root.fold(("host", h), pc0, pc1)
+        nbytes = pc0.nbytes + pc1.nbytes
+        self._link_bytes[h] += nbytes
+        obs_metrics.counter(f"dcn.link.{link}.bytes").inc(nbytes)
+        obs_metrics.counter("dcn.hier.bytes").inc(nbytes)
+        obs_events.emit("dcn_ship", host=h, bytes=nbytes, sha=sha)
+        self._shipped[h] = True
+        self._ship_sha[h] = sha
+
+    def value(self, like_shape=None):
+        """The committed aggregate: ships any unshipped tiers first, then
+        returns the root sum — bitwise the flat fold of the same uploads
+        (zeros of `like_shape` when nothing folded anywhere)."""
+        self.ship_all()
+        return self._root.value(like_shape=like_shape)
+
+    # -- per-tier journals ---------------------------------------------------
+
+    def _meta(self) -> dict:
+        return {
+            "num_hosts": self.num_hosts, "num_clients": self.num_clients,
+        }
+
+    def _recover(self, journal_dir: str, fsync_policy: str | None) -> None:
+        """Construction-is-recovery (the fl.server pattern): open every
+        tier journal (repairing torn tails), re-fold the journaled bodies
+        — nonce dedup makes a replayed record idempotent, so recovery
+        re-folds and can never double-count — verify shipped partials
+        against their journaled sha, and re-ship a partial whose
+        tier_ship landed but whose root_fold did not."""
+        os.makedirs(journal_dir, exist_ok=True)
+        links = dcn_link_names(self.num_hosts)
+        pending_ship: list[int] = []
+        for h in range(self.num_hosts):
+            path = os.path.join(journal_dir, f"tier{h}.wal")
+            w, records, _torn = jr.open_journal(
+                path, fsync_policy, meta=dict(self._meta(), tier=h)
+            )
+            self._writers[h] = w
+            tier = self._tiers[h]
+            for rec in records:
+                kind = rec.get("kind")
+                if kind == "journal_open":
+                    meta = rec.get("meta", {})
+                    if (
+                        meta.get("num_hosts") != self.num_hosts
+                        or meta.get("num_clients") != self.num_clients
+                        or meta.get("tier") != h
+                    ):
+                        raise jr.JournalError(
+                            f"{path}: journal belongs to a different "
+                            f"topology ({meta!r}) than this aggregator "
+                            f"({self._meta()!r}, tier {h})"
+                        )
+                    continue
+                if kind == "tier_fold":
+                    body = rec["body"]
+                    got = hashlib.sha256(body).hexdigest()
+                    if got != rec.get("sha"):
+                        raise jr.JournalCorruptError(
+                            f"{path}: tier_fold body sha256 {got} does "
+                            f"not match its record ({rec.get('sha')})"
+                        )
+                    c0, c1 = jr.ct_from_body(body, rec["shape"])
+                    if tier.fold(tuple(rec["nonce"]), c0, c1):
+                        self.refolded += 1
+                        self._flat_bytes += c0.nbytes + c1.nbytes
+                elif kind == "tier_ship":
+                    if tier.folded == 0:
+                        raise jr.JournalError(
+                            f"{path}: tier_ship with no folded uploads — "
+                            "the fold records this ship summarized are "
+                            "missing"
+                        )
+                    sha = ct_hash(*tier.value())
+                    if sha != rec.get("sha"):
+                        raise jr.JournalError(
+                            f"{path}: recovered tier {h} partial hashes "
+                            f"to {sha} but the journaled ship recorded "
+                            f"{rec.get('sha')} — refusing to re-ship a "
+                            "diverged partial"
+                        )
+                    pending_ship.append(h)
+        root_path = os.path.join(journal_dir, "root.wal")
+        rw, root_records, _ = jr.open_journal(
+            root_path, fsync_policy, meta=dict(self._meta(), tier="root")
+        )
+        self._root_writer = rw
+        root_seen = {
+            int(rec["host"]): rec.get("sha")
+            for rec in root_records if rec.get("kind") == "root_fold"
+        }
+        for h, want in root_seen.items():
+            if h not in pending_ship:
+                raise jr.JournalError(
+                    f"{root_path}: root_fold for host {h} has no "
+                    f"tier_ship in tier{h}.wal — the tiers and root "
+                    "disagree about history"
+                )
+        for h in pending_ship:
+            pc0, pc1 = self._tiers[h].value()
+            sha = ct_hash(pc0, pc1)
+            want = root_seen.get(h)
+            if want is not None and want != sha:
+                raise jr.JournalError(
+                    f"{root_path}: root_fold sha for host {h} ({want}) "
+                    f"does not match the recovered partial ({sha})"
+                )
+            if want is not None:
+                # Already at the root: fold in memory without re-logging.
+                self._root.fold(("host", h), pc0, pc1)
+                nbytes = pc0.nbytes + pc1.nbytes
+                self._link_bytes[h] += nbytes
+                self._shipped[h] = True
+                self._ship_sha[h] = sha
+            else:
+                # Crash landed between tier_ship and root_fold: re-ship.
+                self._ship_partial(h, pc0, pc1, sha, links[h])
+        if self.refolded:
+            obs_metrics.counter("recovery.tier_refolded_uploads").inc(
+                self.refolded
+            )
+            obs_events.emit(
+                "tier_recovered", journal_dir=journal_dir,
+                refolded=self.refolded, shipped=sum(self._shipped),
+            )
+
+    def close(self) -> None:
+        for w in self._writers:
+            if w is not None:
+                w.close()
+        if self._root_writer is not None:
+            self._root_writer.close()
+        self._writers = [None] * self.num_hosts
+        self._root_writer = None
+
+    # -- DCN accounting -------------------------------------------------------
+
+    def report(self) -> dict:
+        """The round's simulated-DCN traffic summary (a BENCH_DCN row):
+        per-uplink bytes, hierarchical total, the flat-topology model for
+        the same folds, and their ratio (the O(cohort)/O(hosts) claim)."""
+        links = dcn_link_names(self.num_hosts)
+        hier = sum(self._link_bytes)
+        return {
+            "num_hosts": self.num_hosts,
+            "num_clients": self.num_clients,
+            "folded": self.folded,
+            "duplicates": int(self.duplicates),
+            "shipping_hosts": int(sum(self._shipped)),
+            "per_link": {
+                links[h]: int(b) for h, b in enumerate(self._link_bytes)
+            },
+            "flat_dcn_bytes": int(self._flat_bytes),
+            "hier_dcn_bytes": int(hier),
+            "bytes_ratio": (
+                round(self._flat_bytes / hier, 3) if hier else float("inf")
+            ),
+        }
+
+
+# ---------------------------------------------------------------------------
+# BENCH_DCN artifact producers (bench.py + run_perf_smoke.sh stage (o)).
+# ---------------------------------------------------------------------------
+
+
+def dcn_compare_record(
+    p,
+    c0_rows,
+    c1_rows,
+    clients,
+    num_clients: int,
+    num_hosts: int,
+    round_index: int = 0,
+    seed: int = 0,
+) -> dict:
+    """Fold the SAME cohort uploads flat vs hierarchical in several
+    arrival orders (identity, reversed, PRNG-shuffled — each with every
+    other upload redelivered as a duplicate storm) and hash-compare the
+    committed aggregates: the `dcn_compare` record bench.py embeds and
+    run_perf_smoke.sh gates.
+
+    `c0_rows`/`c1_rows` are cohort-rowed upload residues aligned with
+    `clients`. The gate: `bitwise_equal` (every order, both topologies,
+    one hash) and `bytes_ratio >= ratio_floor` where the floor is
+    cohort/hosts * 0.8 — the hierarchical topology ships at most one
+    partial per (nonempty) host, so the true ratio is cohort/shipping
+    hosts >= cohort/hosts and the 0.8 margin only absorbs geometry, never
+    a broken O(hosts) claim."""
+    clients = np.asarray(clients, dtype=np.int64)
+    c0_rows = np.asarray(c0_rows)
+    c1_rows = np.asarray(c1_rows)
+    k = len(clients)
+    orders = {
+        "identity": np.arange(k),
+        "reversed": np.arange(k)[::-1],
+        "shuffled": np.random.default_rng([int(seed), 3]).permutation(k),
+    }
+    hashes = set()
+    reports = {}
+    for name, order in orders.items():
+        flat = OnlineAccumulator(p)
+        hier = HierarchicalAggregator(p, num_hosts, num_clients)
+        for i in order:
+            c = int(clients[i])
+            nonce = (c, int(round_index))
+            flat.fold(nonce, c0_rows[i], c1_rows[i])
+            hier.fold(nonce, c0_rows[i], c1_rows[i])
+            if i % 2 == 0:   # duplicate storm: redeliver half the uploads
+                flat.fold(nonce, c0_rows[i], c1_rows[i])
+                hier.fold(nonce, c0_rows[i], c1_rows[i])
+        hashes.add(ct_hash(*flat.value()))
+        hashes.add(ct_hash(*hier.value()))
+        reports[name] = hier.report()
+    rep = reports["identity"]
+    ratio_floor = round((k / num_hosts) * 0.8, 3)
+    return {
+        "num_clients": int(num_clients),
+        "cohort_size": int(k),
+        "num_hosts": int(num_hosts),
+        "ct_bytes": int(c0_rows[0].nbytes + c1_rows[0].nbytes),
+        "flat_dcn_bytes": rep["flat_dcn_bytes"],
+        "hier_dcn_bytes": rep["hier_dcn_bytes"],
+        "per_link": rep["per_link"],
+        "shipping_hosts": rep["shipping_hosts"],
+        "bytes_ratio": rep["bytes_ratio"],
+        "ratio_floor": ratio_floor,
+        "ratio_ok": bool(rep["bytes_ratio"] >= ratio_floor),
+        "arrival_orders": list(orders),
+        "bitwise_equal": len(hashes) == 1,
+    }
+
+
+def dcn_compare_smoke_record() -> dict:
+    """The FIXED dcn_compare geometry bench.py embeds and
+    run_perf_smoke.sh stage (o) gates: 16 registered clients, cohort of
+    8, 4 hosts (4 clients per host block), mnist/smallcnn on a tiny ring
+    — the record measures DCN TOPOLOGY, not HE ring cost. Single-sourced
+    here so the drivers cannot silently measure different
+    configurations."""
+    import jax
+    import jax.numpy as jnp
+
+    from hefl_tpu.ckks.keys import CkksContext, keygen
+    from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+    from hefl_tpu.fl.config import StreamConfig, TrainConfig
+    from hefl_tpu.fl.stream import produce_uploads, sample_cohort
+    from hefl_tpu.models import create_model
+    from hefl_tpu.parallel import make_mesh
+
+    module, params = create_model("smallcnn", rng=jax.random.key(7))
+    (x, y), _, _ = make_dataset("mnist", seed=0, n_train=64, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(len(x), 16))
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(77))
+    cfg = TrainConfig(epochs=1, batch_size=8, num_classes=10,
+                      augment=False, val_fraction=0.25)
+    s = StreamConfig(cohort_size=8, num_hosts=4)
+    cohort = sample_cohort(s, 0, 16)
+    part = np.zeros(16, np.int32)
+    part[cohort] = 1
+    cts = produce_uploads(
+        module, cfg, make_mesh(16), ctx, pk, params,
+        jnp.asarray(xs), jnp.asarray(ys), jax.random.key(78),
+        participation=part, cohort=cohort,
+    )[0]
+    return dcn_compare_record(
+        ctx.ntt.p, np.asarray(cts.c0), np.asarray(cts.c1), cohort,
+        num_clients=16, num_hosts=4,
+    )
+
+
+def _main() -> int:
+    """Standalone BENCH_DCN writer (run_tpu_suite.sh stage 9):
+    `python -m hefl_tpu.fl.hierarchy --out BENCH_DCN.json`."""
+    import argparse
+    import json
+
+    import jax
+
+    ap = argparse.ArgumentParser(description=_main.__doc__)
+    ap.add_argument("--out", default="BENCH_DCN.json")
+    args = ap.parse_args()
+    rec = dcn_compare_smoke_record()
+    artifact = {
+        "platform": jax.devices()[0].platform,
+        "device_count": jax.device_count(),
+        "dcn_compare": rec,
+        "metrics": obs_metrics.snapshot(),
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+    print(
+        f"dcn_compare: cohort={rec['cohort_size']} hosts={rec['num_hosts']}"
+        f" ratio={rec['bytes_ratio']} (floor {rec['ratio_floor']})"
+        f" bitwise_equal={rec['bitwise_equal']} -> {args.out}"
+    )
+    return 0 if (rec["bitwise_equal"] and rec["ratio_ok"]) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())
+
+
+__all__ = [
+    "TIER_CRASH_POINTS",
+    "TierCrash",
+    "HierarchicalAggregator",
+    "dcn_compare_record",
+    "dcn_compare_smoke_record",
+]

@@ -526,8 +526,12 @@ def produce_uploads(
                 "cohort-only training requires the hoisted shuffle "
                 "streams (TrainConfig.flat_scan=True — the default — or "
                 "the fused backend): the nested scan layout's in-body "
-                "shuffle sort is placement-coupled under sharding; set "
-                "flat_scan=True or StreamConfig.cohort_only=False"
+                "shuffle sort is placement-coupled under sharding, so a "
+                "cohort gather could silently diverge bitwise. Either "
+                "set flat_scan=True (keeps cohort-only training) or "
+                "train the full registry with the un-hoisted layout via "
+                "StreamConfig.cohort_only=False — the CLI escape hatch "
+                "is --full-cohort-train"
             )
         n_c = len(cohort)
         bucket = cohort_bucket(n_c, num_clients, n_dev)
@@ -1187,7 +1191,17 @@ class StreamEngine:
 
         # ---- process arrivals in time order ------------------------------
         deadline = s.deadline_s if s.deadline_s > 0 else float("inf")
-        acc = OnlineAccumulator(ctx.ntt.p)
+        if s.num_hosts >= 2:
+            # Hierarchical multi-host fold (ISSUE 16): each host's tier
+            # folds its contiguous client block locally and ships ONE
+            # partial ciphertext across the simulated DCN at commit time
+            # — O(hosts) cross-host bytes, bitwise the flat fold (lazy
+            # import: hierarchy pulls this module).
+            from hefl_tpu.fl.hierarchy import HierarchicalAggregator
+
+            acc = HierarchicalAggregator(ctx.ntt.p, s.num_hosts, num_clients)
+        else:
+            acc = OnlineAccumulator(ctx.ntt.p)
         staleness_hist = obs_metrics.histogram("stream.staleness_rounds")
         committed_at: float | None = None
         fresh = stale_folded = arrivals = rejected = 0
@@ -1440,6 +1454,14 @@ class StreamEngine:
         obs_events.emit(
             "stream_round", round=round_index, **smeta.record()
         )
+        if s.num_hosts >= 2 and committed:
+            # One DCN-traffic summary per committed hierarchical round:
+            # per-uplink bytes, the flat-topology model for the same
+            # folds, and their ratio. The commit seals the fold set, so
+            # shipping here (idempotent) makes the counters final even on
+            # journal-less rounds where value() runs later.
+            acc.ship_all()
+            obs_events.emit("dcn_round", round=round_index, **acc.report())
         # Quorum-wait span: how long (simulated) the round held open before
         # committing — the streaming analog of the straggler wait.
         obs_events.emit(

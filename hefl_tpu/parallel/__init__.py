@@ -17,6 +17,9 @@ from hefl_tpu.parallel.mesh import (
     client_axes,
     client_mesh_size,
     ct_shard_count,
+    dcn_link_names,
+    host_count,
+    host_of_clients,
     local_client_count,
     make_ct_mesh,
     make_host_mesh,
@@ -25,6 +28,7 @@ from hefl_tpu.parallel.mesh import (
     shard_map,
 )
 from hefl_tpu.parallel.collectives import (
+    dcn_traffic_model,
     hierarchical_psum_mod,
     pmean_tree,
     psum_mod,
@@ -39,6 +43,10 @@ __all__ = [
     "client_axes",
     "client_mesh_size",
     "ct_shard_count",
+    "dcn_link_names",
+    "dcn_traffic_model",
+    "host_count",
+    "host_of_clients",
     "make_mesh",
     "make_mesh_2d",
     "make_host_mesh",

@@ -176,6 +176,58 @@ def hierarchical_psum_mod(
     return residues
 
 
+def dcn_traffic_model(
+    num_participants: int,
+    num_hosts: int,
+    ct_nbytes: int,
+    participants_per_host: tuple[int, ...] | None = None,
+) -> dict:
+    """Per-round cross-host (simulated-DCN) byte cost of the two aggregation
+    topologies on a ("hosts", "clients") mesh — host-side arithmetic, no jax.
+
+    Flat aggregation ships every participant's ciphertext across the
+    cross-host link to one root: `num_participants * ct_nbytes`. The
+    hierarchical fold (`hierarchical_psum_mod` on the mesh; fl.hierarchy's
+    `HierarchicalAggregator` off it) reduces each host's block over ICI
+    first and crosses DCN with exactly ONE partial ciphertext per host that
+    holds any participant: at most `num_hosts * ct_nbytes`, i.e. O(hosts)
+    instead of O(cohort). `participants_per_host` (when known) tightens the
+    hierarchical cost to the NONEMPTY hosts — an outage-darkened host ships
+    nothing. This model is what the `dcn.link.*` obs counters measure and
+    what the BENCH_DCN gate checks against.
+    """
+    if num_participants < 0 or num_hosts < 1 or ct_nbytes < 1:
+        raise ValueError(
+            f"dcn_traffic_model: participants={num_participants} "
+            f"hosts={num_hosts} ct_nbytes={ct_nbytes}"
+        )
+    if participants_per_host is not None:
+        if len(participants_per_host) != num_hosts:
+            raise ValueError(
+                f"participants_per_host has {len(participants_per_host)} "
+                f"entries for {num_hosts} hosts"
+            )
+        if sum(participants_per_host) != num_participants:
+            raise ValueError(
+                f"participants_per_host sums to {sum(participants_per_host)}"
+                f", expected {num_participants}"
+            )
+        shipping = sum(1 for n in participants_per_host if n > 0)
+    else:
+        shipping = min(num_hosts, num_participants)
+    flat = num_participants * ct_nbytes
+    hier = shipping * ct_nbytes
+    return {
+        "num_participants": int(num_participants),
+        "num_hosts": int(num_hosts),
+        "shipping_hosts": int(shipping),
+        "ct_bytes": int(ct_nbytes),
+        "flat_dcn_bytes": int(flat),
+        "hier_dcn_bytes": int(hier),
+        "bytes_ratio": (flat / hier) if hier else float("inf"),
+    }
+
+
 def ring_psum_mod(residues: jax.Array, p: jax.Array, axis_name: str) -> jax.Array:
     """Modular all-reduce as an explicit ppermute ring — no participant cap.
 

@@ -167,6 +167,43 @@ def local_client_count(mesh: Mesh, num_clients: int) -> int:
     return num_clients // client_mesh_size(mesh)
 
 
+def host_count(mesh: Mesh) -> int:
+    """Host rows this mesh models (1 on every single-host topology)."""
+    if HOST_AXIS in mesh.axis_names:
+        return int(mesh.shape[HOST_AXIS])
+    return 1
+
+
+def host_of_clients(num_clients: int, num_hosts: int) -> np.ndarray:
+    """int64[num_clients]: which host row owns each client slot.
+
+    The PR-15 layout contract, made queryable: the client axis is laid out
+    outer/slowest, so host h owns the CONTIGUOUS block of
+    ceil(num_clients / num_hosts) client slots starting at
+    h * ceil(num_clients / num_hosts) — exactly the row-major assignment
+    `make_host_mesh` gives a ("hosts", "clients") mesh. The hierarchical
+    aggregation tier (fl.hierarchy) and the regional-outage fault schedule
+    (fl.faults) both key off this map, so "a host's cohort block is
+    host-local" means the same clients everywhere.
+    """
+    if num_hosts < 1:
+        raise ValueError(f"host_of_clients: num_hosts={num_hosts} must be >= 1")
+    if num_clients < num_hosts:
+        raise ValueError(
+            f"host_of_clients: {num_hosts} hosts over {num_clients} clients "
+            "would leave empty host rows; use num_hosts <= num_clients"
+        )
+    per_host = -(-num_clients // num_hosts)
+    return np.arange(num_clients, dtype=np.int64) // per_host
+
+
+def dcn_link_names(num_hosts: int) -> tuple[str, ...]:
+    """The simulated-DCN uplinks of the two-tier aggregation topology:
+    one host->root link per host row (h{h}_root). Per-link byte counters
+    ride the obs registry as `dcn.link.<name>.bytes` — see fl.hierarchy."""
+    return tuple(f"h{h}_root" for h in range(int(num_hosts)))
+
+
 def make_ct_mesh(devices: list | None = None, max_devices: int | None = None) -> Mesh:
     """1-D mesh over the ciphertext-batch axis ``"ct"`` (ISSUE 4).
 

@@ -57,9 +57,16 @@
 #       two runs' final params must be BITWISE equal — the cohort gather
 #       cannot change a single committed bit under the full chaos
 #       schedule.
+#   (k) hierarchical aggregation twin (ISSUE 16): the streaming schedule
+#       re-run flat (num_hosts=0) AND through the two-tier fold tree
+#       (num_hosts=4), under a duplicate storm and under a regional
+#       outage (1 of 4 hosts dark — the --outage-hosts schedule, seen
+#       identically by both twins). Every round must commit in both with
+#       identical stream records, and the final params must be BITWISE
+#       equal — the fold tree commits exactly the flat aggregate.
 # Artifact: CHAOS_SMOKE.json (accuracy curves + per-round exclusions
 # + the events.jsonl cross-checks, streaming + crash-recovery + HHE +
-# cohort-only twins included).
+# cohort-only + hierarchical twins included).
 # Wired into run_tpu_suite.sh as stage 0b (CPU-only, no TPU probe needed).
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -699,6 +706,75 @@ if recovered is not None:
         "recovered_report": rec,
     }
 
+# (k) hierarchical aggregation twin (ISSUE 16): flat (num_hosts=0) vs
+# two-tier (num_hosts=4) engines at the SAME 8-client streaming
+# schedule, under a duplicate storm (3 duplicated deliveries) and under
+# a regional outage (1 of 4 hosts dark for the round — the
+# --outage-hosts schedule; the flat twin sees the identical schedule,
+# only its aggregation topology differs). Gates: every round's stream
+# record identical between the twins and the final params BITWISE
+# equal — the fold tree commits exactly the flat aggregate under chaos.
+hier_checks = {}
+hier_storm_faults = dataclasses.replace(
+    recovery_faults, duplicate_clients=3, arrival_delay_s=0.5,
+)
+# The outage leg swaps the generic dropout/poison draws for the
+# regional schedule (stragglers/retries stay): stacking a 2-client
+# outage on top of the 25% dropout would push rounds below the 3/8
+# quorum — a correct degrade, but this leg gates COMMITTED equality.
+hier_outage_faults = dataclasses.replace(
+    recovery_faults, drop_fraction=0.0, nan_clients=0,
+    duplicate_clients=0, outage_hosts=1, num_hosts=4,
+)
+for hname, hfaults in (("duplicate-storm", hier_storm_faults),
+                       ("regional-outage", hier_outage_faults)):
+    hflat_cfg = dataclasses.replace(
+        stream_cfg, faults=hfaults, events_path="",
+    )
+    hhier_cfg = dataclasses.replace(
+        hflat_cfg,
+        stream=dataclasses.replace(hflat_cfg.stream, num_hosts=4),
+    )
+    print(f"chaos smoke: hierarchical twin ({hname}, 4 hosts) ...",
+          flush=True)
+    hflat_run = run_experiment(hflat_cfg, verbose=False)
+    hhier_run = run_experiment(hhier_cfg, verbose=False)
+    hier_equal = True
+    for a, b in zip(
+        _jax_s.tree_util.tree_leaves(hflat_run["params"]),
+        _jax_s.tree_util.tree_leaves(hhier_run["params"]),
+    ):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            hier_equal = False
+            fail.append(
+                f"hierarchical twin ({hname}): final params differ "
+                "bitwise from the flat-aggregation twin"
+            )
+            break
+    for r, (rec_fl, rec_hi) in enumerate(
+        zip(hflat_run["history"], hhier_run["history"])
+    ):
+        for tname, rec_ in (("flat", rec_fl), ("hierarchical", rec_hi)):
+            if not (rec_.get("stream") or {}).get("committed"):
+                fail.append(
+                    f"hierarchical twin ({hname}, {tname}) round {r}: "
+                    "did not commit"
+                )
+        if rec_fl.get("stream") != rec_hi.get("stream"):
+            fail.append(
+                f"hierarchical twin ({hname}) round {r}: stream record "
+                "diverged between the flat and hierarchical topologies"
+            )
+    hier_checks[hname] = {
+        "num_hosts": 4,
+        "bitwise_equal_to_flat": hier_equal,
+        "acc_hier_by_round": [h["accuracy"] for h in hhier_run["history"]],
+        "rounds_committed": [
+            r for r, h in enumerate(hhier_run["history"])
+            if (h.get("stream") or {}).get("committed")
+        ],
+    }
+
 artifact = {
     "preset": "chaos-smoke",
     "acc_clean_by_round": [h["accuracy"] for h in clean["history"]],
@@ -724,6 +800,10 @@ artifact = {
     # The cohort-only twin's cross-check (bitwise equality vs the full-C
     # producer + unsampled attribution, ISSUE 15).
     "cohort_check": cohort_summary,
+    # The hierarchical-aggregation twins' cross-check (flat vs two-tier
+    # bitwise equality under duplicate-storm and regional-outage
+    # schedules, ISSUE 16).
+    "hier_check": hier_checks,
     "passed": not fail,
     "failures": fail,
 }
@@ -748,6 +828,8 @@ print(
     f"every round at {hrec.get('expansion_hhe') if isinstance(hrec, dict) else '?'}x "
     "wire expansion with counters matching the same schedule, and the "
     "cohort-only twin (6/8) committed every round bitwise-equal to its "
-    "full-C-trained twin"
+    "full-C-trained twin, and the hierarchical twins (4 hosts) committed "
+    "bitwise-equal to flat aggregation under both the duplicate-storm "
+    "and regional-outage schedules"
 )
 PY

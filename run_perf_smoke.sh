@@ -62,7 +62,12 @@
 #       per mesh axis) must be present with bitwise_equal true — the
 #       committed aggregate of the cohort-gathered producer hash-equal to
 #       the full-C masked path — and the cohort-only speedup at
-#       cohort 2-of-16 must clear the >= 2x floor on the CPU smoke.
+#       cohort 2-of-16 must clear the >= 2x floor on the CPU smoke;
+#   (o) hierarchical aggregation (ISSUE 16): the standalone BENCH_DCN
+#       smoke record — flat O(cohort) vs two-tier O(hosts) cross-host
+#       bytes at cohort 8-of-16 over 4 hosts — must clear the
+#       cohort/hosts*0.8 bytes-ratio floor with the committed aggregates
+#       bitwise-equal in every tested arrival order.
 # Wired into run_tpu_suite.sh as stage 0 (cheap pre-stage, no backend
 # probe needed — both harnesses pin themselves to CPU in smoke mode).
 set -euo pipefail
@@ -180,6 +185,67 @@ print(
     f"inference smoke OK: {len(rows)} serving rows with QPS/p50/p95/p99, "
     f"{len(certs)} certificates (ladder + keyswitch gadget per ring), "
     f"analysis.violations=0, batched-vs-single {speedup}x (>= 1.3x)"
+)
+PY
+
+# (o) hierarchical aggregation (ISSUE 16): the standalone BENCH_DCN
+# producer at the cohort-8-of-16 / 4-host smoke geometry. Flat-vs-
+# hierarchical cross-host bytes must clear the cohort/hosts*0.8 ratio
+# floor and the committed aggregates must be bitwise-equal in EVERY
+# tested arrival order (identity/reversed/shuffled, each with duplicate
+# redeliveries) — the module itself exits nonzero on either gate, and
+# the schema gate below keeps the artifact honest.
+JAX_PLATFORMS=cpu python -m hefl_tpu.fl.hierarchy \
+  --out "$workdir/BENCH_DCN_SMOKE.json" > "$workdir/dcn_smoke.out" || {
+  echo "PERF SMOKE FAILED: BENCH_DCN gates (bytes ratio / bitwise equality):"
+  tail -20 "$workdir/dcn_smoke.out"
+  exit 1
+}
+python - "$workdir/BENCH_DCN_SMOKE.json" <<'PY'
+import json
+import sys
+
+fail = []
+art = json.load(open(sys.argv[1]))
+rec = art.get("dcn_compare")
+if not isinstance(rec, dict):
+    fail.append("BENCH_DCN: missing dcn_compare record")
+    rec = {}
+for field in ("num_clients", "cohort_size", "num_hosts", "ct_bytes",
+              "flat_dcn_bytes", "hier_dcn_bytes", "per_link",
+              "shipping_hosts", "bytes_ratio", "ratio_floor",
+              "arrival_orders", "bitwise_equal"):
+    if rec.get(field) is None:
+        fail.append(f"BENCH_DCN: dcn_compare.{field} missing/null")
+if rec.get("bitwise_equal") is not True:
+    fail.append(
+        "BENCH_DCN: hierarchical aggregate is NOT bitwise-equal to the "
+        "flat fold across the tested arrival orders"
+    )
+ratio, floor = rec.get("bytes_ratio"), rec.get("ratio_floor")
+if (
+    isinstance(ratio, (int, float)) and isinstance(floor, (int, float))
+    and ratio < floor
+):
+    fail.append(
+        f"BENCH_DCN: flat/hier bytes ratio {ratio}x is below the "
+        f"cohort/hosts floor {floor}x — the hierarchy is not O(hosts)"
+    )
+links = rec.get("per_link")
+if isinstance(links, dict) and len(links) != rec.get("num_hosts"):
+    fail.append(
+        f"BENCH_DCN: per_link has {len(links)} uplinks for "
+        f"{rec.get('num_hosts')} hosts"
+    )
+if fail:
+    print("PERF SMOKE FAILED (DCN stage):")
+    for f in fail:
+        print(" -", f)
+    sys.exit(1)
+print(
+    f"dcn smoke OK: flat {rec['flat_dcn_bytes']}B vs hier "
+    f"{rec['hier_dcn_bytes']}B = {ratio}x (floor {floor}x), "
+    f"bitwise-equal across {len(rec['arrival_orders'])} arrival orders"
 )
 PY
 
@@ -600,7 +666,8 @@ print(
     "clock, no unflagged utilization > 1, events.jsonl schema valid, "
     "packing + bytes_on_wire rows present with the k-fold reduction and "
     ">=1.5x HE speedups, cohort_compare bitwise-equal with the >=2x "
-    "cohort-only floor, hefl-lint clean with analysis.violations=0 "
-    "embedded in the run metrics"
+    "cohort-only floor, BENCH_DCN flat-vs-hier ratio over the "
+    "cohort/hosts floor with arrival-order bitwise equality, hefl-lint "
+    "clean with analysis.violations=0 embedded in the run metrics"
 )
 PY

@@ -110,6 +110,16 @@ t0=$SECONDS
 HEFL_JOURNAL_FSYNC=always python -m pytest -q -m "not slow" \
   tests/test_journal.py
 echo "== journal shard (fsync=always): $((SECONDS - t0))s"
+# Hierarchical-aggregation shard (ISSUE 16): the two-tier fold tree —
+# flat-vs-hierarchical bitwise equality across arrival orders, the
+# TierCrash recovery matrix, engine twins under duplicate-storm and
+# regional-outage schedules — re-run with every tier journal under
+# fsync policy "always", so the per-tier WAL path gets the same
+# maximum-durability coverage the root journal shard gives journal.py.
+t0=$SECONDS
+HEFL_JOURNAL_FSYNC=always python -m pytest -q -m "not slow" \
+  tests/test_hierarchy.py
+echo "== hierarchical-aggregation shard (fsync=always): $((SECONDS - t0))s"
 # Analysis shard (ISSUE 8/12): the FULL static-analysis gate (no --fast)
 # — everything the pre-shard ran plus the scope-coverage stages, which
 # compile the real round programs (both fusion backends + the secure

@@ -175,4 +175,8 @@ run_stage stage8 900 FIDELITY_TABLE.md fidelity_err.log python fidelity_check.py
   || git checkout -- fidelity_check.json 2>/dev/null \
   || rm -f fidelity_check.json  # table and json must stay one consistent pair
 
+echo "=== stage 9: hierarchical-aggregation DCN bench (flat vs two-tier)"
+run_stage stage9 900 BENCH_DCN.json dcn_err.log \
+  python -m hefl_tpu.fl.hierarchy --out BENCH_DCN.json
+
 echo "=== suite pass complete: $(ls suite_state)"

@@ -92,6 +92,32 @@ def test_cohort_bucket_ladder():
     np.testing.assert_array_equal(idx, [3, 5, 9, 0])
 
 
+def test_cohort_bucket_edges():
+    # cohort == registry: the bucket IS the full-C padded shape on every
+    # mesh — cohort-only training of the whole registry costs exactly the
+    # historical full-C program, never more.
+    assert cohort_bucket(16, 16, 1) == 16
+    assert cohort_bucket(16, 16, 4) == 16
+    assert cohort_bucket(6, 6, 1) == 6     # non-pow2 registry, width-1 mesh
+    assert cohort_bucket(4, 4, 4) == 4     # C == n_dev: full width is 1
+    # cohort == 1: floored at 2 slots per device whenever the full-C
+    # program runs grouped (>= 2 wide), so the bitwise floor holds even
+    # for a single sampled client; a 1-client registry has no grouped
+    # reference and buckets at 1.
+    assert cohort_bucket(1, 16, 1) == 2
+    assert cohort_bucket(1, 16, 4) == 8    # mesh-divisible AND 2/device
+    assert cohort_bucket(1, 1, 1) == 1
+    # bucket exactly AT the full-C padded cap: next-pow2 lands on the
+    # padded shape itself — capped and exact, not clamped below
+    assert cohort_bucket(9, 16, 4) == 16   # pow2 16 == full padded 16
+    assert cohort_bucket(5, 6, 4) == 8     # pow2 8 == full padded 8 (6->8)
+    assert cohort_bucket(8, 8, 1) == 8
+    # the gather index at the full-registry bucket is the identity-sized
+    # cohort with no padding rows
+    idx = cohort_gather_index(np.arange(16), cohort_bucket(16, 16, 1))
+    np.testing.assert_array_equal(idx, np.arange(16))
+
+
 def test_cohort_gather_refuses_unhoisted_nested_layout():
     # flat_scan=False (the nested semantics-reference layout) derives its
     # shuffle sort inside the sharded region, where placement coupling is

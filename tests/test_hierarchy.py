@@ -1,0 +1,337 @@
+"""Hierarchical multi-host aggregation tests (ISSUE 16):
+
+  * client -> host placement (contiguous blocks, the make_host_mesh
+    layout) + DCN uplink naming + the flat-vs-hier traffic model
+  * fold-tree certificate: certify_fold_tree extends the inductive fold
+    proof with the tree facts and is required at construction
+  * flat-vs-hierarchical BITWISE equality (hash-gated) in every arrival
+    order, under duplicate storms, and at every host count
+  * simulated-DCN accounting: per-uplink byte counters, O(hosts) bytes
+    ratio, the BENCH_DCN record gates
+  * per-tier journals: TierCrash kill-at-every-boundary recovery matrix
+    — recovery re-folds (never double-counts) and reaches the bitwise
+    state of an uninterrupted run
+  * engine integration: StreamEngine twins (num_hosts=0 vs 4) commit
+    identical aggregates and round records, with and without faults,
+    including the regional-outage (--outage-hosts) schedule
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu.analysis.ranges import certify_fold_inductive, certify_fold_tree
+from hefl_tpu.ckks.keys import CkksContext, keygen
+from hefl_tpu.fl import (
+    FaultConfig,
+    HierarchicalAggregator,
+    SimulatedCrash,
+    StreamConfig,
+    StreamEngine,
+    TierCrash,
+    TrainConfig,
+    dcn_compare_record,
+    schedule_for_round,
+)
+from hefl_tpu.fl.hierarchy import TIER_CRASH_POINTS
+from hefl_tpu.fl.stream import OnlineAccumulator, ct_hash
+from hefl_tpu.models import SmallCNN
+from hefl_tpu.obs import metrics as obs_metrics
+from hefl_tpu.parallel import (
+    dcn_link_names,
+    dcn_traffic_model,
+    host_of_clients,
+    make_mesh,
+)
+
+CFG = TrainConfig(
+    epochs=1, batch_size=4, num_classes=10, augment=False, val_fraction=0.25
+)
+
+P = 134215681  # a CKKS ring prime (< 2**27: the certified fold range)
+
+
+def _uploads(k=8, limbs=3, n=8, seed=0, p=P):
+    """k cohort uploads of (limbs, n) canonical residues + the flat fold
+    hash they must commit to."""
+    rng = np.random.default_rng(seed)
+    ups = [
+        (
+            (0, c, 0),   # nonce[-2] is the client index (engine layout)
+            rng.integers(0, p, size=(limbs, n), dtype=np.uint32),
+            rng.integers(0, p, size=(limbs, n), dtype=np.uint32),
+        )
+        for c in range(k)
+    ]
+    flat = OnlineAccumulator(p)
+    for nonce, c0, c1 in ups:
+        flat.fold(nonce, c0, c1)
+    return ups, ct_hash(*flat.value())
+
+
+# ----------------------------------------------------- placement + model
+
+
+def test_host_of_clients_contiguous_blocks():
+    np.testing.assert_array_equal(
+        host_of_clients(8, 4), [0, 0, 1, 1, 2, 2, 3, 3]
+    )
+    np.testing.assert_array_equal(host_of_clients(4, 4), [0, 1, 2, 3])
+    # uneven registry: ceil-sized blocks, every host <= block size
+    m = host_of_clients(10, 4)
+    np.testing.assert_array_equal(m, [0, 0, 0, 1, 1, 1, 2, 2, 2, 3])
+    # blocks are contiguous (non-decreasing) for ANY geometry
+    for c, h in ((16, 3), (7, 2), (31, 5)):
+        mm = host_of_clients(c, h)
+        assert np.all(np.diff(mm) >= 0) and mm.max() == h - 1
+    with pytest.raises(ValueError, match="empty host"):
+        host_of_clients(3, 4)
+    with pytest.raises(ValueError, match=">= 1"):
+        host_of_clients(4, 0)
+
+
+def test_dcn_link_names_and_traffic_model():
+    assert dcn_link_names(3) == ("h0_root", "h1_root", "h2_root")
+    m = dcn_traffic_model(8, 4, 192)
+    assert m["flat_dcn_bytes"] == 8 * 192
+    assert m["hier_dcn_bytes"] == 4 * 192
+    assert m["bytes_ratio"] == 2.0 and m["shipping_hosts"] == 4
+    # fewer participants than hosts: only that many tiers ship
+    m = dcn_traffic_model(2, 4, 100)
+    assert m["shipping_hosts"] == 2 and m["hier_dcn_bytes"] == 200
+    # explicit per-host occupancy: empty hosts ship nothing
+    m = dcn_traffic_model(6, 4, 10, participants_per_host=[6, 0, 0, 0])
+    assert m["shipping_hosts"] == 1 and m["bytes_ratio"] == 6.0
+
+
+def test_certify_fold_tree_extends_inductive_certificate():
+    base = certify_fold_inductive(P)
+    tree = certify_fold_tree(P)
+    assert base.ok and tree.ok
+    # the tree certificate carries every inductive check PLUS the two
+    # tree facts (tier partials canonical; fold-tree = flat bitwise)
+    assert set(base.checks) < set(tree.checks)
+    assert any("fold-tree" in c for c in tree.checks)
+    assert certify_fold_tree(P) is tree   # cached
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_topology_validation():
+    with pytest.raises(ValueError, match="num_hosts"):
+        HierarchicalAggregator(P, 1, 8)
+    with pytest.raises(ValueError, match="num_hosts=1"):
+        StreamConfig(num_hosts=1)
+    StreamConfig(num_hosts=0)
+    StreamConfig(num_hosts=4)
+    with pytest.raises(ValueError, match="at"):
+        TierCrash(at="sometime")
+    with pytest.raises(ValueError, match="after_folds"):
+        TierCrash(after_folds=0)
+    with pytest.raises(ValueError, match="num_hosts"):
+        FaultConfig(outage_hosts=1)
+    with pytest.raises(ValueError, match="outage_hosts"):
+        FaultConfig(outage_hosts=4, num_hosts=4)
+
+
+# ------------------------------------------- flat-vs-hier bitwise equality
+
+
+@pytest.mark.parametrize("num_hosts", [2, 3, 4])
+def test_fold_tree_bitwise_equals_flat_any_order(num_hosts):
+    ups, want = _uploads(k=8)
+    for seed in range(3):
+        order = np.random.default_rng(seed).permutation(len(ups))
+        hier = HierarchicalAggregator(P, num_hosts, 8)
+        for i in order:
+            nonce, c0, c1 = ups[i]
+            assert hier.fold(nonce, c0, c1)
+            if i % 2 == 0:   # duplicate storm: redeliver half
+                assert not hier.fold(nonce, c0, c1)
+        assert hier.folded == len(ups)
+        assert hier.duplicates == 4
+        assert ct_hash(*hier.value()) == want
+
+
+def test_ship_seals_tree_and_counts_links():
+    ups, want = _uploads(k=8)
+    base = obs_metrics.snapshot()
+    hier = HierarchicalAggregator(P, 4, 8)
+    for nonce, c0, c1 in ups:
+        hier.fold(nonce, c0, c1)
+    assert ct_hash(*hier.value()) == want
+    rep = hier.report()
+    nbytes = ups[0][1].nbytes + ups[0][2].nbytes
+    # O(hosts): one partial ct per uplink, flat would ship the cohort
+    assert rep["per_link"] == {f"h{h}_root": nbytes for h in range(4)}
+    assert rep["hier_dcn_bytes"] == 4 * nbytes
+    assert rep["flat_dcn_bytes"] == 8 * nbytes
+    assert rep["bytes_ratio"] == 2.0 and rep["shipping_hosts"] == 4
+    d = obs_metrics.snapshot_delta(base)
+    assert d.get("dcn.hier.bytes") == 4 * nbytes
+    assert d.get("dcn.flat.bytes") == 8 * nbytes
+    assert d.get("dcn.link.h2_root.bytes") == nbytes
+    # sealed: the committed hash is journaled — no late folds
+    with pytest.raises(RuntimeError, match="sealed"):
+        hier.fold((0, 0, 1), ups[0][1], ups[0][2])
+
+
+def test_empty_tiers_ship_nothing_and_empty_tree_zeros():
+    ups, _ = _uploads(k=2)
+    hier = HierarchicalAggregator(P, 4, 8)
+    for nonce, c0, c1 in ups:   # clients 0, 1 -> host 0 only
+        hier.fold(nonce, c0, c1)
+    hier.ship_all()
+    assert hier.report()["shipping_hosts"] == 1
+    empty = HierarchicalAggregator(P, 4, 8)
+    c0, c1 = empty.value(like_shape=(3, 8))
+    assert not c0.any() and not c1.any() and c0.shape == (3, 8)
+
+
+def test_dcn_compare_record_gates():
+    ups, _ = _uploads(k=8)
+    rec = dcn_compare_record(
+        P,
+        [u[1] for u in ups],
+        [u[2] for u in ups],
+        [u[0][-2] for u in ups],
+        8, 4,
+    )
+    assert rec["bitwise_equal"] is True
+    assert rec["ratio_floor"] == round(8 / 4 * 0.8, 3)
+    assert rec["bytes_ratio"] >= rec["ratio_floor"] and rec["ratio_ok"]
+    assert rec["arrival_orders"] == ["identity", "reversed", "shuffled"]
+    assert len(rec["per_link"]) == 4 and rec["shipping_hosts"] == 4
+
+
+# ----------------------------------------------- tier crash recovery matrix
+
+
+@pytest.mark.parametrize("at", TIER_CRASH_POINTS)
+def test_tier_crash_recovery_matrix(tmp_path, at):
+    """Kill host 1's sub-aggregator at every lifecycle boundary; recovery
+    from its journal + a full redelivery must reach the bitwise state of
+    the uninterrupted flat fold without double-counting anything."""
+    ups, want = _uploads(k=8)
+    jdir = str(tmp_path / "tiers")
+    crashed = HierarchicalAggregator(
+        P, 4, 8, journal_dir=jdir,
+        crash=TierCrash(host=1, at=at, after_folds=2),
+    )
+    with pytest.raises(SimulatedCrash):
+        for nonce, c0, c1 in ups:
+            crashed.fold(nonce, c0, c1)
+        crashed.ship_all()
+    crashed.close()
+
+    rec = HierarchicalAggregator(P, 4, 8, journal_dir=jdir)
+    for nonce, c0, c1 in ups:
+        try:
+            rec.fold(nonce, c0, c1)
+        except RuntimeError:
+            # that tier shipped its (complete) partial during recovery —
+            # the redelivered upload is already inside it
+            pass
+    assert rec.folded == len(ups)
+    assert ct_hash(*rec.value(like_shape=ups[0][1].shape)) == want
+    rec.close()
+
+    # recovery is idempotent: a third process over the shipped journals
+    # reconstructs the same committed aggregate
+    again = HierarchicalAggregator(P, 4, 8, journal_dir=jdir)
+    assert again.refolded == len(ups)
+    assert ct_hash(*again.value()) == want
+    again.close()
+
+
+def test_tier_journal_topology_mismatch_rejected(tmp_path):
+    from hefl_tpu.fl import journal as jr
+
+    jdir = str(tmp_path / "tiers")
+    agg = HierarchicalAggregator(P, 4, 8, journal_dir=jdir)
+    agg.close()
+    with pytest.raises(jr.JournalError, match="topology"):
+        HierarchicalAggregator(P, 2, 8, journal_dir=jdir)
+
+
+# --------------------------------------------------- regional-outage faults
+
+
+def test_outage_schedule_darkens_contiguous_host_blocks():
+    fc = FaultConfig(seed=3, outage_hosts=1, num_hosts=4)
+    sched = schedule_for_round(fc, 0, 16)
+    hosts = host_of_clients(16, 4)
+    dark = sorted(set(int(hosts[c]) for c in np.flatnonzero(sched.dropped)))
+    assert len(dark) == 1
+    # the WHOLE block is dark, nothing else
+    np.testing.assert_array_equal(sched.dropped, np.isin(hosts, dark))
+    # deterministic per (seed, round); different rounds vary the host
+    again = schedule_for_round(fc, 0, 16)
+    np.testing.assert_array_equal(sched.dropped, again.dropped)
+    darks = set()
+    for r in range(8):
+        s = schedule_for_round(fc, r, 16)
+        darks |= set(hosts[np.flatnonzero(s.dropped)].tolist())
+    assert len(darks) > 1
+    # additive over the dropout draw: outage only ADDS exclusions
+    fc2 = FaultConfig(seed=3, drop_fraction=0.25)
+    fc3 = dataclasses.replace(fc2, outage_hosts=1, num_hosts=4)
+    base = schedule_for_round(fc2, 1, 16).dropped
+    both = schedule_for_round(fc3, 1, 16).dropped
+    assert np.all(both[base])
+    # the worst-case exclusion bound covers the darkened block
+    assert fc.max_scheduled_exclusions(16) >= 4
+
+
+# --------------------------------------------------------- engine twins
+
+
+def _engine_setup(num_clients=8, per_client=8, seed=0):
+    n = num_clients * per_client
+    from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+
+    (x, y), _, _ = make_dataset("mnist", seed=seed, n_train=n, n_test=8)
+    xs, ys = stack_federated(x, y, iid_contiguous(n, num_clients))
+    model = SmallCNN(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    return model, params, jnp.asarray(xs), jnp.asarray(ys)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "faults",
+    [
+        None,
+        FaultConfig(seed=5, duplicate_clients=2, arrival_delay_s=1.0),
+        FaultConfig(seed=5, outage_hosts=1, num_hosts=4),
+    ],
+    ids=["clean", "duplicate-storm", "regional-outage"],
+)
+def test_engine_hierarchical_twin_matches_flat(faults):
+    """StreamEngine rounds with num_hosts=4 commit the SAME ciphertext
+    sum and the SAME round record as the flat engine at the identical
+    schedule — the engine-level half of the tentpole equality gate."""
+    num_clients = 8
+    model, params, xs, ys = _engine_setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(21))
+    results = {}
+    for name, hosts in (("flat", 0), ("hier", 4)):
+        s = StreamConfig(
+            cohort_size=4, quorum=0.5, deadline_s=2.0, num_hosts=hosts
+        )
+        eng = StreamEngine(s, faults)
+        ct, mets, ov, smeta = eng.run_round(
+            model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(22), 0
+        )
+        assert smeta.committed
+        results[name] = (
+            ct_hash(np.asarray(ct.c0), np.asarray(ct.c1)), smeta.record()
+        )
+    assert results["flat"][0] == results["hier"][0]
+    assert results["flat"][1] == results["hier"][1]

@@ -571,3 +571,70 @@ def test_cli_flag_guards():
         CrashConfig(at="sometime")
     with pytest.raises(ValueError, match="after_folds"):
         CrashConfig(after_folds=0)
+
+
+# --------------------------------------- tier journal recovery (ISSUE 16)
+
+
+def test_tier_journal_truncated_mid_fold_refolds_not_double_counts(tmp_path):
+    """A sub-aggregator that dies MID-write of a tier_fold frame leaves a
+    REAL torn tail on ITS journal alone.  Recovery must truncate that
+    tail, re-fold exactly the intact journaled uploads (never the torn
+    one), and dedup a full redelivery of the cohort so no upload is ever
+    double-folded — the committed aggregate stays bitwise-equal to the
+    uninterrupted flat fold."""
+    from hefl_tpu.fl import HierarchicalAggregator, OnlineAccumulator, TierCrash
+
+    ctx = CkksContext.create(n=256)
+    p = ctx.ntt.p
+    rng = np.random.default_rng(16)
+    k, hosts, clients = 8, 4, 8
+    lo = int(np.asarray(p).min())
+    ups = [
+        (
+            (0, c, 0),
+            rng.integers(0, lo, size=(3, 8), dtype=np.uint32),
+            rng.integers(0, lo, size=(3, 8), dtype=np.uint32),
+        )
+        for c in range(k)
+    ]
+    flat = OnlineAccumulator(p)
+    for nonce, c0, c1 in ups:
+        flat.fold(nonce, c0, c1)
+    want = ct_hash(*flat.value())
+
+    jdir = str(tmp_path / "tiers")
+    crashed = HierarchicalAggregator(
+        p, hosts, clients, journal_dir=jdir,
+        crash=TierCrash(host=1, at="mid_fold", after_folds=2, torn_bytes=40),
+    )
+    with pytest.raises(SimulatedCrash, match="torn tier_fold"):
+        for nonce, c0, c1 in ups:
+            crashed.fold(nonce, c0, c1)
+    # clients 0,1 -> host 0 (two intact folds); client 2 -> host 1 fold 1
+    # (intact); client 3 -> host 1 fold 2 dies mid-write: torn frame.
+    assert crashed.folded == 3
+    crashed.close()
+
+    base = obs_metrics.snapshot()
+    rec = HierarchicalAggregator(p, hosts, clients, journal_dir=jdir)
+    d = obs_metrics.snapshot_delta(base)
+    assert d.get("journal.torn_tail_truncated", 0) == 1
+    # Recovery RE-FOLDS the three intact journaled uploads — the torn
+    # fourth never counts.
+    assert rec.refolded == 3 and rec.folded == 3
+    assert d.get("recovery.tier_refolded_uploads", 0) == 3
+    # The full redelivery dedups: each already-journaled upload is a
+    # tier-level nonce hit, so nothing is double-counted.
+    for nonce, c0, c1 in ups:
+        rec.fold(nonce, c0, c1)
+    assert rec.folded == k and rec.duplicates == 3
+    assert ct_hash(*rec.value(like_shape=ups[0][1].shape)) == want
+    rec.close()
+
+    # A second recovery over the now-complete (shipped) journals is
+    # idempotent: same count, same committed hash, no re-shipping.
+    again = HierarchicalAggregator(p, hosts, clients, journal_dir=jdir)
+    assert again.refolded == k and again.folded == k
+    assert ct_hash(*again.value()) == want
+    again.close()
