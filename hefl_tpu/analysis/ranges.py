@@ -1269,7 +1269,14 @@ def certify_fold_tree(prime: int) -> FoldCertificate:
         associative and commutative, so any bracketing of the same upload
         multiset — flat, per-host-then-root, any arrival order — yields
         the same canonical residues bit for bit. This is the identity the
-        BENCH_DCN / chaos flat-vs-hierarchical hash gates then measure.
+        BENCH_DCN / chaos flat-vs-hierarchical hash gates then measure;
+      * carried partials stay certified (ISSUE 17) — a sealed tier
+        partial that misses its round's ship and folds at a LATER round's
+        root is still a canonical residue in [0, p-1] (sealing cannot
+        change its value), so the stale tier fold is one more instance of
+        the same certified loop: folding it at round r+k is bitwise
+        folding it at round r, and the released sum it joins remains a
+        sum of certified canonical summands.
 
     Unsafe base certificate => unsafe tree (no tree claim is made on top
     of a broken loop invariant).
@@ -1284,6 +1291,10 @@ def certify_fold_tree(prime: int) -> FoldCertificate:
         "fold-tree = flat fold bitwise: exact canonical add mod p is "
         "associative+commutative, so any bracketing/arrival order of the "
         "same uploads yields identical residues",
+        "carried partials certified: a sealed tier partial is a frozen "
+        "canonical residue, so a stale tier fold at a later round's root "
+        "is the same certified loop on the same value — late folding "
+        "cannot leave the proven region",
     )
     return dataclasses.replace(base, checks=checks)
 

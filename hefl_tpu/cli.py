@@ -159,6 +159,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fault injection: host rows per round whose whole "
                         "contiguous client block is scheduled out (a "
                         "regional outage); requires --num-hosts H >= 2")
+    p.add_argument("--link-loss", type=int, default=0, metavar="K",
+                   help="fault injection: tier->root uplinks per round "
+                        "whose first ship delivery is LOST (recovered by "
+                        "ship retries); requires --num-hosts H >= 2")
+    p.add_argument("--link-dark", type=int, default=0, metavar="K",
+                   help="fault injection: tier->root uplinks per round "
+                        "that lose EVERY ship delivery (the host misses "
+                        "the round as host_unreachable); requires "
+                        "--num-hosts H >= 2")
+    p.add_argument("--link-delay", type=float, default=0.0, metavar="S",
+                   help="fault injection: max per-uplink ship delivery "
+                        "delay in simulated seconds (drawn per round; "
+                        "gated by --ship-deadline); requires --num-hosts")
+    p.add_argument("--link-dup", type=int, default=0, metavar="K",
+                   help="fault injection: tier->root uplinks per round "
+                        "whose ship is delivered TWICE (the root dedups "
+                        "by (host, round, sha)); requires --num-hosts")
     p.add_argument("--fault-seed", type=int, default=0,
                    help="PRNG seed of the fault schedule")
     # --- streaming quorum aggregation (fl/stream.py, README "Streaming
@@ -201,6 +218,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "simulated DCN per round — O(hosts) cross-host "
                         "bytes, bitwise the flat fold; 0 = flat "
                         "single-root aggregation; implies --stream")
+    p.add_argument("--host-quorum", type=float, default=1.0, metavar="Q",
+                   help="fraction of the round's nonempty host tiers "
+                        "whose partials must land at the root to commit; "
+                        "below it the round degrades like a missed client "
+                        "quorum; requires --num-hosts H >= 2")
+    p.add_argument("--ship-deadline", type=float, default=0.0, metavar="S",
+                   help="per-round tier->root ship deadline in simulated "
+                        "seconds from the client-quorum commit point "
+                        "(0 = none; retried deliveries are exempt); "
+                        "requires --num-hosts H >= 2")
+    p.add_argument("--host-staleness", type=int, default=0, metavar="T",
+                   help="tier staleness budget: rounds a host partial "
+                        "that missed its ship may carry forward to fold "
+                        "as a stale tier fold before its clients are "
+                        "excluded as host_stale; requires --num-hosts")
     p.add_argument("--mesh-ct", type=int, default=0, metavar="K",
                    help="2-D (clients, ct) round mesh: give each client "
                         "block K devices that split its in-round "
@@ -306,12 +338,38 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         or args.transient_clients > 0
         or args.permanent_clients > 0
         or args.outage_hosts > 0
+        or args.link_loss > 0
+        or args.link_dark > 0
+        or args.link_delay > 0
+        or args.link_dup > 0
         or fail_rounds
     )
     if args.outage_hosts > 0 and args.num_hosts < 2:
         raise SystemExit(
             "--outage-hosts darkens host rows of the hierarchical "
             "topology; add --num-hosts H (>= 2) to define the rows"
+        )
+    link_faults = (
+        args.link_loss > 0
+        or args.link_dark > 0
+        or args.link_delay > 0
+        or args.link_dup > 0
+    )
+    if link_faults and args.num_hosts < 2:
+        raise SystemExit(
+            "--link-loss/--link-dark/--link-delay/--link-dup fault the "
+            "tier->root uplinks of the hierarchical topology; add "
+            "--num-hosts H (>= 2) to define the uplinks"
+        )
+    if (
+        args.host_quorum != 1.0
+        or args.ship_deadline > 0
+        or args.host_staleness > 0
+    ) and args.num_hosts < 2:
+        raise SystemExit(
+            "--host-quorum/--ship-deadline/--host-staleness govern the "
+            "tier->root uplink of the hierarchical fold tree; add "
+            "--num-hosts H (>= 2) to define the tiers"
         )
     faults = (
         FaultConfig(
@@ -327,7 +385,15 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             transient_fail_clients=args.transient_clients,
             permanent_fail_clients=args.permanent_clients,
             outage_hosts=args.outage_hosts,
-            num_hosts=args.num_hosts if args.outage_hosts > 0 else 0,
+            link_loss_hosts=args.link_loss,
+            link_dark_hosts=args.link_dark,
+            link_delay_s=args.link_delay,
+            link_dup_hosts=args.link_dup,
+            num_hosts=(
+                args.num_hosts
+                if (args.outage_hosts > 0 or link_faults)
+                else 0
+            ),
         )
         if any_fault
         else None
@@ -420,6 +486,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             staleness_rounds=args.staleness,
             seed=args.stream_seed,
             num_hosts=args.num_hosts,
+            host_quorum=args.host_quorum,
+            ship_deadline_s=args.ship_deadline,
+            host_staleness_rounds=args.host_staleness,
             upload_kind="hhe" if args.hhe else "ckks",
         )
         if want_stream

@@ -351,6 +351,19 @@ def run_experiment(
             "StreamConfig.staleness_rounds=0 for dp runs (a carried "
             "upload would double a client's accounted sensitivity)"
         )
+    if (
+        cfg.dp is not None
+        and cfg.stream is not None
+        and cfg.stream.host_staleness_rounds > 0
+    ):
+        # The same hazard one tier up (see fl.stream.run_round, which
+        # enforces the same rule): a carried host partial re-releases
+        # every client fold it holds in a later round.
+        raise ValueError(
+            "dp cannot be combined with a tier staleness budget: set "
+            "StreamConfig.host_staleness_rounds=0 for dp runs (a carried "
+            "host partial would double its clients' accounted sensitivity)"
+        )
     # dp under partial participation: each client's distributed noise
     # share is calibrated to the surviving-cohort floor
     # (DpConfig.min_surviving; fl/dp.py) — conservative over-noising whose

@@ -32,11 +32,13 @@ from hefl_tpu.fl.faults import (
     CrashConfig,
     DeviceLost,
     FaultConfig,
+    LinkFaults,
     RoundFaults,
     RoundMeta,
     SimulatedCrash,
     schedule_arrivals,
     schedule_for_round,
+    schedule_links,
 )
 from hefl_tpu.fl.fedavg import (
     cohort_bucket,
@@ -56,6 +58,7 @@ from hefl_tpu.fl.secure import (
 )
 from hefl_tpu.fl.hierarchy import (
     HierarchicalAggregator,
+    ShipPolicy,
     TierCrash,
     dcn_compare_record,
 )
@@ -88,11 +91,14 @@ __all__ = [
     "RoundMeta",
     "schedule_arrivals",
     "schedule_for_round",
+    "schedule_links",
+    "LinkFaults",
     "calibration_clients",
     "clip_by_global_norm",
     "dp_sanitize",
     "epsilon_spent",
     "HierarchicalAggregator",
+    "ShipPolicy",
     "TierCrash",
     "dcn_compare_record",
     "OnlineAccumulator",

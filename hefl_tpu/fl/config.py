@@ -171,6 +171,38 @@ class StreamConfig:
                       analysis.certify_fold_tree, measured by the
                       BENCH_DCN / chaos gates). Part of the journal's
                       config echo.
+    host_quorum:      tier-level quorum H_Q (ISSUE 17): fraction of the
+                      round's SHIPPING hosts (tiers that folded at least
+                      one upload) whose partials must land at the root
+                      for the round to commit — the hierarchical analog
+                      of `quorum`. Below it the round degrades exactly
+                      like a sub-quorum flat round (model carried,
+                      encryption-of-zero, degraded_reason="host_quorum").
+                      1.0 (default) = every shipping host must land, the
+                      PR-16 lossless-DCN semantics. Requires
+                      num_hosts >= 2.
+    ship_deadline_s:  per-round tier->root ship deadline, measured from
+                      the round's client-quorum commit point (0 = none):
+                      a ship delivery landing after it cannot fold at
+                      the root this round — the host is excluded
+                      per-cause ("host_timeout") and its sealed partial
+                      carries under `host_staleness_rounds` or is
+                      dropped. Ship RETRIES (redeliveries of a LOST
+                      ship) may land after the deadline and still fold,
+                      mirroring the client-level retry contract.
+                      Requires num_hosts >= 2.
+    host_staleness_rounds:
+                      tier-level bounded-staleness budget: how many
+                      rounds a host's sealed partial that missed its
+                      round's ship may carry forward as a STALE TIER
+                      FOLD (one extra instance of the certified fold
+                      loop at the root — analysis.certify_fold_tree's
+                      carried-partial fact) before its clients are
+                      excluded as "host_stale". 0 = synchronous DCN
+                      semantics: a missed ship is dropped. Refused with
+                      dp for the same reason as `staleness_rounds` (a
+                      carried partial doubles its clients' accounted
+                      per-round sensitivity). Requires num_hosts >= 2.
     upload_kind:      what the clients put on the wire (ISSUE 11):
                       "ckks" (the historical packed/float CKKS ciphertext)
                       or "hhe" — a symmetric stream-cipher encryption of
@@ -194,6 +226,9 @@ class StreamConfig:
     seed: int = 0
     time_scale: float = 0.0
     num_hosts: int = 0
+    host_quorum: float = 1.0
+    ship_deadline_s: float = 0.0
+    host_staleness_rounds: int = 0
     upload_kind: str = "ckks"
 
     def __post_init__(self):
@@ -208,13 +243,29 @@ class StreamConfig:
             )
         for name in ("cohort_size", "deadline_s", "max_retries",
                      "retry_backoff_s", "staleness_rounds", "time_scale",
-                     "num_hosts"):
+                     "num_hosts", "ship_deadline_s", "host_staleness_rounds"):
             if getattr(self, name) < 0:
                 raise ValueError(f"StreamConfig.{name} must be >= 0")
         if self.num_hosts == 1:
             raise ValueError(
                 "StreamConfig.num_hosts=1: one host IS the flat fold — "
                 "use 0 (flat) or >= 2 (hierarchical)"
+            )
+        if not 0.0 < self.host_quorum <= 1.0:
+            raise ValueError(
+                f"StreamConfig.host_quorum={self.host_quorum}: must be in "
+                "(0, 1] (a fraction of the round's shipping hosts)"
+            )
+        if self.num_hosts < 2 and (
+            self.host_quorum != 1.0
+            or self.ship_deadline_s > 0
+            or self.host_staleness_rounds > 0
+        ):
+            raise ValueError(
+                "StreamConfig.host_quorum/ship_deadline_s/"
+                "host_staleness_rounds describe the tier->root uplink of "
+                "the hierarchical fold tree and would be silent no-ops on "
+                "the flat engine — set num_hosts >= 2 to define the tiers"
             )
         if not 0.0 <= self.retry_jitter <= 1.0:
             raise ValueError(

@@ -28,7 +28,12 @@ update-norm bound, bit 3 encoder saturation. The streaming round engine
 (a late upload exceeded the bounded-staleness budget), bit 5 timeout (the
 upload missed this round's commit), bit 6 unreachable (delivery failed
 and retries were exhausted), bit 7 unsampled (the client was not in this
-round's cohort — attribution, not a fault).
+round's cohort — attribution, not a fault). The hierarchical engine
+(ISSUE 17) adds TIER-level causes applied to every client of a host whose
+sealed partial missed the round: bit 8 host_timeout (ship landed after the
+ship deadline), bit 9 host_unreachable (dark uplink, every ship delivery
+lost), bit 10 host_stale (carried tier partial exceeded the host
+staleness budget).
 """
 
 from __future__ import annotations
@@ -51,6 +56,12 @@ EXCLUDED_STALE = 16        # late upload exceeded the staleness budget tau
 EXCLUDED_TIMEOUT = 32      # upload missed this round's commit (may carry)
 EXCLUDED_UNREACHABLE = 64  # delivery failed, retries exhausted
 EXCLUDED_UNSAMPLED = 128   # not in this round's cohort (attribution only)
+# Tier-level causes (ISSUE 17): the client folded into its host tier, but
+# the TIER's partial missed the round — attribution is per-host, applied to
+# every client the sealed partial contains.
+EXCLUDED_HOST_TIMEOUT = 256      # tier ship landed after the ship deadline
+EXCLUDED_HOST_UNREACHABLE = 512  # every ship delivery lost (dark uplink)
+EXCLUDED_HOST_STALE = 1024       # carried tier partial exceeded host tau
 
 EXCLUSION_CAUSES = {
     "scheduled": EXCLUDED_SCHEDULED,
@@ -61,6 +72,9 @@ EXCLUSION_CAUSES = {
     "timeout": EXCLUDED_TIMEOUT,
     "unreachable": EXCLUDED_UNREACHABLE,
     "unsampled": EXCLUDED_UNSAMPLED,
+    "host_timeout": EXCLUDED_HOST_TIMEOUT,
+    "host_unreachable": EXCLUDED_HOST_UNREACHABLE,
+    "host_stale": EXCLUDED_HOST_STALE,
 }
 
 # Poison codes (the int32[C] `poison` input of a masked round).
@@ -176,10 +190,31 @@ class FaultConfig:
                              (seed, round, 5) AFTER the dropout draw, so
                              an existing schedule is bit-identical when
                              outage_hosts=0.
-    num_hosts:               host rows the outage draw partitions the
+    num_hosts:               host rows the outage/link draws partition the
                              registry into (must match the deployment's
                              StreamConfig.num_hosts to darken real host
-                             blocks).
+                             blocks / fault real uplinks).
+
+    DCN link faults (ISSUE 17 — the tier->root uplink's failure modes;
+    require num_hosts >= 2; consumed only by the hierarchical engine — the
+    flat twin has no DCN, so the same FaultConfig drives both twins of a
+    flat-vs-hier comparison with the client-level schedule identical):
+
+    link_loss_hosts:         uplinks per round whose tier ship's FIRST
+                             delivery is LOST in flight; only the ship
+                             retry machinery (backoff + jitter on the
+                             virtual clock) can land the partial.
+    link_dark_hosts:         uplinks per round for which EVERY ship
+                             delivery fails (a dark region) — the host is
+                             excluded as "host_unreachable" and its sealed
+                             partial carries under host_staleness_rounds.
+    link_delay_s:            max added delivery delay per ship (uniform
+                             U(0, link_delay_s)); a delivery past the
+                             ship deadline excludes the host as
+                             "host_timeout".
+    link_dup_hosts:          uplinks per round whose ship is delivered
+                             TWICE — the root must dedup by
+                             (host, round, sha).
     """
 
     seed: int = 0
@@ -195,6 +230,10 @@ class FaultConfig:
     permanent_fail_clients: int = 0
     outage_hosts: int = 0
     num_hosts: int = 0
+    link_loss_hosts: int = 0
+    link_dark_hosts: int = 0
+    link_delay_s: float = 0.0
+    link_dup_hosts: int = 0
 
     def __post_init__(self):
         # Negative knobs would crash deep inside the numpy draws
@@ -205,6 +244,8 @@ class FaultConfig:
             "straggler_fraction", "straggler_delay_s", "arrival_delay_s",
             "duplicate_clients", "transient_fail_clients",
             "permanent_fail_clients", "outage_hosts", "num_hosts",
+            "link_loss_hosts", "link_dark_hosts", "link_delay_s",
+            "link_dup_hosts",
         ):
             if getattr(self, name) < 0:
                 raise ValueError(f"FaultConfig.{name} must be >= 0")
@@ -220,6 +261,27 @@ class FaultConfig:
                 f"num_hosts={self.num_hosts}: at least one host row must "
                 "survive or no round can ever commit"
             )
+        if self._any_link_fault() and self.num_hosts < 2:
+            raise ValueError(
+                "FaultConfig.link_loss_hosts/link_dark_hosts/link_delay_s/"
+                "link_dup_hosts fault the tier->root uplinks of a "
+                "multi-host topology; set num_hosts >= 2 to define the "
+                "uplinks"
+            )
+        if self.link_dark_hosts >= self.num_hosts > 0:
+            raise ValueError(
+                f"FaultConfig.link_dark_hosts={self.link_dark_hosts} with "
+                f"num_hosts={self.num_hosts}: at least one uplink must "
+                "deliver or no hierarchical round can ever commit"
+            )
+
+    def _any_link_fault(self) -> bool:
+        return bool(
+            self.link_loss_hosts > 0
+            or self.link_dark_hosts > 0
+            or self.link_delay_s > 0
+            or self.link_dup_hosts > 0
+        )
 
     def max_scheduled_exclusions(self, num_clients: int) -> int:
         """Worst-case per-round exclusion count this schedule can cause —
@@ -234,10 +296,19 @@ class FaultConfig:
             # A darkened host row scheds out its whole contiguous block.
             per_host = -(-int(num_clients) // int(self.num_hosts))
             outage = int(self.outage_hosts) * per_host
+        linkx = 0
+        if self.link_dark_hosts > 0 or self.link_loss_hosts > 0:
+            # A faulted uplink can (worst case: no retries / no staleness
+            # budget) exclude its tier's whole folded block for the round.
+            per_host = -(-int(num_clients) // int(self.num_hosts))
+            linkx = (
+                int(self.link_dark_hosts) + int(self.link_loss_hosts)
+            ) * per_host
         return min(
             int(num_clients),
             int(round(self.drop_fraction * num_clients))
             + outage
+            + linkx
             + int(self.nan_clients)
             + int(self.huge_clients)
             + int(self.permanent_fail_clients)
@@ -380,6 +451,60 @@ def schedule_arrivals(
         duplicate=duplicate,
         transient=transient,
         permanent=permanent,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkFaults:
+    """One round's concrete DCN-link fault assignment (host-side numpy),
+    indexed by host row: the per-uplink delivery behavior of that host's
+    tier->root ship. Consumed by fl.hierarchy's ship timeline."""
+
+    delay_s: np.ndarray    # float64[H] added delivery delay per ship
+    duplicate: np.ndarray  # bool[H]  successful ship is delivered twice
+    transient: np.ndarray  # bool[H]  first delivery lost; retries succeed
+    dark: np.ndarray       # bool[H]  every delivery attempt fails
+
+
+def schedule_links(fc: FaultConfig, round_index: int) -> LinkFaults:
+    """The deterministic DCN-link fault assignment for one round.
+
+    Keyed by (fc.seed, round_index, 7) — an independent PRNG stream from
+    every existing draw (round schedule uses (seed, round), arrivals
+    (seed, round, 1), outage (seed, round, 5)), so adding link faults never
+    reshuffles an existing client-level schedule and a zero-link-knob
+    config is bit-identical to its pre-ISSUE-17 twin. The three failure
+    kinds are disjoint (dark first, then transient, then duplicates among
+    the clean remainder) so every scheduled fault is observable in the
+    dcn.retry.* / exclusions.host_* counters; delay composes with all of
+    them.
+    """
+    num_hosts = int(fc.num_hosts)
+    rng = np.random.default_rng([int(fc.seed), int(round_index), 7])
+    delay_s = (
+        rng.uniform(0.0, fc.link_delay_s, num_hosts)
+        if fc.link_delay_s > 0
+        else np.zeros(num_hosts)
+    )
+    duplicate = np.zeros(num_hosts, dtype=bool)
+    transient = np.zeros(num_hosts, dtype=bool)
+    dark = np.zeros(num_hosts, dtype=bool)
+    hosts = np.arange(num_hosts)
+    n_dark = min(int(fc.link_dark_hosts), len(hosts))
+    if n_dark:
+        picks = rng.choice(hosts, n_dark, replace=False)
+        dark[picks] = True
+        hosts = np.setdiff1d(hosts, picks)
+    n_loss = min(int(fc.link_loss_hosts), len(hosts))
+    if n_loss:
+        picks = rng.choice(hosts, n_loss, replace=False)
+        transient[picks] = True
+        hosts = np.setdiff1d(hosts, picks)
+    n_dup = min(int(fc.link_dup_hosts), len(hosts))
+    if n_dup:
+        duplicate[rng.choice(hosts, n_dup, replace=False)] = True
+    return LinkFaults(
+        delay_s=delay_s, duplicate=duplicate, transient=transient, dark=dark
     )
 
 

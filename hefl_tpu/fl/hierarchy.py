@@ -45,6 +45,24 @@ bytes-per-round ratio >= cohort/hosts * 0.8 and bitwise-equal committed
 aggregates in every tested arrival order (identity, reversed, shuffled,
 each with duplicate redeliveries). `python -m hefl_tpu.fl.hierarchy` writes
 the standalone BENCH_DCN.json (run_tpu_suite.sh stage 9).
+
+Fault-tolerant DCN (ISSUE 17): the tier->root uplink is a FAULTY link.
+`ship_all(t0)` runs each tier's ship as a delivery timeline on the
+engine's virtual clock: the first delivery lands at t0 plus the uplink's
+scheduled delay (`fl.faults.LinkFaults`), a LOST delivery is redelivered
+with exponential backoff + deterministic per-(round, host, attempt)
+jitter (`ShipPolicy`, the `_retry_times` idiom from fl.stream), every
+attempt journals a `tier_ship` record (attempt, t, lost) to that host's
+WAL, and the root DEDUPS deliveries by (host, round, sha) — a retried,
+duplicated, or crash-recovery re-shipped partial can never double-fold,
+and root.wal holds exactly one `root_fold` per distinct shipped tier. A
+first delivery landing past the ship deadline misses the round
+("host_timeout"); retried deliveries are exempt (the root extended the
+round for them, mirroring the client-level retry contract); a dark uplink
+loses every delivery ("host_unreachable"). A missed tier's sealed partial
+is retrievable via `take_late_partial` so the engine can carry it into
+the next round as a STALE TIER FOLD (`fold_carried` — one extra instance
+of the certified fold loop, `certify_fold_tree`'s carried-partial fact).
 """
 
 from __future__ import annotations
@@ -100,6 +118,39 @@ class TierCrash:
             raise ValueError("TierCrash.torn_bytes must be >= 1")
 
 
+@dataclasses.dataclass(frozen=True)
+class ShipPolicy:
+    """Retry/deadline policy of the tier->root ship timeline (ISSUE 17).
+    The engine builds one from StreamConfig (ship_deadline_s + the shared
+    retry knobs) per round; the defaults — no deadline, no retries —
+    reproduce the PR-16 instantaneous-wire behavior on a clean link.
+
+    deadline_s:   per-round ship deadline measured from `ship_all`'s t0
+                  (the round's client-quorum commit point); 0 = none.
+    max_retries:  redelivery attempts for a LOST ship delivery.
+    backoff_s:    base backoff between redeliveries (doubles per attempt).
+    jitter:       +/- fraction of each backoff drawn from the
+                  deterministic per-(round, host, attempt) PRNG stream
+                  (seed, round, host, 9).
+    seed:         PRNG seed of the retry jitter (StreamConfig.seed).
+    """
+
+    deadline_s: float = 0.0
+    max_retries: int = 0
+    backoff_s: float = 0.25
+    jitter: float = 0.25
+    seed: int = 0
+
+    def __post_init__(self):
+        for name in ("deadline_s", "max_retries", "backoff_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"ShipPolicy.{name} must be >= 0")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise ValueError(
+                f"ShipPolicy.jitter={self.jitter}: must be in [0, 1]"
+            )
+
+
 class HierarchicalAggregator:
     """Two-tier fold tree: per-host `OnlineAccumulator`s + a root fold.
 
@@ -120,6 +171,9 @@ class HierarchicalAggregator:
         journal_dir: str | None = None,
         fsync_policy: str | None = None,
         crash: TierCrash | None = None,
+        round_index: int = 0,
+        link=None,
+        ship: ShipPolicy | None = None,
     ):
         if num_hosts < 2:
             raise ValueError(
@@ -151,6 +205,25 @@ class HierarchicalAggregator:
         self._flat_bytes = 0       # what the flat topology would have
                                    # shipped cross-host for the same folds
         self.crash = crash
+        # --- faulty-uplink state (ISSUE 17) ---
+        self.round_index = int(round_index)
+        self.link = link                       # fl.faults.LinkFaults | None
+        self.ship = ship if ship is not None else ShipPolicy()
+        # Root-side ship dedup: (host, round) -> partial sha. A retried,
+        # duplicated, or crash-recovery re-shipped partial dedups here;
+        # carried stale partials key by their ORIGIN round, so they can
+        # never collide with this round's fresh ships.
+        self._root_seen: dict[tuple[int, int], str] = {}
+        self._ship_attempts = [0] * self.num_hosts
+        self.ship_log: list[tuple[int, int, float, bool]] = []
+        self.ship_retries = 0      # redelivery attempts beyond the first
+        self.ship_lost = 0         # deliveries lost in flight
+        self.ship_deduped = 0      # deliveries the root deduped
+        self.missed_ships: list[tuple[int, str]] = []  # (host, cause)
+        self._missed_partials: dict[int, tuple] = {}
+        self.ships_done_s = 0.0    # virtual time the last partial landed
+        self.stale_tier_folds = 0      # carried partials folded at the root
+        self.stale_tier_clients = 0    # client uploads those partials held
         self._writers: list[jr.JournalWriter | None] = [None] * self.num_hosts
         self._root_writer: jr.JournalWriter | None = None
         self.refolded = 0          # uploads recovered from tier journals
@@ -161,9 +234,37 @@ class HierarchicalAggregator:
 
     @property
     def folded(self) -> int:
-        """Uploads folded across every tier (the surviving count / dp and
-        headroom currency — NOT the root's host-partial count)."""
-        return sum(t.folded for t in self._tiers)
+        """Uploads folded across every tier PLUS the client uploads held
+        by carried stale tier partials already folded at the root (the
+        surviving count / dp and headroom currency — NOT the root's
+        host-partial count)."""
+        return sum(t.folded for t in self._tiers) + self.stale_tier_clients
+
+    @property
+    def nonempty_tiers(self) -> int:
+        """Tiers that folded at least one upload this round — the
+        denominator of the host quorum H_Q = ceil(host_quorum * this)."""
+        return sum(1 for t in self._tiers if t.folded > 0)
+
+    @property
+    def landed_hosts(self) -> list[int]:
+        """Hosts whose partial folded at the root (shipped this round)."""
+        return [h for h in range(self.num_hosts) if self._shipped[h]]
+
+    @property
+    def released(self) -> int:
+        """Client uploads actually IN the root sum: folds of tiers whose
+        partial landed, plus carried-stale-partial clients. This — not
+        `folded` — is the decode denominator and dp-floor count once ships
+        can miss; equal to `folded` when every nonempty tier landed."""
+        return (
+            sum(
+                t.folded
+                for h, t in enumerate(self._tiers)
+                if self._shipped[h]
+            )
+            + self.stale_tier_clients
+        )
 
     def fold(self, nonce, c0, c1) -> bool:
         """Fold one upload into its client's host tier; False (counting a
@@ -220,14 +321,53 @@ class HierarchicalAggregator:
         obs_metrics.counter("dcn.flat.bytes").inc(c0.nbytes + c1.nbytes)
         return True
 
-    def ship_all(self) -> None:
+    def _ship_retry_times(self, host: int, t_send: float) -> list[float]:
+        """Virtual-clock redelivery times for host `host`'s lost ship:
+        exponential backoff from the send time with deterministic
+        per-(round, host, attempt) jitter — the `_retry_times` idiom from
+        fl.stream, one tier up, on its own PRNG stream (seed, round,
+        host, 9)."""
+        ship = self.ship
+        rng = np.random.default_rng(
+            [int(ship.seed), int(self.round_index), int(host), 9]
+        )
+        t = float(t_send)
+        out = []
+        for i in range(int(ship.max_retries)):
+            back = ship.backoff_s * (2.0 ** i)
+            t += back * (1.0 + ship.jitter * float(rng.uniform(-1.0, 1.0)))
+            out.append(t)
+        return out
+
+    def ship_all(self, t0: float = 0.0) -> None:
         """Ship each nonempty tier's ONE partial ciphertext to the root
         (the per-round DCN traffic — O(hosts), counted per uplink) and
         seal the tree. Idempotent; crash-safe via the tier_ship /
-        root_fold WAL ordering (see _recover)."""
+        root_fold WAL ordering (see _recover).
+
+        Each ship runs as a DELIVERY TIMELINE on the virtual clock
+        starting at `t0` (the round's client-quorum commit point): first
+        delivery at t0 + the uplink's scheduled delay; a LOST delivery
+        (LinkFaults.transient / .dark) is redelivered at
+        `_ship_retry_times`; a duplicated delivery (LinkFaults.duplicate)
+        lands twice and the root dedups it. Every attempt journals a
+        `tier_ship` record (attempt, t, lost) BEFORE its delivery, so a
+        recovering tier re-derives the full retry timeline. A first
+        delivery past the ship deadline misses the round ("host_timeout");
+        RETRIED deliveries are exempt from the deadline (the root extended
+        the round for them — the client-level retry contract, one tier
+        up); an uplink that loses every delivery misses as
+        "host_unreachable". A missed tier is NOT marked shipped: its
+        sealed partial stays retrievable via `take_late_partial`."""
         if self._sealed:
             return
         links = dcn_link_names(self.num_hosts)
+        ship = self.ship
+        deadline = (
+            float(t0) + ship.deadline_s if ship.deadline_s > 0
+            else float("inf")
+        )
+        lf = self.link
         for h, tier in enumerate(self._tiers):
             if self._shipped[h] or tier.folded == 0:
                 continue
@@ -239,23 +379,147 @@ class HierarchicalAggregator:
                 )
             pc0, pc1 = tier.value()
             sha = ct_hash(pc0, pc1)
+            delay = float(lf.delay_s[h]) if lf is not None else 0.0
+            dark = bool(lf.dark[h]) if lf is not None else False
+            trans = bool(lf.transient[h]) if lf is not None else False
+            dup = bool(lf.duplicate[h]) if lf is not None else False
+            send = float(t0) + delay
+            # The delivery plan: (t, lost, retried) in virtual-clock order.
+            plan: list[tuple[float, bool, bool]] = [
+                (send, dark or trans, False)
+            ]
+            if dark:
+                plan += [
+                    (rt, True, True) for rt in self._ship_retry_times(h, send)
+                ]
+            elif trans:
+                rts = self._ship_retry_times(h, send)
+                if rts:
+                    plan.append((rts[0], False, True))
+            elif dup:
+                plan.append((send + 1e-6, False, False))
             w = self._writers[h]
-            if w is not None:
-                w.append(
-                    "tier_ship", dict(host=h, sha=sha, folded=tier.folded)
-                )
-            if c is not None and c.host == h and c.at == "post_ship":
-                raise SimulatedCrash(
-                    f"tier crash injection: host {h} died after tier_ship "
-                    "landed, before the root saw the partial"
-                )
-            self._ship_partial(h, pc0, pc1, sha, links[h])
+            landed_t = None
+            cause = None
+            for t, lost, retried in plan:
+                self._ship_attempts[h] += 1
+                att = self._ship_attempts[h]
+                if retried:
+                    self.ship_retries += 1
+                    obs_metrics.counter("dcn.retry.attempts").inc()
+                self.ship_log.append((h, att, float(t), bool(lost)))
+                if w is not None:
+                    w.append("tier_ship", dict(
+                        host=h, sha=sha, folded=tier.folded,
+                        round=self.round_index, attempt=att, t=float(t),
+                        lost=bool(lost),
+                    ))
+                if (
+                    c is not None and c.host == h and c.at == "post_ship"
+                    and att == 1
+                ):
+                    raise SimulatedCrash(
+                        f"tier crash injection: host {h} died after "
+                        "tier_ship landed, before the root saw the partial"
+                    )
+                if lost:
+                    self.ship_lost += 1
+                    obs_metrics.counter("dcn.retry.lost").inc()
+                    continue
+                if not retried and t > deadline:
+                    cause = "timeout"
+                    continue
+                if self._ship_partial(h, pc0, pc1, sha, links[h]):
+                    if landed_t is None:
+                        landed_t = float(t)
+            if landed_t is None:
+                self.missed_ships.append((h, cause or "unreachable"))
+                self._missed_partials[h] = (pc0, pc1, sha, tier.folded)
+                obs_metrics.counter("dcn.ship.missed").inc()
+            else:
+                self.ships_done_s = max(self.ships_done_s, landed_t)
+                obs_metrics.counter("dcn.ship.landed").inc()
         self._sealed = True
 
-    def _ship_partial(self, h, pc0, pc1, sha, link) -> None:
+    def take_late_partial(self, host: int):
+        """The sealed partial of a host whose ship missed the round ->
+        (c0, c1, sha, folded). The engine carries it into the next round
+        as a stale tier fold under host_staleness_rounds."""
+        pc0, pc1, sha, nfold = self._missed_partials[int(host)]
+        return np.array(pc0), np.array(pc1), sha, int(nfold)
+
+    def fold_carried(self, host, origin_round, c0, c1, sha, nclients) -> bool:
+        """Fold a CARRIED stale tier partial — sealed in `origin_round`,
+        missed that round's ship — into the root: one extra instance of
+        the certified fold loop (certify_fold_tree's carried-partial
+        fact). Dedups by (host, origin_round), so a replayed or
+        re-delivered carry can never double-fold; the partial's durable
+        bytes live in the engine session's tier_carry record (root.wal
+        records only this round's genuine DCN ships, keeping
+        root folds == distinct shipped tiers checkable from it). The late
+        partial crosses its uplink NOW, so its bytes count against this
+        round's DCN accounting. False = deduped."""
+        c0 = np.asarray(c0, dtype=np.uint32)
+        c1 = np.asarray(c1, dtype=np.uint32)
+        got = ct_hash(c0, c1)
+        if got != sha:
+            raise jr.JournalError(
+                f"carried tier partial from host {host} round "
+                f"{origin_round} hashes to {got} but its carry recorded "
+                f"{sha} — refusing to fold a diverged partial"
+            )
+        key = (int(host), int(origin_round))
+        seen = self._root_seen.get(key)
+        if seen is not None:
+            if seen != sha:
+                raise jr.JournalError(
+                    f"carried tier partial {key} diverged: root folded "
+                    f"{seen}, redelivery carries {sha}"
+                )
+            self.ship_deduped += 1
+            obs_metrics.counter("dcn.retry.deduped").inc()
+            return False
+        self._root_seen[key] = sha
+        self._root.fold(("tier", int(host), int(origin_round)), c0, c1)
+        self.stale_tier_folds += 1
+        self.stale_tier_clients += int(nclients)
+        links = dcn_link_names(self.num_hosts)
+        nbytes = c0.nbytes + c1.nbytes
+        self._link_bytes[int(host)] += nbytes
+        obs_metrics.counter(f"dcn.link.{links[int(host)]}.bytes").inc(nbytes)
+        obs_metrics.counter("dcn.hier.bytes").inc(nbytes)
+        obs_events.emit(
+            "dcn_ship", host=int(host), bytes=nbytes, sha=sha,
+            stale=True, origin_round=int(origin_round),
+        )
+        return True
+
+    def _ship_partial(self, h, pc0, pc1, sha, link) -> bool:
+        """Deliver one tier partial to the root. Root-side dedup by
+        (host, round, sha): a second delivery of the same partial —
+        injected duplicate, retry after a delivery that DID land, or a
+        crash-recovery re-ship racing either — counts `ship_deduped` and
+        folds nothing; a colliding delivery with a DIFFERENT sha fails
+        loudly. Exactly one root_fold record per distinct shipped tier.
+        -> True iff the partial folded."""
+        key = (int(h), int(self.round_index))
+        seen = self._root_seen.get(key)
+        if seen is not None:
+            if seen != sha:
+                raise jr.JournalError(
+                    f"tier {h} re-shipped a DIVERGED partial for round "
+                    f"{self.round_index}: root folded {seen}, redelivery "
+                    f"carries {sha}"
+                )
+            self.ship_deduped += 1
+            obs_metrics.counter("dcn.retry.deduped").inc()
+            return False
         if self._root_writer is not None:
-            self._root_writer.append("root_fold", dict(host=h, sha=sha))
+            self._root_writer.append(
+                "root_fold", dict(host=h, round=self.round_index, sha=sha)
+            )
         self._root.fold(("host", h), pc0, pc1)
+        self._root_seen[key] = sha
         nbytes = pc0.nbytes + pc1.nbytes
         self._link_bytes[h] += nbytes
         obs_metrics.counter(f"dcn.link.{link}.bytes").inc(nbytes)
@@ -263,6 +527,7 @@ class HierarchicalAggregator:
         obs_events.emit("dcn_ship", host=h, bytes=nbytes, sha=sha)
         self._shipped[h] = True
         self._ship_sha[h] = sha
+        return True
 
     def value(self, like_shape=None):
         """The committed aggregate: ships any unshipped tiers first, then
@@ -282,11 +547,15 @@ class HierarchicalAggregator:
         """Construction-is-recovery (the fl.server pattern): open every
         tier journal (repairing torn tails), re-fold the journaled bodies
         — nonce dedup makes a replayed record idempotent, so recovery
-        re-folds and can never double-count — verify shipped partials
-        against their journaled sha, and re-ship a partial whose
-        tier_ship landed but whose root_fold did not."""
+        re-folds and can never double-count — and verify shipped partials
+        against their journaled sha. A partial whose tier_ship landed but
+        whose root_fold did not is NOT re-shipped here: the re-ship is
+        DEFERRED to the next `ship_all`, where it runs through the same
+        delivery timeline as any other ship (so a schedule-injected
+        duplicate applies to it too) and the root's (host, round, sha)
+        dedup guarantees it folds exactly once however many deliveries
+        race."""
         os.makedirs(journal_dir, exist_ok=True)
-        links = dcn_link_names(self.num_hosts)
         pending_ship: list[int] = []
         for h in range(self.num_hosts):
             path = os.path.join(journal_dir, f"tier{h}.wal")
@@ -337,16 +606,31 @@ class HierarchicalAggregator:
                             f"{rec.get('sha')} — refusing to re-ship a "
                             "diverged partial"
                         )
-                    pending_ship.append(h)
+                    # One tier may hold several attempt records (retries /
+                    # duplicates); continue their numbering on re-ship.
+                    self._ship_attempts[h] = max(
+                        self._ship_attempts[h],
+                        int(rec.get("attempt", self._ship_attempts[h] + 1)),
+                    )
+                    if h not in pending_ship:
+                        pending_ship.append(h)
         root_path = os.path.join(journal_dir, "root.wal")
         rw, root_records, _ = jr.open_journal(
             root_path, fsync_policy, meta=dict(self._meta(), tier="root")
         )
         self._root_writer = rw
-        root_seen = {
-            int(rec["host"]): rec.get("sha")
-            for rec in root_records if rec.get("kind") == "root_fold"
-        }
+        root_seen: dict[int, str] = {}
+        for rec in root_records:
+            if rec.get("kind") != "root_fold":
+                continue
+            r = int(rec.get("round", self.round_index))
+            if r != self.round_index:
+                raise jr.JournalError(
+                    f"{root_path}: root_fold for round {r} in an "
+                    f"aggregator recovering round {self.round_index} — "
+                    "the journal belongs to a different round"
+                )
+            root_seen[int(rec["host"])] = rec.get("sha")
         for h, want in root_seen.items():
             if h not in pending_ship:
                 raise jr.JournalError(
@@ -366,13 +650,14 @@ class HierarchicalAggregator:
             if want is not None:
                 # Already at the root: fold in memory without re-logging.
                 self._root.fold(("host", h), pc0, pc1)
+                self._root_seen[(h, self.round_index)] = sha
                 nbytes = pc0.nbytes + pc1.nbytes
                 self._link_bytes[h] += nbytes
                 self._shipped[h] = True
                 self._ship_sha[h] = sha
-            else:
-                # Crash landed between tier_ship and root_fold: re-ship.
-                self._ship_partial(h, pc0, pc1, sha, links[h])
+            # else: crash landed between tier_ship and root_fold — the
+            # re-ship is deferred to ship_all (see docstring), which the
+            # root dedup makes safe against concurrent duplicates.
         if self.refolded:
             obs_metrics.counter("recovery.tier_refolded_uploads").inc(
                 self.refolded
@@ -403,6 +688,7 @@ class HierarchicalAggregator:
             "num_hosts": self.num_hosts,
             "num_clients": self.num_clients,
             "folded": self.folded,
+            "released": self.released,
             "duplicates": int(self.duplicates),
             "shipping_hosts": int(sum(self._shipped)),
             "per_link": {
@@ -413,6 +699,17 @@ class HierarchicalAggregator:
             "bytes_ratio": (
                 round(self._flat_bytes / hier, 3) if hier else float("inf")
             ),
+            # Faulty-uplink outcome (ISSUE 17): the retry/quorum fields
+            # BENCH_DCN rows carry and run_perf_smoke.sh gates.
+            "ship_retries": int(self.ship_retries),
+            "ship_lost": int(self.ship_lost),
+            "ship_deduped": int(self.ship_deduped),
+            "missed_hosts": [
+                [int(h), str(cause)] for h, cause in self.missed_ships
+            ],
+            "stale_tier_folds": int(self.stale_tier_folds),
+            "stale_tier_clients": int(self.stale_tier_clients),
+            "ships_done_s": round(float(self.ships_done_s), 6),
         }
 
 
@@ -485,6 +782,14 @@ def dcn_compare_record(
         "ratio_ok": bool(rep["bytes_ratio"] >= ratio_floor),
         "arrival_orders": list(orders),
         "bitwise_equal": len(hashes) == 1,
+        # Faulty-uplink schema (ISSUE 17) — zero on this clean-link
+        # geometry, but every BENCH_DCN row carries the fields so
+        # dashboards/gates can rely on the schema unconditionally.
+        "ship_retries": rep["ship_retries"],
+        "ship_lost": rep["ship_lost"],
+        "ship_deduped": rep["ship_deduped"],
+        "missed_hosts": rep["missed_hosts"],
+        "released": rep["released"],
     }
 
 
@@ -562,6 +867,7 @@ if __name__ == "__main__":
 __all__ = [
     "TIER_CRASH_POINTS",
     "TierCrash",
+    "ShipPolicy",
     "HierarchicalAggregator",
     "dcn_compare_record",
     "dcn_compare_smoke_record",

@@ -92,8 +92,9 @@ _COMMIT_KINDS = frozenset(
 # Record kinds that belong to one round's lifecycle (everything but the
 # file header); recovery groups these by their "round" field.
 ROUND_KINDS = (
-    "round_open", "retry", "fold", "dedup", "reject", "miss",
-    "commit", "degrade", "carry", "round_close",
+    "round_open", "retry", "fold", "tier_fold", "ship_retry", "dedup",
+    "reject", "miss", "commit", "degrade", "carry", "tier_carry",
+    "round_close",
 )
 
 
@@ -613,6 +614,41 @@ class RoundSession:
             shape=list(np.asarray(c0).shape),
         ), body=ct_body(c0, c1))
 
+    def tier_fold(self, round_index, host, origin_round, sha, clients,
+                  lateness) -> None:
+        """A carried STALE TIER PARTIAL folding at the root this round
+        (ISSUE 17). Hash-only: the partial's bytes are already durable in
+        the origin round's tier_carry record — the stale-fold analog of
+        fold(persist=False)."""
+        self._record("tier_fold", dict(
+            round=int(round_index), host=int(host),
+            origin_round=int(origin_round), sha=sha, clients=int(clients),
+            lateness=int(lateness),
+        ))
+
+    def ship_retry(self, round_index, host, attempt, t, lost) -> None:
+        """One tier->root ship redelivery attempt on the virtual clock
+        (ISSUE 17) — the session-level mirror of the per-tier WAL's
+        tier_ship attempt records, so engine replay re-derives the full
+        retry timeline."""
+        self._record("ship_retry", dict(
+            round=int(round_index), host=int(host), attempt=int(attempt),
+            t=float(t), lost=bool(lost),
+        ))
+
+    def tier_carry(self, round_index, host, origin_round, clients,
+                   lateness, c0, c1) -> None:
+        """A sealed tier partial that missed this round's ship, carried
+        into the next round under host_staleness_rounds (ISSUE 17) —
+        payload-bearing like carry(): recovery re-materializes the pending
+        partial from these bytes."""
+        self._record("tier_carry", dict(
+            round=int(round_index), host=int(host),
+            origin_round=int(origin_round),
+            clients=[int(c) for c in clients], lateness=int(lateness),
+            shape=list(np.asarray(c0).shape),
+        ), body=ct_body(c0, c1))
+
     def close(self, round_index, committed, surviving, excluded, seen) -> None:
         self._record("round_close", dict(
             round=int(round_index), committed=bool(committed),
@@ -632,10 +668,10 @@ def compact(
     """Rewrite the journal keeping only what recovery can still need once
     a round checkpoint covers everything before `keep_from_round`: records
     of rounds >= keep_from_round, plus round keep_from_round-1's
-    carry/round_close records (the pending uploads and dedup window the
-    next round starts from). Atomic (tmp + rename); the rewritten file
-    re-seeds the hash chain and stamps `base_round`. -> (kept, dropped)
-    round-record counts."""
+    carry/tier_carry/round_close records (the pending uploads, pending
+    tier partials, and dedup window the next round starts from). Atomic
+    (tmp + rename); the rewritten file re-seeds the hash chain and stamps
+    `base_round`. -> (kept, dropped) round-record counts."""
     records = read_journal(path, repair=True)
     header_meta: dict = {}
     for rec in records:
@@ -650,7 +686,8 @@ def compact(
             continue
         r = rec.get("round", -1)
         if r >= keep_from_round or (
-            r == keep_from_round - 1 and kind in ("carry", "round_close")
+            r == keep_from_round - 1
+            and kind in ("carry", "tier_carry", "round_close")
         ):
             keep.append(rec)
         else:

@@ -68,6 +68,9 @@ from hefl_tpu.ckks.ops import Ciphertext
 from hefl_tpu.fl.config import StreamConfig, TrainConfig
 from hefl_tpu.fl.dp import calibration_clients
 from hefl_tpu.fl.faults import (
+    EXCLUDED_HOST_STALE,
+    EXCLUDED_HOST_TIMEOUT,
+    EXCLUDED_HOST_UNREACHABLE,
     EXCLUDED_NONFINITE,
     EXCLUDED_NORM,
     EXCLUDED_OVERFLOW,
@@ -79,6 +82,7 @@ from hefl_tpu.fl.faults import (
     RoundMeta,
     schedule_arrivals,
     schedule_for_round,
+    schedule_links,
 )
 from hefl_tpu.fl.fedavg import (
     _mask_inputs,
@@ -94,6 +98,7 @@ from hefl_tpu.parallel import (
     client_axes,
     client_mesh_size,
     ct_shard_count,
+    host_of_clients,
     shard_map,
 )
 
@@ -739,6 +744,26 @@ class PendingUpload:
 
 
 @dataclasses.dataclass
+class PendingTierPartial:
+    """A sealed HOST partial carried across rounds under the tier
+    staleness budget (ISSUE 17): host `host`'s tier folded `clients`'
+    uploads in `origin_round` but its ship missed that round's commit
+    (deadline / dark uplink). The partial folds at the NEXT round's root
+    as a stale tier fold (`HierarchicalAggregator.fold_carried`, deduped
+    by (host, origin_round)) or keeps carrying until `lateness` exceeds
+    host_staleness_rounds, when its clients are excluded as
+    "host_stale"."""
+
+    host: int
+    origin_round: int
+    sha: str
+    c0: np.ndarray
+    c1: np.ndarray
+    clients: tuple[int, ...]   # the client folds the partial contains
+    lateness: int              # rounds behind its origin when it folds
+
+
+@dataclasses.dataclass
 class _HheRound:
     """Server-side hybrid-HE state of one round (ISSUE 11): the arrived
     symmetric ciphertexts, their transciphered CKKS residues (what the
@@ -774,7 +799,7 @@ class StreamRoundMeta:
     quorum: int
     committed: bool          # round released (False = degraded: model
                              # carried forward, nothing released)
-    degraded_reason: str | None  # None | "quorum" | "dp_floor"
+    degraded_reason: str | None  # None|"quorum"|"host_quorum"|"dp_floor"
     fresh: int               # this round's cohort arrivals folded
     stale_folded: int        # carried uploads folded this round
     carried: int             # uploads carried into the NEXT round
@@ -785,10 +810,15 @@ class StreamRoundMeta:
     rejected: int            # arrivals the in-program sanitizer rejected
     retries: int             # redelivery attempts made
     commit_s: float          # simulated time at which the round closed
+    hosts: dict | None = None  # hierarchical uplink story (ISSUE 17):
+                             # landed/missed tiers, host quorum, ship
+                             # retry/dedup and stale-tier-carry counts.
+                             # None on the flat engine — flat-vs-hier twin
+                             # comparisons strip this key.
 
     def record(self) -> dict:
         """JSON-ready summary for history[r] / the stream_round event."""
-        return {
+        out = {
             "cohort": list(self.cohort),
             "quorum": self.quorum,
             "committed": self.committed,
@@ -804,6 +834,9 @@ class StreamRoundMeta:
             "retries": self.retries,
             "commit_s": round(self.commit_s, 6),
         }
+        if self.hosts is not None:
+            out["hosts"] = dict(self.hosts)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -839,6 +872,9 @@ class StreamEngine:
         self.stream = stream
         self.faults = faults
         self._pending: list[PendingUpload] = []   # land next round
+        # Sealed host partials that missed their round's commit, carried
+        # under host_staleness_rounds to fold as stale tier folds.
+        self._pending_tiers: list[PendingTierPartial] = []
         # Dedup nonce window, bounded to the duplicate-reachability
         # horizon (tau + 1 rounds past a nonce's origin) — see DedupWindow.
         self._seen: DedupWindow = DedupWindow()
@@ -1029,6 +1065,18 @@ class StreamEngine:
                 "sensitivity and breaks cohort-subsampling amplification "
                 "— set staleness_rounds=0 for dp runs"
             )
+        if dp is not None and s.host_staleness_rounds > 0:
+            # Same hazard one tier up: a carried HOST partial re-releases
+            # every client fold it contains in a later round, doubling
+            # their accounted sensitivity and crossing cohort boundaries.
+            raise ValueError(
+                "dp cannot be combined with a tier staleness budget "
+                f"(host_staleness_rounds={s.host_staleness_rounds}): a "
+                "carried host partial re-releases its client folds in a "
+                "later round, giving each 2x the accounted per-round "
+                "sensitivity and breaking cohort-subsampling amplification "
+                "— set host_staleness_rounds=0 for dp runs"
+            )
         n_dev = client_mesh_size(mesh)
         num_clients, _, _ = _round_geometry(xs, n_dev, num_real_clients)
         cohort = sample_cohort(s, round_index, num_clients)
@@ -1191,17 +1239,65 @@ class StreamEngine:
 
         # ---- process arrivals in time order ------------------------------
         deadline = s.deadline_s if s.deadline_s > 0 else float("inf")
-        if s.num_hosts >= 2:
+        hier = s.num_hosts >= 2
+        if hier:
             # Hierarchical multi-host fold (ISSUE 16): each host's tier
             # folds its contiguous client block locally and ships ONE
             # partial ciphertext across the simulated DCN at commit time
             # — O(hosts) cross-host bytes, bitwise the flat fold (lazy
-            # import: hierarchy pulls this module).
-            from hefl_tpu.fl.hierarchy import HierarchicalAggregator
+            # import: hierarchy pulls this module). ISSUE 17 makes the
+            # tier->root uplink faulty: the link-fault schedule and the
+            # ship retry policy ride into the aggregator.
+            from hefl_tpu.fl.hierarchy import HierarchicalAggregator, ShipPolicy
 
-            acc = HierarchicalAggregator(ctx.ntt.p, s.num_hosts, num_clients)
+            link = None
+            if self.faults is not None and self.faults._any_link_fault():
+                if int(self.faults.num_hosts) != int(s.num_hosts):
+                    raise ValueError(
+                        f"FaultConfig.num_hosts={self.faults.num_hosts} does "
+                        f"not match StreamConfig.num_hosts={s.num_hosts}: "
+                        "the link-fault schedule would fault the uplinks of "
+                        "a different fold-tree topology"
+                    )
+                link = schedule_links(self.faults, round_index)
+            acc = HierarchicalAggregator(
+                ctx.ntt.p, s.num_hosts, num_clients,
+                round_index=round_index, link=link,
+                ship=ShipPolicy(
+                    deadline_s=float(s.ship_deadline_s),
+                    max_retries=int(s.max_retries),
+                    backoff_s=float(s.retry_backoff_s),
+                    jitter=float(s.retry_jitter),
+                    seed=int(s.seed),
+                ),
+            )
+            host_of = host_of_clients(num_clients, s.num_hosts)
         else:
             acc = OnlineAccumulator(ctx.ntt.p)
+            host_of = None
+        # ---- stale tier folds (ISSUE 17) ---------------------------------
+        # Host partials that missed an earlier round's commit fold at THIS
+        # round's root before any arrival: each is one sealed mod-p sum,
+        # deduped by (host, origin_round), and its clients re-enter the
+        # released set without re-uploading. acc.folded counts their client
+        # folds, so quorum/headroom/DP accounting see them automatically.
+        tier_stale_folded = 0
+        tier_stale_clients: list[int] = []
+        if hier:
+            for tp in self._pending_tiers:
+                if session is not None:
+                    session.tier_fold(
+                        round_index, tp.host, tp.origin_round, tp.sha,
+                        len(tp.clients), tp.lateness,
+                    )
+                if acc.fold_carried(
+                    tp.host, tp.origin_round, tp.c0, tp.c1, tp.sha,
+                    len(tp.clients),
+                ):
+                    tier_stale_folded += 1
+                    tier_stale_clients.extend(int(c) for c in tp.clients)
+                    for tc in tp.clients:
+                        bits[int(tc)] &= ~EXCLUDED_UNSAMPLED
         staleness_hist = obs_metrics.histogram("stream.staleness_rounds")
         committed_at: float | None = None
         fresh = stale_folded = arrivals = rejected = 0
@@ -1332,12 +1428,93 @@ class StreamEngine:
         # failure the batched path fail-louds on (fl.secure). Streaming
         # degrades instead of raising: the model carries forward, loudly.
         degraded_reason = None if committed else "quorum"
+
+        # ---- hierarchical ship phase (ISSUE 17) --------------------------
+        # The client-quorum commit point launches every nonempty tier's
+        # ship onto the faulty DCN uplink: delay, transient loss with
+        # journaled retries (exempt from the ship deadline once launched),
+        # dark links, and duplicate deliveries (deduped at the root) all
+        # run on the same virtual clock. The round then re-takes its
+        # verdict at the TIER level: fewer than host_quorum landed tiers
+        # (or an empty released sum) degrades the round exactly like a
+        # missed client quorum. The client quorum itself was enforced at
+        # arrival time over the FULL fold set; host_quorum < 1 is the
+        # operator's explicit consent to release with missed tiers
+        # excluded per-cause — the released sum then holds at least
+        # qcount - (folds of the missed tiers) uploads, and dp runs keep
+        # the hard calibration floor on the RELEASED count below.
+        host_tau = int(s.host_staleness_rounds)
+        pending_tiers_next: list[PendingTierPartial] = []
+        tier_carried = 0
+        tier_stale_excluded = 0
+        missed_hosts: set[int] = set()
+        hq = 0
+        released: int | None = None
+        if hier and committed:
+            acc.ship_all(t0=float(committed_at))
+            if session is not None:
+                for sh_h, sh_att, sh_t, sh_lost in acc.ship_log:
+                    if sh_att > 1:
+                        session.ship_retry(
+                            round_index, sh_h, sh_att, sh_t, sh_lost
+                        )
+            nonempty = int(acc.nonempty_tiers)
+            hq = max(1, math.ceil(s.host_quorum * nonempty)) if nonempty else 0
+            missed_hosts = {h for h, _cz in acc.missed_ships}
+            # Per-cause attribution for every client whose tier missed the
+            # ship — set regardless of the round's eventual verdict so the
+            # exclusions.host_* counters track the link-fault schedule.
+            for mh, cause in acc.missed_ships:
+                cbit = (
+                    EXCLUDED_HOST_TIMEOUT if cause == "timeout"
+                    else EXCLUDED_HOST_UNREACHABLE
+                )
+                for c in folded_clients:
+                    if int(host_of[c]) == int(mh):
+                        bits[int(c)] |= cbit
+            released = (
+                sum(
+                    1 for c in folded_clients
+                    if int(host_of[c]) not in missed_hosts
+                )
+                + len(tier_stale_clients)
+            )
+            if len(acc.landed_hosts) < hq:
+                committed = False
+                degraded_reason = "host_quorum"
+                obs_metrics.counter("stream.host_quorum_degraded").inc()
+            elif released <= 0:
+                # Every landed fold was in a missed tier: nothing to
+                # release — same verdict as a missed client quorum.
+                committed = False
+                degraded_reason = "quorum"
         if dp is not None and committed:
             dp_floor = calibration_clients(dp, num_clients)
-            if acc.folded < dp_floor:
+            n_rel = released if released is not None else acc.folded
+            if n_rel < dp_floor:
                 committed = False
                 degraded_reason = "dp_floor"
                 obs_metrics.counter("stream.dp_floor_degraded").inc()
+        if committed and missed_hosts:
+            # The round commits WITHOUT the missed tiers: their clients are
+            # excluded per-cause and each sealed partial carries under the
+            # tier staleness budget to fold at a later round's root.
+            for mh, _cause in acc.missed_ships:
+                pc0, pc1, psha, _nf = acc.take_late_partial(mh)
+                t_clients = tuple(
+                    int(c) for c in folded_clients
+                    if int(host_of[c]) == int(mh)
+                )
+                if host_tau >= 1 and t_clients:
+                    pending_tiers_next.append(PendingTierPartial(
+                        host=int(mh), origin_round=int(round_index),
+                        sha=psha, c0=pc0, c1=pc1, clients=t_clients,
+                        lateness=1,
+                    ))
+                    tier_carried += 1
+        surviving = 0
+        if committed:
+            surviving = int(released if released is not None else acc.folded)
         if session is not None:
             # The transaction's verdict record. On replay the re-derived
             # canonical-sum sha256 must MATCH the journaled one — the
@@ -1346,7 +1523,7 @@ class StreamEngine:
             if committed:
                 sc0, sc1 = acc.value(like_shape=row_shape)
                 session.commit(
-                    round_index, ct_hash(sc0, sc1), acc.folded, fresh,
+                    round_index, ct_hash(sc0, sc1), surviving, fresh,
                     stale_folded, commit_s,
                 )
             else:
@@ -1407,12 +1584,57 @@ class StreamEngine:
                         lateness=1,
                     ))
                     carried += 1
+            # Carried tier partials folded into the discarded accumulator
+            # (or still pending): re-carry each one round deeper under the
+            # tier budget, restoring its clients' attribution — past the
+            # budget its clients are excluded as host_stale.
+            for tp in self._pending_tiers:
+                next_late = tp.lateness + 1
+                if next_late <= host_tau:
+                    pending_tiers_next.append(
+                        dataclasses.replace(tp, lateness=next_late)
+                    )
+                    tier_carried += 1
+                    for tc in tp.clients:
+                        bits[int(tc)] |= EXCLUDED_HOST_TIMEOUT
+                else:
+                    for tc in tp.clients:
+                        bits[int(tc)] |= EXCLUDED_HOST_STALE
+                    tier_stale_excluded += 1
 
         # ---- public metadata + observability -----------------------------
-        surviving = acc.folded if committed else 0
+        hosts_rec = None
+        if hier:
+            hosts_rec = {
+                "nonempty": int(acc.nonempty_tiers),
+                "landed": [int(h) for h in acc.landed_hosts],
+                "missed": [
+                    [int(h), str(cz)] for h, cz in acc.missed_ships
+                ],
+                "host_quorum": int(hq),
+                "ship_retries": int(acc.ship_retries),
+                "ship_lost": int(acc.ship_lost),
+                "ship_deduped": int(acc.ship_deduped),
+                "tier_carried": int(tier_carried),
+                "tier_stale_folded": int(tier_stale_folded),
+                "tier_stale_excluded": int(tier_stale_excluded),
+                "ships_done_s": round(float(acc.ships_done_s), 6),
+            }
+            obs_metrics.counter("dcn.tier.carried").inc(tier_carried)
+            obs_metrics.counter("dcn.tier.stale_folded").inc(
+                tier_stale_folded
+            )
+            obs_metrics.counter("dcn.tier.stale_excluded").inc(
+                tier_stale_excluded
+            )
         participation = np.zeros(num_clients, np.int32)
         if committed:
-            participation[np.asarray(folded_clients, dtype=int)] = 1
+            rel_clients = [
+                c for c in folded_clients
+                if host_of is None or int(host_of[c]) not in missed_hosts
+            ] + tier_stale_clients
+            if rel_clients:
+                participation[np.asarray(rel_clients, dtype=int)] = 1
         meta = RoundMeta(
             num_clients=num_clients,
             bits=tuple(int(v) for v in bits),
@@ -1441,6 +1663,7 @@ class StreamEngine:
             rejected=rejected,
             retries=retries_made,
             commit_s=float(commit_s),
+            hosts=hosts_rec,
         )
         obs_metrics.counter("stream.arrivals").inc(arrivals)
         obs_metrics.counter("stream.duplicates").inc(acc.duplicates)
@@ -1454,13 +1677,12 @@ class StreamEngine:
         obs_events.emit(
             "stream_round", round=round_index, **smeta.record()
         )
-        if s.num_hosts >= 2 and committed:
+        if hier and committed:
             # One DCN-traffic summary per committed hierarchical round:
             # per-uplink bytes, the flat-topology model for the same
-            # folds, and their ratio. The commit seals the fold set, so
-            # shipping here (idempotent) makes the counters final even on
-            # journal-less rounds where value() runs later.
-            acc.ship_all()
+            # folds, their ratio, and the faulty-uplink outcome. The ship
+            # phase above already ran the delivery timelines and sealed
+            # the tree, so the counters are final here.
             obs_events.emit("dcn_round", round=round_index, **acc.report())
         # Quorum-wait span: how long (simulated) the round held open before
         # committing — the streaming analog of the straggler wait.
@@ -1487,6 +1709,14 @@ class StreamEngine:
                     round_index, up.client, up.origin_round, up.nonce,
                     up.lands_at, up.lateness, up.c0, up.c1,
                 )
+            for tp in pending_tiers_next:
+                # Payload-bearing like `carry`: a carried HOST partial must
+                # survive a crash even though its origin round's tier
+                # journals are gone by the time it folds.
+                session.tier_carry(
+                    round_index, tp.host, tp.origin_round, tp.clients,
+                    tp.lateness, tp.c0, tp.c1,
+                )
             session.close(
                 round_index, committed, surviving, meta.excluded, seen
             )
@@ -1496,6 +1726,7 @@ class StreamEngine:
         # previous round's carried uploads and dedup window intact for
         # the driver's retry.
         self._pending = pending_next
+        self._pending_tiers = pending_tiers_next
         self._seen = seen
 
         if committed:

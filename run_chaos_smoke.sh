@@ -64,6 +64,13 @@
 #       identically by both twins). Every round must commit in both with
 #       identical stream records, and the final params must be BITWISE
 #       equal — the fold tree commits exactly the flat aggregate.
+#   (l) lossy-DCN twin (ISSUE 17): the streaming schedule with the
+#       tier->root uplinks faulted — transient ship loss (recovered by
+#       the ship retry), duplicated delivery (root dedup), per-uplink
+#       delay — vs the flat twin at the identical client schedule.
+#       Committed rounds must stay BITWISE equal to flat, and the
+#       retry/dedup/exclusion counters must equal the injected link
+#       schedule exactly.
 # Artifact: CHAOS_SMOKE.json (accuracy curves + per-round exclusions
 # + the events.jsonl cross-checks, streaming + crash-recovery + HHE +
 # cohort-only + hierarchical twins included).
@@ -760,7 +767,13 @@ for hname, hfaults in (("duplicate-storm", hier_storm_faults),
                     f"hierarchical twin ({hname}, {tname}) round {r}: "
                     "did not commit"
                 )
-        if rec_fl.get("stream") != rec_hi.get("stream"):
+        # the hierarchical record carries an extra `hosts` sub-record
+        # (tier landings/counters, ISSUE 17) the flat topology has no
+        # analogue for; everything else must match exactly
+        st_fl = dict(rec_fl.get("stream") or {})
+        st_hi = dict(rec_hi.get("stream") or {})
+        st_hi.pop("hosts", None)
+        if st_fl != st_hi:
             fail.append(
                 f"hierarchical twin ({hname}) round {r}: stream record "
                 "diverged between the flat and hierarchical topologies"
@@ -774,6 +787,110 @@ for hname, hfaults in (("duplicate-storm", hier_storm_faults),
             if (h.get("stream") or {}).get("committed")
         ],
     }
+
+# (l) lossy-DCN leg (ISSUE 17): the same streaming schedule with the
+# tier->root uplinks faulted — one transient ship loss (recovered by
+# the ship retry), one duplicated delivery (root dedup), and per-uplink
+# delivery delay — vs the flat twin at the IDENTICAL client schedule
+# (link faults draw on an independent PRNG stream and the flat engine
+# has no uplinks). Gates: every committed round's stream record and the
+# final params BITWISE equal, and the retry/dedup counters equal the
+# injected link schedule EXACTLY (no exclusions: nothing is dark and
+# there is no ship deadline).
+from hefl_tpu.fl import schedule_links
+
+lossy_faults = dataclasses.replace(
+    recovery_faults, num_hosts=4, link_loss_hosts=1, link_dup_hosts=1,
+    link_delay_s=0.5,
+)
+lossy_flat_cfg = dataclasses.replace(
+    stream_cfg, faults=lossy_faults, events_path="",
+)
+lossy_hier_cfg = dataclasses.replace(
+    lossy_flat_cfg,
+    stream=dataclasses.replace(
+        lossy_flat_cfg.stream, num_hosts=4, host_quorum=0.5,
+        host_staleness_rounds=1,
+    ),
+)
+print("chaos smoke: lossy-DCN twin (loss 1 + dup 1 + delay 0.5s) ...",
+      flush=True)
+lossy_flat_run = run_experiment(lossy_flat_cfg, verbose=False)
+lossy_hier_run = run_experiment(lossy_hier_cfg, verbose=False)
+lossy_equal = True
+for a, b in zip(
+    _jax_s.tree_util.tree_leaves(lossy_flat_run["params"]),
+    _jax_s.tree_util.tree_leaves(lossy_hier_run["params"]),
+):
+    if not np.array_equal(np.asarray(a), np.asarray(b)):
+        lossy_equal = False
+        fail.append(
+            "lossy-DCN twin: final params differ bitwise from the flat "
+            "twin — a retried/duplicated ship changed the committed sum"
+        )
+        break
+lossy_counters = []
+for r, (rec_fl, rec_hi) in enumerate(
+    zip(lossy_flat_run["history"], lossy_hier_run["history"])
+):
+    st_fl = dict(rec_fl.get("stream") or {})
+    st_hi = dict(rec_hi.get("stream") or {})
+    hosts = st_hi.pop("hosts", None) or {}
+    if not st_hi.get("committed"):
+        fail.append(f"lossy-DCN twin round {r}: did not commit")
+        continue
+    if st_fl != st_hi:
+        fail.append(
+            f"lossy-DCN twin round {r}: stream record diverged from the "
+            "flat twin under link faults"
+        )
+    # counters == the injected link schedule, exactly: every nonempty
+    # tier ships; transient uplinks lose + retry ONCE, duplicate uplinks
+    # deliver twice and dedup ONCE, nothing is missed or excluded
+    lf = schedule_links(lossy_faults, r)
+    landed = set(hosts.get("landed") or ())
+    want_lost = sum(1 for h in landed if lf.transient[h])
+    want_dup = sum(1 for h in landed if lf.duplicate[h])
+    got = {
+        "round": r,
+        "ship_lost": hosts.get("ship_lost"),
+        "ship_retries": hosts.get("ship_retries"),
+        "ship_deduped": hosts.get("ship_deduped"),
+        "missed": hosts.get("missed"),
+    }
+    lossy_counters.append(got)
+    if len(landed) != hosts.get("nonempty") or hosts.get("missed"):
+        fail.append(
+            f"lossy-DCN twin round {r}: a tier missed the round — "
+            f"{hosts.get('missed')} (nothing is dark and there is no "
+            "ship deadline; retries must recover every loss)"
+        )
+    if (got["ship_lost"] != want_lost or got["ship_retries"] != want_lost
+            or got["ship_deduped"] != want_dup):
+        fail.append(
+            f"lossy-DCN twin round {r}: retry/dedup counters {got} != "
+            f"link schedule (lost/retried {want_lost}, deduped {want_dup})"
+        )
+    rob = rec_hi.get("robust") or {}
+    exc = rob.get("excluded") or {}
+    for cause in ("host_timeout", "host_unreachable", "host_stale"):
+        if exc.get(cause, 0):
+            fail.append(
+                f"lossy-DCN twin round {r}: unexpected {cause} "
+                f"exclusions {exc.get(cause)} (schedule injects none)"
+            )
+lossy_summary = {
+    "num_hosts": 4,
+    "link_loss_hosts": 1,
+    "link_dup_hosts": 1,
+    "link_delay_s": 0.5,
+    "bitwise_equal_to_flat": lossy_equal,
+    "counters_by_round": lossy_counters,
+    "rounds_committed": [
+        r for r, h in enumerate(lossy_hier_run["history"])
+        if (h.get("stream") or {}).get("committed")
+    ],
+}
 
 artifact = {
     "preset": "chaos-smoke",
@@ -804,6 +921,10 @@ artifact = {
     # bitwise equality under duplicate-storm and regional-outage
     # schedules, ISSUE 16).
     "hier_check": hier_checks,
+    # The lossy-DCN twin's cross-check (ship loss + duplication + delay
+    # vs flat bitwise equality + retry/dedup counters == link schedule,
+    # ISSUE 17).
+    "lossy_dcn_check": lossy_summary,
     "passed": not fail,
     "failures": fail,
 }
@@ -830,6 +951,8 @@ print(
     "cohort-only twin (6/8) committed every round bitwise-equal to its "
     "full-C-trained twin, and the hierarchical twins (4 hosts) committed "
     "bitwise-equal to flat aggregation under both the duplicate-storm "
-    "and regional-outage schedules"
+    "and regional-outage schedules, and the lossy-DCN twin (ship loss + "
+    "duplication + delay) committed bitwise-equal to flat with retry/"
+    "dedup counters matching the link schedule exactly"
 )
 PY

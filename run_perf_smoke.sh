@@ -214,9 +214,19 @@ if not isinstance(rec, dict):
 for field in ("num_clients", "cohort_size", "num_hosts", "ct_bytes",
               "flat_dcn_bytes", "hier_dcn_bytes", "per_link",
               "shipping_hosts", "bytes_ratio", "ratio_floor",
-              "arrival_orders", "bitwise_equal"):
+              "arrival_orders", "bitwise_equal",
+              # faulty-uplink schema (ISSUE 17): every row carries the
+              # retry/quorum fields (zero on clean links) so dashboards
+              # can rely on them unconditionally
+              "ship_retries", "ship_lost", "ship_deduped",
+              "missed_hosts", "released"):
     if rec.get(field) is None:
         fail.append(f"BENCH_DCN: dcn_compare.{field} missing/null")
+if rec.get("missed_hosts"):
+    fail.append(
+        f"BENCH_DCN: clean-link geometry missed hosts "
+        f"{rec.get('missed_hosts')} — the quorum fields must be zero here"
+    )
 if rec.get("bitwise_equal") is not True:
     fail.append(
         "BENCH_DCN: hierarchical aggregate is NOT bitwise-equal to the "

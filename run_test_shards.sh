@@ -120,6 +120,17 @@ t0=$SECONDS
 HEFL_JOURNAL_FSYNC=always python -m pytest -q -m "not slow" \
   tests/test_hierarchy.py
 echo "== hierarchical-aggregation shard (fsync=always): $((SECONDS - t0))s"
+# Lossy-DCN shard (ISSUE 17): the faulty tier->root uplink — link-fault
+# schedules, ship retry/backoff + root-side dedup, the tier-quorum
+# degradation matrix, and the carried-stale-tier-partial replay — re-run
+# with every journal under fsync policy "always", so the per-attempt
+# ship_retry WAL records and the tier_carry/tier_fold recovery path get
+# the same maximum-durability coverage as the flat journal shard.
+t0=$SECONDS
+HEFL_JOURNAL_FSYNC=always python -m pytest -q -m "not slow" \
+  tests/test_faults.py tests/test_stream.py tests/test_journal.py \
+  -k "link or ship or tier"
+echo "== lossy-DCN shard (fsync=always): $((SECONDS - t0))s"
 # Analysis shard (ISSUE 8/12): the FULL static-analysis gate (no --fast)
 # — everything the pre-shard ran plus the scope-coverage stages, which
 # compile the real round programs (both fusion backends + the secure

@@ -668,3 +668,69 @@ def test_fused_secure_masked_round_drop_nan_and_reference():
     avg = decrypt_average(ctx, sk, ct, num_clients, spec, meta=meta)
     for a, b in zip(_leaves(avg), _leaves(ref)):
         np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+# ------------------------------------------- DCN link faults (ISSUE 17)
+
+
+def test_link_fault_schedule_deterministic_and_disjoint():
+    from hefl_tpu.fl.faults import schedule_links
+
+    fc = FaultConfig(
+        seed=11, num_hosts=6, link_loss_hosts=2, link_dark_hosts=1,
+        link_dup_hosts=2, link_delay_s=1.5,
+    )
+    a = schedule_links(fc, 3)
+    b = schedule_links(fc, 3)
+    np.testing.assert_array_equal(a.transient, b.transient)
+    np.testing.assert_array_equal(a.dark, b.dark)
+    np.testing.assert_array_equal(a.duplicate, b.duplicate)
+    np.testing.assert_array_equal(a.delay_s, b.delay_s)
+    # exact counts, not Bernoulli
+    assert int(a.transient.sum()) == 2
+    assert int(a.dark.sum()) == 1
+    assert int(a.duplicate.sum()) == 2
+    # draws are disjoint: one uplink holds at most one loss/dup role
+    assert not np.any(a.transient & a.dark)
+    assert not np.any(a.transient & a.duplicate)
+    assert not np.any(a.dark & a.duplicate)
+    # delay bounded by the knob, non-negative
+    assert np.all(a.delay_s >= 0) and np.all(a.delay_s <= 1.5)
+    # different rounds differ (overwhelmingly at H=6)
+    rounds = [schedule_links(fc, r) for r in range(6)]
+    assert len({tuple(np.flatnonzero(r.dark)) for r in rounds}) > 1
+
+
+def test_link_faults_compose_bit_identically_with_other_schedules():
+    # Adding link knobs must not perturb the round/arrival schedules —
+    # the link stream draws from its own PRNG key (seed, round, 7).
+    from hefl_tpu.fl.faults import schedule_arrivals, schedule_links
+
+    base = FaultConfig(
+        seed=9, drop_fraction=0.25, arrival_delay_s=2.0,
+        duplicate_clients=1, outage_hosts=1, num_hosts=4,
+    )
+    withlink = dataclasses.replace(
+        base, link_loss_hosts=1, link_delay_s=0.5, link_dup_hosts=1
+    )
+    for r in range(3):
+        s0, s1 = schedule_for_round(base, r, 8), schedule_for_round(withlink, r, 8)
+        np.testing.assert_array_equal(s0.dropped, s1.dropped)
+        np.testing.assert_array_equal(s0.poison, s1.poison)
+        a0, a1 = schedule_arrivals(base, r, 8), schedule_arrivals(withlink, r, 8)
+        np.testing.assert_array_equal(a0.arrival_s, a1.arrival_s)
+        np.testing.assert_array_equal(a0.duplicate, a1.duplicate)
+        np.testing.assert_array_equal(a0.transient, a1.transient)
+        np.testing.assert_array_equal(a0.permanent, a1.permanent)
+
+
+def test_link_fault_validation_and_exclusion_bound():
+    with pytest.raises(ValueError, match="num_hosts"):
+        FaultConfig(link_loss_hosts=1)
+    with pytest.raises(ValueError, match="num_hosts"):
+        FaultConfig(link_delay_s=1.0)
+    with pytest.raises(ValueError, match="link_dark_hosts"):
+        FaultConfig(link_dark_hosts=4, num_hosts=4)
+    fc = FaultConfig(num_hosts=4, link_dark_hosts=1, link_loss_hosts=1)
+    # worst case: a dark AND a lossy uplink can each exclude a whole block
+    assert fc.max_scheduled_exclusions(16) >= 8

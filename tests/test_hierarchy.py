@@ -330,8 +330,193 @@ def test_engine_hierarchical_twin_matches_flat(faults):
             model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(22), 0
         )
         assert smeta.committed
+        rec = smeta.record()
+        # The hier record adds the "hosts" uplink story (ISSUE 17) — the
+        # flat engine has no tiers, so the twin gate strips it and
+        # compares everything else bit for bit.
+        rec.pop("hosts", None)
         results[name] = (
-            ct_hash(np.asarray(ct.c0), np.asarray(ct.c1)), smeta.record()
+            ct_hash(np.asarray(ct.c0), np.asarray(ct.c1)), rec
         )
     assert results["flat"][0] == results["hier"][0]
     assert results["flat"][1] == results["hier"][1]
+
+
+# ----------------------------------------- faulty DCN uplinks (ISSUE 17)
+
+
+def _links(num_hosts, delay=(), dup=(), transient=(), dark=()):
+    """Hand-built LinkFaults: exact per-uplink behavior for ship tests."""
+    from hefl_tpu.fl.faults import LinkFaults
+
+    d = np.zeros(num_hosts)
+    for h, s in delay:
+        d[h] = s
+    mk = lambda hs: np.isin(np.arange(num_hosts), list(hs))
+    return LinkFaults(
+        delay_s=d, duplicate=mk(dup), transient=mk(transient), dark=mk(dark)
+    )
+
+
+def test_ship_policy_validation():
+    from hefl_tpu.fl.hierarchy import ShipPolicy
+
+    ShipPolicy()
+    with pytest.raises(ValueError, match="deadline_s"):
+        ShipPolicy(deadline_s=-1.0)
+    with pytest.raises(ValueError, match="jitter"):
+        ShipPolicy(jitter=1.5)
+
+
+def test_transient_ship_loss_retries_and_lands_bitwise():
+    from hefl_tpu.fl.hierarchy import ShipPolicy
+
+    ups, want = _uploads(k=8)
+    hier = HierarchicalAggregator(
+        P, 4, 8, round_index=0, link=_links(4, transient=[1]),
+        ship=ShipPolicy(max_retries=2, seed=3),
+    )
+    for nonce, c0, c1 in ups:
+        hier.fold(nonce, c0, c1)
+    hier.ship_all(t0=1.0)
+    # the lost first delivery was redelivered; nothing missed, nothing lost
+    # from the committed aggregate
+    assert hier.ship_lost == 1 and hier.ship_retries == 1
+    assert hier.missed_ships == [] and hier.released == 8
+    assert ct_hash(*hier.value()) == want
+    # attempts journal in virtual-clock order on host 1 (send, then retry)
+    att = [(h, a, t) for h, a, t, _ in hier.ship_log if h == 1]
+    assert [a for _, a, _ in att] == [1, 2]
+    assert att[1][2] > att[0][2] >= 1.0
+
+
+def test_duplicate_ship_delivery_dedups_exactly_once():
+    ups, want = _uploads(k=8)
+    hier = HierarchicalAggregator(
+        P, 4, 8, round_index=0, link=_links(4, dup=[2])
+    )
+    for nonce, c0, c1 in ups:
+        hier.fold(nonce, c0, c1)
+    hier.ship_all()
+    # two deliveries, ONE root fold: dedup count == injected duplicates
+    assert hier.ship_deduped == 1
+    assert hier.released == 8 and hier.missed_ships == []
+    assert ct_hash(*hier.value()) == want
+
+
+def test_dark_uplink_misses_round_and_partial_carries_conserved():
+    from hefl_tpu.fl.hierarchy import ShipPolicy
+
+    ups, want = _uploads(k=8)
+    hier = HierarchicalAggregator(
+        P, 4, 8, round_index=0, link=_links(4, dark=[3]),
+        ship=ShipPolicy(max_retries=2, seed=5),
+    )
+    for nonce, c0, c1 in ups:
+        hier.fold(nonce, c0, c1)
+    hier.ship_all(t0=0.0)
+    # every delivery (send + retries) lost -> host_unreachable, excluded
+    # from the released sum but NOT from folded
+    assert hier.missed_ships == [(3, "unreachable")]
+    assert hier.ship_lost == 3 and hier.ship_retries == 2
+    assert hier.folded == 8 and hier.released == 6
+    assert ct_hash(*hier.value()) != want
+    # the sealed partial carries: folding it at the NEXT round's root is
+    # bitwise folding it at this one (conservation)
+    pc0, pc1, sha, nfold = hier.take_late_partial(3)
+    assert nfold == 2
+    nxt = HierarchicalAggregator(P, 4, 8, round_index=1)
+    assert nxt.fold_carried(3, 0, pc0, pc1, sha, nfold)
+    assert nxt.stale_tier_folds == 1 and nxt.folded == 2
+    # a redelivered carry dedups by (host, origin_round) -- never double
+    assert not nxt.fold_carried(3, 0, pc0, pc1, sha, nfold)
+    assert nxt.ship_deduped == 1 and nxt.folded == 2
+    r0c0, r0c1 = hier.value()
+    r1c0, r1c1 = nxt.value(like_shape=r0c0.shape)
+    s0 = ((r0c0.astype(np.int64) + r1c0.astype(np.int64)) % P).astype(np.uint32)
+    s1 = ((r0c1.astype(np.int64) + r1c1.astype(np.int64)) % P).astype(np.uint32)
+    assert ct_hash(s0, s1) == want
+    # a diverged carried partial fails loudly
+    from hefl_tpu.fl import journal as jr
+
+    with pytest.raises(jr.JournalError, match="diverged"):
+        bad = np.array(pc0)
+        bad[0, 0] = (int(bad[0, 0]) + 1) % P
+        HierarchicalAggregator(P, 4, 8, round_index=1).fold_carried(
+            3, 0, bad, pc1, sha, nfold
+        )
+
+
+def test_ship_deadline_times_out_but_retried_deliveries_are_exempt():
+    from hefl_tpu.fl.hierarchy import ShipPolicy
+
+    ups, _ = _uploads(k=8)
+    # host 0 delayed past the deadline -> host_timeout; host 1's first
+    # delivery is lost and its RETRY lands after the deadline yet still
+    # folds (the retry contract: the root extended the round for it)
+    hier = HierarchicalAggregator(
+        P, 4, 8, round_index=0,
+        link=_links(4, delay=[(0, 5.0)], transient=[1]),
+        ship=ShipPolicy(deadline_s=2.0, max_retries=1, backoff_s=4.0, seed=7),
+    )
+    for nonce, c0, c1 in ups:
+        hier.fold(nonce, c0, c1)
+    hier.ship_all(t0=0.0)
+    assert hier.missed_ships == [(0, "timeout")]
+    assert 0 not in hier.landed_hosts and 1 in hier.landed_hosts
+    retry = [t for h, a, t, lost in hier.ship_log if h == 1 and a == 2][0]
+    assert retry > 2.0   # landed past the deadline, still folded
+    assert hier.released == 6
+
+
+def test_post_ship_crash_recovery_deferred_reship_dedups_with_duplicate(
+    tmp_path,
+):
+    """Satellite: a post_ship crash (tier_ship journaled, root never saw
+    the partial) recovers by DEFERRING the re-ship to ship_all, where a
+    schedule-injected duplicate delivers it twice more — the root folds
+    exactly once (root folds == distinct shipped tiers) and root.wal
+    proves it."""
+    ups, want = _uploads(k=8)
+    jdir = str(tmp_path / "tiers")
+    crashed = HierarchicalAggregator(
+        P, 4, 8, journal_dir=jdir,
+        crash=TierCrash(host=1, at="post_ship", after_folds=1),
+    )
+    with pytest.raises(SimulatedCrash):
+        for nonce, c0, c1 in ups:
+            crashed.fold(nonce, c0, c1)
+        crashed.ship_all()
+    crashed.close()
+
+    rec = HierarchicalAggregator(
+        P, 4, 8, journal_dir=jdir, round_index=0, link=_links(4, dup=[1])
+    )
+    # recovery did NOT re-ship host 1 yet: deferred to ship_all
+    assert 1 not in rec.landed_hosts
+    for nonce, c0, c1 in ups:
+        try:
+            rec.fold(nonce, c0, c1)
+        except RuntimeError:
+            pass
+    rec.ship_all()
+    assert ct_hash(*rec.value()) == want
+    # the re-ship raced a duplicate delivery: exactly one fold, the rest
+    # deduped; attempt numbering continued from the journaled attempt
+    assert rec.ship_deduped >= 1
+    assert max(a for h, a, _, _ in rec.ship_log if h == 1) >= 2
+    rec.close()
+    # root.wal holds exactly ONE root_fold per shipped tier
+    from hefl_tpu.fl import journal as jr
+
+    import os
+
+    _w, records, _t = jr.open_journal(
+        os.path.join(jdir, "root.wal"), "never",
+        meta={"num_hosts": 4, "num_clients": 8, "tier": "root"},
+    )
+    _w.close()
+    folds = [r for r in records if r.get("kind") == "root_fold"]
+    hosts = [int(r["host"]) for r in folds]
+    assert sorted(hosts) == [0, 1, 2, 3]
+    assert len(hosts) == len(set(hosts))

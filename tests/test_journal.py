@@ -638,3 +638,55 @@ def test_tier_journal_truncated_mid_fold_refolds_not_double_counts(tmp_path):
     assert again.refolded == k and again.folded == k
     assert ct_hash(*again.value()) == want
     again.close()
+
+
+def test_replay_round_with_carried_stale_tier_partial_bitwise(tmp_path):
+    # ISSUE 17 satellite: a round committed WITH a carried stale tier
+    # partial replays bitwise. Round 0 commits while one uplink is dark
+    # (its sealed partial journals as tier_carry); the server crashes
+    # mid-round-1, and recovery must re-materialize the pending tier
+    # partial from the journal so the re-run round 1 folds it at the
+    # root — sha256 equal to the uninterrupted in-memory twin.
+    model, params, xs, ys = _setup(num_clients=8)
+    mesh = make_mesh(8)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(21))
+    sc = StreamConfig(num_hosts=4, quorum=0.5, host_quorum=0.5,
+                      host_staleness_rounds=1, max_retries=1)
+    fc = FaultConfig(seed=5, link_dark_hosts=1, num_hosts=4)
+
+    def args(r):
+        return (model, CFG, mesh, ctx, pk, params, xs, ys,
+                jax.random.key(22 + r), r)
+
+    def run(target, rounds):
+        out = {}
+        for r in rounds:
+            ct, _, _, sm = target.run_round(*args(r))
+            out[r] = (ct_hash(ct.c0, ct.c1), sm.record())
+        return out
+
+    twin = run(StreamEngine(sc, fc), (0, 1))
+    assert twin[0][1]["hosts"]["tier_carried"] == 1
+    assert twin[1][1]["hosts"]["tier_stale_folded"] == 1
+
+    jp = str(tmp_path / "j.wal")
+    srv = AggregationServer(
+        sc, fc, journal_path=jp, fsync_policy=None,
+        crash=CrashConfig(round=1, at="post_fold", after_folds=1),
+    )
+    live = run(srv, (0,))
+    assert live[0] == twin[0]
+    with pytest.raises(SimulatedCrash):
+        srv.run_round(*args(1))
+
+    srv2 = AggregationServer(sc, fc, journal_path=jp, fsync_policy=None)
+    # recovery re-materialized the carried tier partial from tier_carry
+    assert srv2.recovered.carried_tier_partials == 1
+    tp = srv2.engine._pending_tiers[0]
+    assert (tp.host, tp.origin_round, tp.lateness) == (
+        twin[0][1]["hosts"]["missed"][0][0], 0, 1
+    )
+    got = run(srv2, (1,))
+    assert got[1] == twin[1]   # sha + full round record, bitwise
+    srv2.close()

@@ -681,3 +681,169 @@ def test_experiment_streaming_history_and_finite(tmp_path):
         run_experiment(
             dataclasses.replace(cfg, encrypted=False), verbose=False
         )
+
+
+# ------------------------------ tier quorum + late-partial carry (ISSUE 17)
+
+
+def test_stream_config_tier_knob_validation():
+    with pytest.raises(ValueError, match="host_quorum"):
+        StreamConfig(num_hosts=4, host_quorum=0.0)
+    with pytest.raises(ValueError, match="host_quorum"):
+        StreamConfig(num_hosts=4, host_quorum=1.5)
+    # the tier knobs describe the tier->root uplink: flat engine has none
+    for kw in (
+        {"host_quorum": 0.5},
+        {"ship_deadline_s": 1.0},
+        {"host_staleness_rounds": 1},
+    ):
+        with pytest.raises(ValueError, match="num_hosts"):
+            StreamConfig(**kw)
+    StreamConfig(num_hosts=4, host_quorum=0.5, ship_deadline_s=1.0,
+                 host_staleness_rounds=1)
+
+
+def test_engine_dp_rejects_tier_staleness_budget():
+    # Satellite: a carried HOST partial would double its clients'
+    # accounted sensitivity exactly like a carried client upload — dp +
+    # host_staleness_rounds refuses with the staleness error contract at
+    # both the engine and the driver.
+    from hefl_tpu.experiment import ExperimentConfig, HEConfig, run_experiment
+    from hefl_tpu.fl import DpConfig
+
+    num_clients = 2
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(23))
+    eng = StreamEngine(
+        StreamConfig(num_hosts=2, host_staleness_rounds=1), None
+    )
+    with pytest.raises(ValueError, match="tier staleness"):
+        eng.run_round(
+            model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(24), 0,
+            dp=DpConfig(noise_multiplier=0.1),
+        )
+    train = TrainConfig(epochs=1, batch_size=8, num_classes=10, augment=False,
+                        val_fraction=0.25)
+    with pytest.raises(ValueError, match="tier staleness"):
+        run_experiment(
+            ExperimentConfig(
+                model="smallcnn", dataset="mnist", num_clients=2, rounds=1,
+                train=train, he=HEConfig(n=256), n_train=32, n_test=16,
+                dp=DpConfig(noise_multiplier=0.1),
+                stream=StreamConfig(num_hosts=2, host_staleness_rounds=1),
+            ),
+            verbose=False,
+        )
+
+
+def test_engine_tier_quorum_degradation_matrix():
+    # Dark uplinks vs H_Q: with the missed tier ABOVE host quorum the
+    # round commits and the sealed partial carries; AT/BELOW host quorum
+    # the round degrades exactly like a client-quorum miss (model
+    # carried, encryption of zero, never a sub-quorum sum); with
+    # host_staleness_rounds=0 the missed tier is excluded, not carried.
+    from hefl_tpu.fl.faults import (
+        EXCLUDED_HOST_UNREACHABLE,
+    )
+
+    num_clients = 8
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(21))
+    fc = FaultConfig(seed=5, link_dark_hosts=1, num_hosts=4)
+    key = jax.random.key(22)
+
+    # above H_Q (hq=1 of 2 nonempty tiers land): commit + carry
+    eng = StreamEngine(
+        StreamConfig(num_hosts=4, quorum=0.5, host_quorum=0.5,
+                     host_staleness_rounds=1, max_retries=1), fc,
+    )
+    _, _, _, s0 = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, key, 0
+    )
+    assert s0.committed and s0.hosts is not None
+    assert s0.hosts["missed"] and s0.hosts["tier_carried"] == 1
+    assert len(eng._pending_tiers) == 1
+    missed_host = s0.hosts["missed"][0][0]
+    dark = [
+        c for c in range(num_clients)
+        if s0.meta.bits[c] & EXCLUDED_HOST_UNREACHABLE
+    ]
+    assert dark and all(c // 2 == missed_host for c in dark)
+    # released sum excludes the missed tier's folds
+    assert s0.meta.surviving == s0.fresh - len(dark)
+    # the round record carries the hosts sub-record with the counters
+    rec = s0.record()
+    assert rec["hosts"]["ship_lost"] >= 1
+    assert rec["hosts"]["host_quorum"] == 1
+
+    # below H_Q (host_quorum=1.0 -> hq = nonempty): degrade, zero release
+    engq = StreamEngine(
+        StreamConfig(num_hosts=4, quorum=0.5, host_quorum=1.0,
+                     max_retries=1), fc,
+    )
+    ct, _, _, d0 = engq.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, key, 0
+    )
+    assert not d0.committed and d0.degraded_reason == "host_quorum"
+    assert d0.meta.surviving == 0
+    assert not np.any(np.asarray(ct.c0)) and not np.any(np.asarray(ct.c1))
+
+    # tau=0: the missed tier is excluded per-cause, never carried
+    eng0 = StreamEngine(
+        StreamConfig(num_hosts=4, quorum=0.5, host_quorum=0.5,
+                     max_retries=1), fc,
+    )
+    _, _, _, z0 = eng0.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, key, 0
+    )
+    assert z0.committed and z0.hosts["tier_carried"] == 0
+    assert len(eng0._pending_tiers) == 0
+    assert z0.hosts["missed"] == s0.hosts["missed"]
+
+
+def test_engine_carried_tier_partial_folds_next_round_conserved():
+    # 2-round conservation: the tier partial missed at round 0 folds at
+    # round 1's root as a stale tier fold — its clients re-enter the
+    # released count (surviving = fresh released + carried tier clients)
+    # and the decode denominator stays consistent.
+    from hefl_tpu.ckks.packing import PackSpec
+    from hefl_tpu.fl import decrypt_average
+
+    num_clients = 8
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(21))
+    spec = PackSpec.for_params(params, ctx.n)
+    fc = FaultConfig(seed=5, link_dark_hosts=1, num_hosts=4)
+    eng = StreamEngine(
+        StreamConfig(num_hosts=4, quorum=0.5, host_quorum=0.5,
+                     host_staleness_rounds=1, max_retries=1), fc,
+    )
+    _, _, _, s0 = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(22), 0
+    )
+    assert s0.committed and s0.hosts["tier_carried"] == 1
+    carried_clients = len(eng._pending_tiers[0].clients)
+    ct1, _, _, s1 = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(23), 1
+    )
+    assert s1.committed and s1.hosts["tier_stale_folded"] == 1
+    # conservation: carried == late partials folded, and round 1's decode
+    # denominator counts the carried tier's uploads ON TOP of its fresh
+    # release (those are round-0 uploads landing late — distinct
+    # contributions to the running sum, never double-folded: the root
+    # dedups by (host, origin_round))
+    missed1 = {h for h, _ in s1.hosts["missed"]}
+    fresh_released = s1.fresh - sum(
+        1 for c in range(num_clients)
+        if s1.meta.participation[c] and (c // 2) in missed1
+    )
+    assert s1.meta.surviving == fresh_released + carried_clients
+    avg = decrypt_average(ctx, sk, ct1, None, spec, meta=s1.meta)
+    for leaf in _leaves(avg):
+        assert np.all(np.isfinite(leaf))
