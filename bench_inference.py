@@ -9,6 +9,15 @@ it. Batched rows drive `score_many` (bucket-padded batches, one fused
 dispatch chain per batch) against the single-query rows, which is the
 throughput claim the perf smoke gates at >= 1.3x.
 
+ISSUE 18 adds the hoisting rows: the BSGS plan with the baby sweep's
+gadget decomposition shared ("bsgs", the serving default) vs re-run per
+step ("bsgs_unhoisted") — bitwise-equal outputs (gated by parity shas),
+strictly fewer forward NTTs per score, and a gated hoisted-QPS floor —
+plus the composed two-layer "mlp_bsgs" plan against the per-class-ladder
+"mlp" rows (same circuit to decryption tolerance, far fewer
+key-switches). The `hoisted` and `mlp_compare` artifact blocks carry the
+comparisons.
+
 Both configurations sit within the 128-bit-security envelope (linear:
 N=4096 / 3x27-bit primes, log2(q)=81 <= 109; MLP: N=8192 / 5 primes,
 log2(q)=135 <= 218). The reference has no private-inference capability at
@@ -61,9 +70,10 @@ def _measure(call, ready, reps):
     return compile_s, np.asarray(lats), out
 
 
-def _row(name, plan, batch, keyswitches, compile_s, lats, err, argmax_ok):
+def _row(name, plan, batch, keyswitches, compile_s, lats, err, argmax_ok,
+         ntts=None):
     mean = float(np.mean(lats))
-    return {
+    row = {
         "row": name,
         "plan": plan,
         "batch": batch,
@@ -78,6 +88,21 @@ def _row(name, plan, batch, keyswitches, compile_s, lats, err, argmax_ok):
         "max_abs_err": err,
         "argmax_ok": argmax_ok,
     }
+    if ntts is not None:
+        row["forward_ntts_per_score"] = int(ntts)
+    return row
+
+
+def _parity_sha(out) -> str:
+    """Bitwise fingerprint of a ciphertext result: sha256 over the raw
+    (c0, c1) residue bytes. Equal shas == bitwise-equal ciphertexts —
+    the hoisted/unhoisted parity gate run_perf_smoke.sh checks."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(np.asarray(out.c0).tobytes())
+    h.update(np.asarray(out.c1).tobytes())
+    return h.hexdigest()
 
 
 def main():
@@ -147,8 +172,69 @@ def main():
         bsgs.plan.num_keyswitches, compile_s, lats,
         float(np.max(np.abs(got - want(x1)))),
         bool(np.argmax(got) == np.argmax(want(x1))),
+        ntts=bsgs.hoisted_ntts,
     )
     rows.append(single)
+
+    # Hoisted vs unhoisted (ISSUE 18): the SAME plan run with the baby
+    # sweep's shared decomposition vs re-run per step — identical
+    # uncentered digits, so the outputs must be BITWISE equal (the parity
+    # shas the perf smoke gates) while the hoisted run pays L*d forward
+    # NTTs once instead of per baby step (the gated forward-NTT and QPS
+    # deltas). The pair uses a baby-HEAVY split: hoisting makes baby
+    # rotations NTT-free, so the hoisting-optimal plan shifts rotations
+    # out of the giant sweep — the default min-keyswitch split would
+    # leave most of the work on the (mode-independent) giant path and
+    # understate the win.
+    hoist_baby = 16 if SMOKE else 64
+    hoist_gks = hei.gen_rotation_keys_for_steps(
+        ctx, sk, jax.random.key(3),
+        hei.bsgs_plan(slots, d, K, hoist_baby).rotation_steps_needed,
+    )
+    hoisted = hei.BsgsLinearScorer(ctx, W, b, hoist_gks, baby=hoist_baby)
+    compile_s, lats, out_h = _measure(
+        lambda: hoisted.score(ct1), lambda o: (o.c0, o.c1), REPS
+    )
+    got_h = hei.decrypt_class_scores(ctx, sk, out_h, K)
+    hoisted_row = _row(
+        f"bsgs_hoisted N={n_lin} d={d} K={K} b={hoist_baby}",
+        "bsgs_hoisted", 1, hoisted.plan.num_keyswitches, compile_s, lats,
+        float(np.max(np.abs(got_h - want(x1)))),
+        bool(np.argmax(got_h) == np.argmax(want(x1))),
+        ntts=hoisted.hoisted_ntts,
+    )
+    rows.append(hoisted_row)
+    unhoisted = hei.BsgsLinearScorer(
+        ctx, W, b, hoist_gks, baby=hoist_baby, rotation_mode="unhoisted"
+    )
+    compile_s, lats, out_u = _measure(
+        lambda: unhoisted.score(ct1), lambda o: (o.c0, o.c1), REPS
+    )
+    got_u = hei.decrypt_class_scores(ctx, sk, out_u, K)
+    unhoisted_row = _row(
+        f"bsgs_unhoisted N={n_lin} d={d} K={K} b={hoist_baby}",
+        "bsgs_unhoisted", 1, unhoisted.plan.num_keyswitches, compile_s,
+        lats,
+        float(np.max(np.abs(got_u - want(x1)))),
+        bool(np.argmax(got_u) == np.argmax(want(x1))),
+        ntts=unhoisted.unhoisted_ntts,
+    )
+    rows.append(unhoisted_row)
+    hoisted_cmp = {
+        "plan": "bsgs",
+        "baby": hoist_baby,
+        "hoisted_qps": hoisted_row["qps"],
+        "unhoisted_qps": unhoisted_row["qps"],
+        "speedup": round(hoisted_row["qps"] / unhoisted_row["qps"], 3),
+        "hoisted_ntts_per_score": hoisted.hoisted_ntts,
+        "unhoisted_ntts_per_score": unhoisted.unhoisted_ntts,
+        "parity_sha_hoisted": _parity_sha(out_h),
+        "parity_sha_unhoisted": _parity_sha(out_u),
+    }
+    hoisted_cmp["parity"] = (
+        hoisted_cmp["parity_sha_hoisted"]
+        == hoisted_cmp["parity_sha_unhoisted"]
+    )
 
     # Batched serving: queries packed q-per-ciphertext into slot blocks
     # (ISSUE 13 — the device program is unchanged, the diagonals tile) AND
@@ -228,12 +314,66 @@ def main():
         lambda: mlp.score_many(ctms), lambda o: (o.c0, o.c1), REPS
     )
     got = hei.decrypt_score_matrix(mlp.sub_ctx, sk_dec, out)
-    rows.append(_row(
+    ladder_mlp_row = _row(
         f"mlp N={n_mlp} d={d2} H={H} K={K} B={B_mlp}", "mlp", B_mlp,
         mlp_ks, compile_s, lats,
         float(np.max(np.abs(got - mlp_want(xms)))),
         bool(np.all(np.argmax(got, -1) == np.argmax(mlp_want(xms), -1))),
-    ))
+    )
+    rows.append(ladder_mlp_row)
+
+    # Composed MLP BSGS (ISSUE 18): both linear layers as diagonal plans
+    # on the hoisted path, ONE squaring, same depth budget. The unhoisted
+    # twin runs once for the bitwise parity sha; ladder-vs-bsgs is the
+    # serving comparison (different rotation sets, so those two agree only
+    # after decryption).
+    plan1, plan2 = hei.bsgs_mlp_plans(
+        encoding.num_slots(ctx2.ntt), d2, H, K
+    )
+    mgks1 = hei.gen_rotation_keys_for_steps(
+        ctx2, sk2, jax.random.key(13), plan1.rotation_steps_needed
+    )
+    msub = hei.mlp_sub_context(ctx2, 2)
+    mgks2 = hei.gen_rotation_keys_for_steps(
+        msub, hei.slice_secret_key(sk2, msub.num_primes),
+        jax.random.key(14), plan2.rotation_steps_needed,
+    )
+    mlp_bsgs = hei.BsgsMlpScorer(
+        ctx2, w1, b1, w2, b2, mgks1, rlk2, mgks2
+    )
+    compile_s, lats, out_mb = _measure(
+        lambda: mlp_bsgs.score(ctm), lambda o: (o.c0, o.c1), REPS
+    )
+    got = hei.decrypt_class_scores(mlp_bsgs.sub_ctx, sk_dec, out_mb, K)
+    mlp_bsgs_row = _row(
+        f"mlp_bsgs N={n_mlp} d={d2} H={H} K={K}", "mlp_bsgs", 1,
+        mlp_bsgs.num_keyswitches, compile_s, lats,
+        float(np.max(np.abs(got - mlp_want(xm)))),
+        bool(np.argmax(got) == np.argmax(mlp_want(xm))),
+        ntts=mlp_bsgs.hoisted_ntts,
+    )
+    rows.append(mlp_bsgs_row)
+    mlp_bsgs_u = hei.BsgsMlpScorer(
+        ctx2, w1, b1, w2, b2, mgks1, rlk2, mgks2,
+        rotation_mode="unhoisted",
+    )
+    out_mbu = mlp_bsgs_u.score(ctm)
+    jax.block_until_ready((out_mbu.c0, out_mbu.c1))
+    mlp_compare = {
+        "plan": "mlp_bsgs",
+        "ladder_qps": ladder_mlp_row["qps"] / ladder_mlp_row["batch"],
+        "mlp_bsgs_qps": mlp_bsgs_row["qps"],
+        "ladder_keyswitches_per_score": mlp_ks,
+        "mlp_bsgs_keyswitches_per_score": mlp_bsgs.num_keyswitches,
+        "hoisted_ntts_per_score": mlp_bsgs.hoisted_ntts,
+        "unhoisted_ntts_per_score": mlp_bsgs.unhoisted_ntts,
+        "parity_sha_hoisted": _parity_sha(out_mb),
+        "parity_sha_unhoisted": _parity_sha(out_mbu),
+    }
+    mlp_compare["parity"] = (
+        mlp_compare["parity_sha_hoisted"]
+        == mlp_compare["parity_sha_unhoisted"]
+    )
 
     print(f"# Private-inference serving bench ({backend.device_kind}, reps={REPS})")
     print()
@@ -252,6 +392,18 @@ def main():
         f"batched-vs-single ({batched_vs_single['plan']}, "
         f"B={batched_vs_single['batch']}): "
         f"{batched_vs_single['speedup']}x QPS"
+    )
+    print(
+        f"hoisted-vs-unhoisted (bsgs): {hoisted_cmp['speedup']}x QPS, "
+        f"{hoisted_cmp['hoisted_ntts_per_score']} vs "
+        f"{hoisted_cmp['unhoisted_ntts_per_score']} forward NTTs/score, "
+        f"parity={'OK' if hoisted_cmp['parity'] else 'BROKEN'}"
+    )
+    print(
+        f"mlp ladder-vs-bsgs: {mlp_compare['ladder_keyswitches_per_score']}"
+        f" vs {mlp_compare['mlp_bsgs_keyswitches_per_score']} "
+        f"keyswitches/score, "
+        f"parity={'OK' if mlp_compare['parity'] else 'BROKEN'}"
     )
     print()
     # The analysis evidence row (ISSUE 12/13): violations is the same
@@ -276,6 +428,8 @@ def main():
         "reps": REPS,
         "rows": rows,
         "batched_vs_single": batched_vs_single,
+        "hoisted": hoisted_cmp,
+        "mlp_compare": mlp_compare,
         "analysis_check": {
             "violations": check_row["violations"],
             "certified": certified,

@@ -281,7 +281,10 @@ def check_inference_coverage() -> list[LintFinding]:
     region is pure Montgomery pointwise math (or one fused Pallas custom
     call) with no gather/dot leaf, so the leaf rule alone cannot see it —
     the presence check is what guarantees trace attribution sees the
-    kernel as a first-class phase."""
+    kernel as a first-class phase. The hoisted programs (the BSGS baby
+    sweep and the composed MLP, ISSUE 18) must additionally retain
+    `hefl.serve_hoist` — the shared-decomposition region is equally
+    leaf-less."""
     import numpy as np
 
     import jax
@@ -323,14 +326,60 @@ def check_inference_coverage() -> list[LintFinding]:
         bsgs._giant_tables,
     )
 
+    # Composed two-layer MLP BSGS program (ISSUE 18): both diagonal
+    # sweeps on the hoisted path plus the square/relin/rescale bridge,
+    # compiled once at a tiny geometry. Its hidden-layer sweep must keep
+    # the `hefl.serve_hoist` scope — the shared decomposition has no
+    # gather/dot leaf either, so only the presence check can see it.
+    from hefl_tpu.ckks.keys import gen_relin_key
+
+    # The square needs its own deeper modulus chain (5 primes, like the
+    # MLP serving tests) so ct_mul has headroom; n stays tiny.
+    mctx = CkksContext.create(n=256, num_primes=5)
+    msk, mpk = keygen(mctx, jax.random.key(7))
+    rlk = gen_relin_key(mctx, msk, jax.random.key(4))
+    d_mlp, hidden, num_k_mlp = 16, 4, 2
+    w1 = rng.normal(0, 0.3, (hidden, d_mlp))
+    w2 = rng.normal(0, 0.3, (num_k_mlp, hidden))
+    plan1, plan2 = hei.bsgs_mlp_plans(
+        encoding.num_slots(mctx.ntt), d_mlp, hidden, num_k_mlp
+    )
+    gks1 = hei.gen_rotation_keys_for_steps(
+        mctx, msk, jax.random.key(5), plan1.rotation_steps_needed
+    )
+    sub_ctx = hei.mlp_sub_context(mctx, 2)
+    gks2 = hei.gen_rotation_keys_for_steps(
+        sub_ctx, hei.slice_secret_key(msk, sub_ctx.num_primes),
+        jax.random.key(6), plan2.rotation_steps_needed,
+    )
+    mlp = hei.BsgsMlpScorer(
+        mctx, w1, rng.normal(0, 0.2, (hidden,)), w2,
+        rng.normal(0, 0.2, (num_k_mlp,)), gks1, rlk, gks2,
+    )
+    mlp_fn = hei._mlp_bsgs_program(
+        mctx, mlp.plan1, mlp.plan2, mlp.pt_scale, mlp._rescales, "hoisted"
+    )
+    ct_mx = hei.encrypt_features(
+        mctx, mpk, rng.normal(0, 0.5, (d_mlp,)), jax.random.key(8)
+    )
+    mlp_args = (
+        ct_mx, rlk, mlp._u1, mlp._b1_res, mlp._baby1, mlp._giant1,
+        mlp._u2, mlp._b2_res, mlp._baby2, mlp._giant2,
+    )
+
+    base_scopes = (obs_scopes.SERVE_KEYSWITCH, obs_scopes.SERVE_ROTATE,
+                   obs_scopes.SERVE_SCORE)
+    hoist_scopes = base_scopes + (obs_scopes.SERVE_HOIST,)
+
     # Both layers per program, each compiled ONCE: the leaf rule and the
     # scope-presence gate (serve_keyswitch is pure Montgomery pointwise
     # math / one fused custom call — no gather/dot leaf, so only the
     # presence check can see it) share one HLO text.
     findings: list[LintFinding] = []
-    for name, f, args in (
-        ("he_inference.serve[linear]", fn, ladder_args),
-        ("he_inference.serve[bsgs]", bsgs_fn, bsgs_args),
+    for name, f, args, scopes in (
+        ("he_inference.serve[linear]", fn, ladder_args, base_scopes),
+        ("he_inference.serve[bsgs]", bsgs_fn, bsgs_args, hoist_scopes),
+        ("he_inference.serve[mlp_bsgs]", mlp_fn, mlp_args, hoist_scopes),
     ):
         findings.extend(jaxpr_scope_findings(
             jax.make_jaxpr(f)(*args), name,
@@ -341,8 +390,7 @@ def check_inference_coverage() -> list[LintFinding]:
         findings.extend(leaf_scope_findings(
             txt, name, leaf_opcodes=INFERENCE_LEAF_OPCODES
         ))
-        for scope in (obs_scopes.SERVE_KEYSWITCH, obs_scopes.SERVE_ROTATE,
-                      obs_scopes.SERVE_SCORE):
+        for scope in scopes:
             if scope not in txt:
                 findings.append(LintFinding(
                     rule="missing-scope", where=name,

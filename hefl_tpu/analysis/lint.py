@@ -143,6 +143,37 @@ ALLOWLIST: tuple[Allow, ...] = (
         ),
     ),
     Allow(
+        region="ckks.ops.hoisted_gadget",
+        rule="forbidden-primitive",
+        primitive="rem",
+        reason=(
+            "hoisted_gadget_probe (ISSUE 18) mirrors the hoisted baby "
+            "sweep — uncentered digit extraction, digit x pre-permuted "
+            "key accumulation, the eval-domain output gather — with `%` "
+            "standing in for the Montgomery REDC canonical-residue "
+            "contract; a probe traced for range analysis (certifying the "
+            "2**w <= min(p) digit-width geometry), never executed on a "
+            "device. The REAL sweep (hoisted_rotations + Pallas kernel) "
+            "stays division-free and is bitwise parity-tested against "
+            "the per-step reference"
+        ),
+    ),
+    Allow(
+        region="he_inference.mlp_compose",
+        rule="forbidden-primitive",
+        primitive="rem",
+        reason=(
+            "mlp_bsgs_range_probe (ISSUE 18) mirrors the composed "
+            "two-layer serving circuit — hoisted sweep, square, relin "
+            "key-switch, rescale, second hoisted sweep — with `%` "
+            "standing in for the Montgomery REDC contract; traced for "
+            "range analysis only. The REAL composed program "
+            "(_mlp_bsgs_program) stays division-free, is hot-path linted "
+            "separately, and its hoisted/unhoisted twins are bitwise "
+            "parity-tested"
+        ),
+    ),
+    Allow(
         region="*",
         rule="forbidden-primitive",
         primitive="rem",

@@ -1350,6 +1350,16 @@ def certify_inference(
     (intentional wraparound, covered by the lint rules + bitwise parity
     tests); the probe mirrors their canonical-residue CONTRACT, exactly
     like the packing probes mirror `psum_mod`.
+
+    ISSUE 18 extends the same certificate over the other two serving
+    programs: `ckks.ops.hoisted_gadget_probe` (the shared UNCENTERED
+    decomposition — its digits must be canonical as extracted, i.e.
+    2**digit_bits must sit inside the prime — plus the per-step digit x
+    key products and eval permutation, at any abstract step count) and
+    `he_inference.mlp_bsgs_range_probe` (the composed two-layer BSGS
+    circuit: hoisted sweep → square → relinearize → rescale → hoisted
+    sweep). A geometry is CERTIFIED only when all three programs hold;
+    rejections cite the producing op.
     """
     import jax
 
@@ -1409,6 +1419,86 @@ def certify_inference(
             f"gadget digit x key products inside the 2**62 wall "
             f"(w={digit_bits}, d={num_digits})"
         )
+
+    # ISSUE 18: the hoisted-rotation sweep and the composed two-layer MLP
+    # program ride the SAME certificate — serving dispatches through them,
+    # so an uncertified geometry must refuse all three programs at once.
+    def probe_checks(name: str, closed2, in_ivs2, out_specs) -> None:
+        res2 = eval_jaxpr_ranges(
+            closed2, in_ivs2, ceiling=Interval(-wall, wall)
+        )
+        findings.extend(res2.findings)
+        if not any(rep.op == "while" for rep in res2.loops):
+            findings.append(RangeFinding(  # pragma: no cover - tripwire
+                kind="output-bound", op="while", eqn_index=-1,
+                interval=res2.out_intervals[0], bound=canonical,
+                message=f"{name} probe traced without a while loop — the "
+                        "inductive machinery was not exercised",
+            ))
+        for idx, what, bound in out_specs:
+            iv = res2.out_intervals[idx]
+            if iv.lo < bound.lo or iv.hi > bound.hi:
+                outvar = closed2.jaxpr.outvars[idx]
+                op = "input"
+                for eqn in closed2.jaxpr.eqns:
+                    if outvar in eqn.outvars:
+                        op = eqn.primitive.name
+                findings.append(RangeFinding(
+                    kind="output-bound", op=op, eqn_index=-1,
+                    interval=iv, bound=bound,
+                    message=f"{name}: {what}: `{op}` yields {iv}, "
+                            f"outside {bound}",
+                ))
+            else:
+                checks.append(f"{name}: {what} in {iv} ⊆ {bound}")
+
+    from hefl_tpu.ckks import ops as ckks_ops
+
+    hprobe, hargs = ckks_ops.hoisted_gadget_probe(
+        prime, digit_bits, num_digits
+    )
+    with jax.experimental.enable_x64():
+        hclosed = jax.make_jaxpr(hprobe)(*hargs)
+    # The hoisted path skips centering, so its digits must be canonical AS
+    # EXTRACTED: the 2**w gadget bound has to sit inside [0, p-1].
+    digit_bound = Interval(0, min((1 << int(digit_bits)) - 1, prime - 1))
+    probe_checks(
+        "hoisted sweep", hclosed,
+        [
+            Interval(0, LOOP_COUNT_CEILING),   # abstract step count
+            canonical, canonical,              # shared (c0, c1) residues
+            canonical, canonical,              # pre-permuted key tensors
+            Interval(0, LOOP_COUNT_CEILING),   # eval permutation indices
+        ],
+        [
+            (0, "uncentered gadget digits (shared across every step)",
+             digit_bound),
+            (1, "hoisted c0 outputs (any step count)", canonical),
+            (2, "hoisted c1 outputs (any step count)", canonical),
+        ],
+    )
+
+    mprobe, margs = he_inference.mlp_bsgs_range_probe(
+        prime, digit_bits, num_digits
+    )
+    with jax.experimental.enable_x64():
+        mclosed = jax.make_jaxpr(mprobe)(*margs)
+    probe_checks(
+        "mlp compose", mclosed,
+        [
+            Interval(0, LOOP_COUNT_CEILING),   # layer-1 step count
+            Interval(0, LOOP_COUNT_CEILING),   # layer-2 step count
+            canonical, canonical,              # input ciphertext residues
+            canonical, canonical,              # key tensors
+            Interval(0, LOOP_COUNT_CEILING),   # permutation indices
+            canonical,                         # rescale p_last^{-1} mod p
+        ],
+        [
+            (0, "composed c0 residues (sweep→square→relin→rescale→sweep)",
+             canonical),
+            (1, "composed c1 residues (full two-layer circuit)", canonical),
+        ],
+    )
 
     return InferenceCertificate(
         ok=not findings,

@@ -49,13 +49,15 @@ def _probe_shapes(ctx) -> tuple:
 
 
 def _autoselect(ctx) -> str:
-    """Micro-time one fused encrypt AND one fused key-switch per backend
-    on the live TPU; persist the combined winner.
+    """Micro-time one fused encrypt, one fused key-switch AND one hoisted
+    product sweep per backend on the live TPU; persist the combined winner.
 
     The key-switch probe (ISSUE 13) runs at the gadget geometry the
     serving path and relinearization actually dispatch ([L*d+1, L, N] key
-    tensors); the persisted record keeps both components so the bench
-    artifacts can show WHY a backend won, not just which.
+    tensors); the hoisted probe (ISSUE 18) at the BSGS baby sweep's
+    [S, L*d, L, N] pre-permuted key geometry. The persisted record keeps
+    every component ({name}_encrypt / {name}_keyswitch / {name}_hoisted)
+    so the bench artifacts can show WHY a backend won, not just which.
     """
     global _AUTO_TIMINGS_MS, _AUTO_PERSISTED
     kind = str(getattr(jax.devices()[0], "device_kind", "unknown"))
@@ -108,18 +110,41 @@ def _autoselect(ctx) -> str:
                 digit_bits=ctx.ksk_digit_bits,
                 num_digits=ctx.ksk_num_digits)[0]),
         }
+        # Hoisted-rotation probe (ISSUE 18): the batched digit x key
+        # product sweep the BSGS serving path dispatches per query — a
+        # small step count suffices, the kernel's per-step work is what
+        # differs between backends.
+        num_r = num_l * ctx.ksk_num_digits
+        num_s = 4
+        h_d = mk(num_r, num_l, n)
+        h_b = mk(num_s, num_r, num_l, n)
+        h_a = mk(num_s, num_r, num_l, n)
+        hoist_cands = {
+            "xla": jax.jit(lambda cc: ops._hoisted_products_xla(
+                ctx, cc, h_d, h_b, h_a)[0]),
+            "pallas": jax.jit(lambda cc: pallas_ntt.hoisted_rotations_pallas(
+                ctx.ntt, cc, h_d, h_b, h_a)[0]),
+        }
+        single = mk(num_l, n)
         timings = {name: steady_seconds(fn, m) for name, fn in cands.items()}
         ks_timings = {
             name: steady_seconds(fn, coeff) for name, fn in ks_cands.items()
         }
+        hoist_timings = {
+            name: steady_seconds(fn, single)
+            for name, fn in hoist_cands.items()
+        }
     _AUTO_TIMINGS_MS = {}
     for name in HE_BACKENDS:
         _AUTO_TIMINGS_MS[name] = round(
-            (timings[name] + ks_timings[name]) * 1e3, 3
+            (timings[name] + ks_timings[name] + hoist_timings[name]) * 1e3, 3
         )
         _AUTO_TIMINGS_MS[f"{name}_encrypt"] = round(timings[name] * 1e3, 3)
         _AUTO_TIMINGS_MS[f"{name}_keyswitch"] = round(
             ks_timings[name] * 1e3, 3
+        )
+        _AUTO_TIMINGS_MS[f"{name}_hoisted"] = round(
+            hoist_timings[name] * 1e3, 3
         )
     winner = min(HE_BACKENDS, key=lambda name: _AUTO_TIMINGS_MS[name])
     _AUTO_CHOICE[kind] = winner

@@ -57,3 +57,65 @@ def apply_automorphism(
     """Signed coefficient permutation on canonical residues [..., L, N]."""
     gathered = jnp.take(residues, jnp.asarray(src), axis=-1)
     return jnp.where(jnp.asarray(flip), neg_mod(gathered, p), gathered)
+
+
+# ---------------------------------------------------------------------------
+# Eval-domain automorphism tables (ISSUE 18): the NTT-domain action of
+# X -> X^g. Evaluation points are fixed by the NTT ordering; phi_g(a)
+# evaluated at zeta is a(zeta^g), and zeta^g is again an evaluation point,
+# so the whole automorphism is a PURE permutation of the eval vector — no
+# sign flips (those live in the coefficient picture only). This is what
+# lets `ops.hoisted_rotations` share one gadget decomposition (+ its C
+# forward NTTs) across a whole baby-step sweep: per step the already-NTT'd
+# digits just get permuted.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=16)
+def _eval_point_index(ntt) -> tuple[np.ndarray, int, dict]:
+    """The NTT's evaluation points under its FIRST prime, as a value->index
+    map. Derived numerically (convention-proof): the monomial X transforms
+    to the vector of evaluation points themselves — r[j] = NTT(X)[j] =
+    zeta_j — whatever stage ordering / bit-reversal the transform uses.
+    The N points are distinct odd powers of a primitive 2N-th root, so the
+    map is a bijection. The point ORDERING is determined by the butterfly
+    network alone (identical across primes), so one prime suffices for
+    every permutation table."""
+    from hefl_tpu.ckks.ntt import ntt_forward
+
+    one_hot = np.zeros((1, ntt.n), np.uint32)
+    one_hot[0, 1] = 1
+    sub = ntt.slice_limbs(0, 1)
+    r = np.asarray(ntt_forward(sub, jnp.asarray(one_hot)))[0].astype(np.int64)
+    p0 = int(np.asarray(sub.p)[0, 0])
+    index = {int(v): j for j, v in enumerate(r)}
+    if len(index) != ntt.n:
+        raise AssertionError(
+            "evaluation points are not distinct — the NTT tables are broken"
+        )
+    return r, p0, index
+
+
+@functools.lru_cache(maxsize=128)
+def eval_permutation(ntt, g: int) -> tuple[np.ndarray, np.ndarray]:
+    """-> (perm int32[N], inv_perm int32[N]) with, for canonical residues
+    a [..., L, N]:
+
+        ntt_forward(ntt, apply_automorphism(a, p, *automorphism_tables(n, g)))
+            == take(ntt_forward(ntt, a), perm, axis=-1)
+
+    bitwise (pinned by tests/test_hoisted.py). perm[j] is the index of
+    zeta_j^g among the evaluation points: NTT(phi_g(a))[j] = a(zeta_j^g).
+    `inv_perm` is the inverse permutation (perm[inv_perm[i]] == i) — it
+    pre-permutes STATIC key tensors so a hoisted inner product needs no
+    per-step gather of the digit tensors:
+    sum_c perm(D_c)*B_c == perm(sum_c D_c * inv_perm(B_c))."""
+    if g % 2 == 0 or not (0 < g < 2 * ntt.n):
+        raise ValueError(f"galois element must be odd in (0, 2N); got {g}")
+    r, p0, index = _eval_point_index(ntt)
+    perm = np.empty(ntt.n, np.int32)
+    for j in range(ntt.n):
+        perm[j] = index[pow(int(r[j]), g, p0)]
+    inv_perm = np.empty(ntt.n, np.int32)
+    inv_perm[perm] = np.arange(ntt.n, dtype=np.int32)
+    return perm, inv_perm

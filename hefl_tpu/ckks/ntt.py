@@ -186,6 +186,24 @@ def shoup_tables(ctx: NTTContext) -> ShoupTables:
     )
 
 
+# Trace-time transform counters (ISSUE 18): every ntt_forward/ntt_inverse
+# CALL bumps these by the number of [L, N] polynomial transforms its input
+# carries (batch x component axes; shapes are static, so the count is too).
+# Inside jit the bump happens at TRACE time — a `lax.scan` body counts ONCE
+# however many stages it runs — which is exactly the per-stage/shared-prefix
+# cost model the hoisting tests assert against (tests/test_hoisted.py).
+_TRACE_TRANSFORMS = {"forward": 0, "inverse": 0}
+
+
+def transform_trace_counts() -> dict:
+    """Snapshot of the trace-time transform counters (copies, not a view)."""
+    return dict(_TRACE_TRANSFORMS)
+
+
+def _count_transforms(kind: str, a: jnp.ndarray) -> None:
+    _TRACE_TRANSFORMS[kind] += int(np.prod(a.shape[:-2], dtype=np.int64))
+
+
 def ntt_forward(ctx: NTTContext, a: jnp.ndarray) -> jnp.ndarray:
     """Coefficient domain -> evaluation (bit-reversed NTT) domain.
 
@@ -193,6 +211,7 @@ def ntt_forward(ctx: NTTContext, a: jnp.ndarray) -> jnp.ndarray:
     stages; stage s has m=2**s blocks of half-width t=N/2m, twiddle slice
     psi_rev[:, m:2m].
     """
+    _count_transforms("forward", a)
     if _use_pallas(ctx):
         from hefl_tpu.ckks import pallas_ntt
 
@@ -220,6 +239,7 @@ def ntt_forward(ctx: NTTContext, a: jnp.ndarray) -> jnp.ndarray:
 def ntt_inverse(ctx: NTTContext, a: jnp.ndarray) -> jnp.ndarray:
     """Evaluation (bit-reversed) domain -> coefficient domain, including the
     final N^{-1} scaling (folded in as one extra Montgomery multiply)."""
+    _count_transforms("inverse", a)
     if _use_pallas(ctx):
         from hefl_tpu.ckks import pallas_ntt
 

@@ -386,6 +386,232 @@ def ct_conjugate(ctx: CkksContext, a: Ciphertext, gk: GaloisKey) -> Ciphertext:
     return ct_apply_galois(ctx, a, gk)
 
 
+# ---------------------------------------------------------------------------
+# Hoisted rotations (ISSUE 18, Halevi-Shoup): decompose c1 ONCE, serve every
+# baby-step rotation from the shared eval-domain digit tensors.
+#
+# The per-step gadget decomposition is the rotation hot path: base-2**w
+# digit split + L*d forward NTTs, per rotation. But digit extraction acts on
+# coefficients, so it does NOT commute with the SIGNED coefficient
+# permutation phi_g — digits of phi_g(c1) are not a permutation of the
+# digits of c1, and the centered-digit + correction-row decomposition
+# `ct_rotate` uses (whose correction encrypts K*J*phi_g(s), J = all-ones)
+# would need a correction digit R_g = phi_g(J)/J whose coefficients are
+# full-range mod q, destroying the noise budget. The hoisted path therefore
+# uses the UNCENTERED gadget identity sum_c digit_c(x)*g_c = x (exact, no
+# correction row; digits in [0, 2**w) instead of centered — at most one bit
+# more noise per component), which DOES hoist: phi_g is a ring automorphism
+# fixing the integer gadget constants, so
+#
+#     sum_c phi_g(digit_c(c1)) * g_c = phi_g(c1),
+#
+# and in the eval domain phi_g is the pure permutation
+# `galois.eval_permutation` — shared digits, one permutation per step.
+# Pre-permuting the static KEY tensors with the inverse permutation moves
+# even that gather out of the per-step inner product:
+# sum_c perm(D_c)*B_c == perm(sum_c D_c * inv_perm(B_c)), so a step costs
+# 2*(L*d) Montgomery multiplies + one output gather. Bitwise parity anchor:
+# `hoisted_rotations_reference` runs the SAME decomposition step-by-step
+# through the coefficient-domain automorphism + per-step NTTs (the XLA
+# reference) — exact modular arithmetic makes the two bitwise-equal. The
+# legacy `ct_rotate` loop (centered digits + correction row) computes the
+# same rotation with a different decomposition, hence equal decrypted
+# values but different noise bits — compared to tolerance, never bitwise.
+# ---------------------------------------------------------------------------
+
+
+def hoisted_digits(ctx: CkksContext, c1_coeff: jax.Array) -> jax.Array:
+    """The shared decomposition: COEFFICIENT-domain c1 [..., L, N] ->
+    uncentered eval-domain gadget digits uint32[..., L*d, L, N] (plain
+    domain, canonical). This is the hoisted prefix — L*d forward NTTs paid
+    ONCE for any number of rotation steps."""
+    ntt = ctx.ntt
+    w = ctx.ksk_digit_bits
+    d = ctx.ksk_num_digits
+    if (1 << w) > int(np.asarray(ntt.p)[:, 0].min()):
+        raise ValueError(
+            f"ksk_digit_bits={w} digits overflow the smallest prime; the "
+            "uncentered hoisted decomposition needs 2**w <= min(p)"
+        )
+    mask = jnp.uint32((1 << w) - 1)
+    num_l = c1_coeff.shape[-2]
+    n = c1_coeff.shape[-1]
+    digits = jnp.stack(
+        [(c1_coeff >> jnp.uint32(w * k)) & mask for k in range(d)], axis=-2
+    )                                                             # [..., L, d, N]
+    comp = digits.reshape(*c1_coeff.shape[:-2], num_l * d, n)
+    lifted = jnp.broadcast_to(
+        comp[..., :, None, :], (*c1_coeff.shape[:-2], num_l * d, num_l, n)
+    )
+    return ntt_forward(ntt, lifted)
+
+
+def hoisted_rotation_tables(ctx: CkksContext, gks: dict, steps):
+    """Hoisted-plan tables for a rotation step sequence -> (perm i32[S, N],
+    b_mont u32[S, L*d, L, N], a_mont u32[S, L*d, L, N]).
+
+    Per step: the eval-domain automorphism permutation, and the Galois key
+    rows PRE-GATHERED through the inverse permutation (static host work) —
+    the correction row is dropped (the uncentered gadget identity is exact
+    without it). Built once per scorer; validation (key presence, galois
+    element match) all happens here, like `stack_rotation_steps`."""
+    from hefl_tpu.ckks import galois
+
+    steps = [int(s) for s in steps]
+    num_r = ctx.num_primes * ctx.ksk_num_digits
+    if not steps:
+        zk = jnp.zeros((0, num_r, ctx.num_primes, ctx.n), jnp.uint32)
+        return jnp.zeros((0, ctx.n), jnp.int32), zk, zk
+    missing = [s for s in steps if s not in gks]
+    if missing:
+        raise ValueError(f"rotation keys missing for steps {missing}")
+    perms, bks, aks = [], [], []
+    for s in steps:
+        want = galois.galois_elt_rotation(ctx.n, s)
+        if gks[s].g != want:
+            raise ValueError(
+                f"galois key for step {s} has g={gks[s].g}, rotation needs "
+                f"g={want}"
+            )
+        perm, inv_perm = galois.eval_permutation(ctx.ntt, want)
+        perms.append(perm)
+        inv = jnp.asarray(inv_perm)
+        bks.append(jnp.take(gks[s].b_mont[:num_r], inv, axis=-1))
+        aks.append(jnp.take(gks[s].a_mont[:num_r], inv, axis=-1))
+    return jnp.asarray(np.stack(perms)), jnp.stack(bks), jnp.stack(aks)
+
+
+def _hoisted_products_xla(
+    ctx: CkksContext, c0: jax.Array, d_eval: jax.Array,
+    b_mont: jax.Array, a_mont: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Per-step inner products against the shared digits (XLA graph path —
+    the bit-exact semantics reference of the fused Pallas kernel):
+    acc0[s] = c0 + sum_c D_c * B'[s, c], acc1[s] = sum_c D_c * A'[s, c].
+    Outputs still await the per-step output permutation."""
+    ntt = ctx.ntt
+    p = jnp.asarray(ntt.p)
+    pinv = jnp.asarray(ntt.pinv_neg)
+    num_s, num_r = b_mont.shape[0], b_mont.shape[1]
+    batch_ndim = c0.ndim - 2
+    kshape = (num_s,) + (1,) * batch_ndim + b_mont.shape[1:]
+    kb = b_mont.reshape(kshape)
+    ka = a_mont.reshape(kshape)
+    acc0 = modular.mont_mul(d_eval[..., 0, :, :], kb[..., 0, :, :], p, pinv)
+    acc1 = modular.mont_mul(d_eval[..., 0, :, :], ka[..., 0, :, :], p, pinv)
+    for c in range(1, num_r):                                     # modular tree-sum
+        acc0 = modular.add_mod(
+            acc0, modular.mont_mul(d_eval[..., c, :, :], kb[..., c, :, :], p, pinv), p
+        )
+        acc1 = modular.add_mod(
+            acc1, modular.mont_mul(d_eval[..., c, :, :], ka[..., c, :, :], p, pinv), p
+        )
+    return modular.add_mod(acc0, c0[None], p), acc1
+
+
+def hoisted_rotations_core(
+    ctx: CkksContext, c0: jax.Array, d_eval: jax.Array,
+    perms: jax.Array, b_mont: jax.Array, a_mont: jax.Array,
+    backend: str | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """All planned rotations from the shared digit tensors -> stacked
+    (r0, r1) uint32[S, ..., L, N], eval domain.
+
+    Backend-dispatched like `_keyswitch_coeff` (`HEFL_HE` env / autoselect;
+    untileable rings always XLA): on the Pallas backend the whole per-step
+    digit x key accumulation runs as `pallas_ntt.hoisted_rotations_pallas`
+    (one fused dispatch for every step), bitwise-equal to the XLA graph.
+    The final eval-domain output permutation is a static gather either way.
+    """
+    from hefl_tpu.ckks.backend import resolve_he_backend
+
+    if resolve_he_backend(ctx, backend) == "pallas":
+        from hefl_tpu.ckks import pallas_ntt
+
+        if pallas_ntt.supported(ctx.ntt):
+            acc0, acc1 = pallas_ntt.hoisted_rotations_pallas(
+                ctx.ntt, c0, d_eval, b_mont, a_mont
+            )
+        else:
+            acc0, acc1 = _hoisted_products_xla(ctx, c0, d_eval, b_mont, a_mont)
+    else:
+        acc0, acc1 = _hoisted_products_xla(ctx, c0, d_eval, b_mont, a_mont)
+    batch_ndim = c0.ndim - 2
+    idx = perms.reshape((perms.shape[0],) + (1,) * (batch_ndim + 1) + (perms.shape[-1],))
+    return (
+        jnp.take_along_axis(acc0, idx, axis=-1),
+        jnp.take_along_axis(acc1, idx, axis=-1),
+    )
+
+
+def hoisted_rotations(
+    ctx: CkksContext, ct: Ciphertext, steps, gks: dict,
+    backend: str | None = None,
+) -> Ciphertext:
+    """Rotate `ct` by every step in `steps` sharing ONE gadget
+    decomposition -> stacked Ciphertext (leading axis S).
+
+    Cost: 1 inverse NTT + L*d forward NTTs TOTAL, then 2*(L*d) Montgomery
+    multiplies + one gather per step — vs (L*d + 1) forward NTTs (plus the
+    inverse pair) PER STEP for a loop of `ct_rotate` calls."""
+    perms, bk, ak = hoisted_rotation_tables(ctx, gks, steps)
+    c1_coeff = ntt_inverse(ctx.ntt, ct.c1)
+    d_eval = hoisted_digits(ctx, c1_coeff)
+    r0, r1 = hoisted_rotations_core(ctx, ct.c0, d_eval, perms, bk, ak, backend)
+    return Ciphertext(c0=r0, c1=r1, scale=ct.scale)
+
+
+def hoisted_rotations_reference(
+    ctx: CkksContext, ct: Ciphertext, steps, gks: dict
+) -> Ciphertext:
+    """The UNHOISTED twin (bitwise parity anchor, XLA only): the same
+    uncentered decomposition applied step-by-step — per step, the
+    coefficient-domain signed automorphism of every digit polynomial, L*d
+    fresh forward NTTs, and the inner product against the ORIGINAL
+    (unpermuted) key rows. Exact modular arithmetic makes this
+    bitwise-equal to `hoisted_rotations`; it is also the honest cost model
+    the hoisted path is benchmarked against (bench_inference)."""
+    from hefl_tpu.ckks import galois
+
+    ntt = ctx.ntt
+    p = jnp.asarray(ntt.p)
+    pinv = jnp.asarray(ntt.pinv_neg)
+    w = ctx.ksk_digit_bits
+    d = ctx.ksk_num_digits
+    mask = jnp.uint32((1 << w) - 1)
+    num_l = ctx.num_primes
+    num_r = num_l * d
+    c0_coeff = ntt_inverse(ntt, ct.c0)
+    c1_coeff = ntt_inverse(ntt, ct.c1)
+    digits = jnp.stack(
+        [(c1_coeff >> jnp.uint32(w * k)) & mask for k in range(d)], axis=-2
+    )
+    comp = digits.reshape(*c1_coeff.shape[:-2], num_r, ctx.n)
+    lifted = jnp.broadcast_to(
+        comp[..., :, None, :], (*c1_coeff.shape[:-2], num_r, num_l, ctx.n)
+    )
+    r0s, r1s = [], []
+    for s in steps:
+        g = galois.galois_elt_rotation(ctx.n, int(s))
+        if gks[int(s)].g != g:
+            raise ValueError(f"galois key for step {s} has g={gks[int(s)].g}")
+        src, flip = galois.automorphism_tables(ctx.n, g)
+        pd = galois.apply_automorphism(lifted, p, src, flip)
+        d_eval = ntt_forward(ntt, pd)
+        bk = gks[int(s)].b_mont[:num_r]
+        ak = gks[int(s)].a_mont[:num_r]
+        t0 = modular.mont_mul(d_eval, bk, p, pinv)
+        t1 = modular.mont_mul(d_eval, ak, p, pinv)
+        k0, k1 = t0[..., 0, :, :], t1[..., 0, :, :]
+        for c in range(1, num_r):
+            k0 = modular.add_mod(k0, t0[..., c, :, :], p)
+            k1 = modular.add_mod(k1, t1[..., c, :, :], p)
+        pc0 = galois.apply_automorphism(c0_coeff, p, src, flip)
+        r0s.append(modular.add_mod(ntt_forward(ntt, pc0), k0, p))
+        r1s.append(k1)
+    return Ciphertext(c0=jnp.stack(r0s), c1=jnp.stack(r1s), scale=ct.scale)
+
+
 def ct_mul(ctx: CkksContext, a: Ciphertext, b: Ciphertext, rlk: RelinKey) -> Ciphertext:
     """Ciphertext x ciphertext multiply with relinearization.
 
@@ -527,10 +753,75 @@ def keyswitch_gadget_probe(prime: int, digit_bits: int, num_digits: int):
     return probe, (z, z, z)
 
 
+def hoisted_gadget_probe(prime: int, digit_bits: int, num_digits: int):
+    """The HOISTED rotation's carrier arithmetic as a traceable mirror
+    (analysis.ranges.certify_inference, ISSUE 18).
+
+    Mirrors what `hoisted_digits` + `hoisted_rotations_core` compute per
+    RNS limb: UNCENTERED base-2**w digit extraction (no centering, no
+    correction row — the exact gadget identity the hoisted path relies
+    on), then, inside a `lax.while_loop` over an ABSTRACT step count, the
+    digit x pre-permuted-key Montgomery inner product, the c0 add, and the
+    eval-domain output permutation (a `take` gather through the step's
+    permutation table — range-preserving by construction, proven rather
+    than assumed). The loop folds each step's outputs into a carried
+    accumulator so the invariant holds for ANY number of hoisted steps.
+    Int64 carrier, `%` as the allowlisted probe modulo, exactly like the
+    ladder and key-switch probes. Trace under
+    `jax.experimental.enable_x64()`. -> (fn, example_args).
+
+    Returning the raw digits lets the certificate check them against BOTH
+    the 2**w gadget bound and the canonical range [0, p-1]: the hoisted
+    path skips centering, so its digits must be canonical AS EXTRACTED —
+    a digit width overflowing the prime is refuted here, statically.
+    """
+    p = int(prime)
+    w = int(digit_bits)
+    mask = (1 << w) - 1
+    m = 4  # coefficients per probe limb; ranges are per-element anyway
+
+    def probe(num_steps, c0, c1, key_b, key_a, perm):
+        digits = []
+        for k in range(int(num_digits)):
+            digits.append((c1 >> (w * k)) & mask)   # [0, 2**w - 1], canonical
+        digit_stack = jnp.stack(digits)
+
+        def cond(state):
+            return state[0] > 0
+
+        def body(state):
+            remaining, a0, a1 = state
+            # One hoisted step: inner product of the SHARED digits against
+            # this step's (pre-inverse-permuted) key rows, the c0 add, and
+            # the output permutation.
+            k0 = jnp.zeros_like(c0)
+            k1 = jnp.zeros_like(c1)
+            for k in range(int(num_digits)):
+                k0 = (k0 + digit_stack[k] * key_b) % p
+                k1 = (k1 + digit_stack[k] * key_a) % p
+            r0 = jnp.take((c0 + k0) % p, perm, axis=-1)
+            r1 = jnp.take(k1, perm, axis=-1)
+            return remaining - 1, (a0 + r0) % p, (a1 + r1) % p
+
+        _, a0, a1 = jax.lax.while_loop(
+            cond, body, (num_steps, jnp.zeros_like(c0), jnp.zeros_like(c1))
+        )
+        return digit_stack, a0, a1
+
+    z = np.zeros((m,), np.int64)
+    return probe, (np.int64(0), z, z, z, z, np.zeros((m,), np.int64))
+
+
 def exact_int_probes() -> dict:
     """The key-switch gadget as a declared exact-integer region
     (analysis.lint): digit extraction, centering, and the digit x key
     accumulation are watched by the no-float / no-stray-div rules (the
-    `%` is the allowlisted probe modulo)."""
+    `%` is the allowlisted probe modulo). The hoisted-rotation mirror
+    (uncentered digits, shared across the step loop) is a second declared
+    region under the same rules."""
     fn, args = keyswitch_gadget_probe(2**27 - 39, 5, 6)
-    return {"ckks.ops.keyswitch_gadget": (fn, args)}
+    hfn, hargs = hoisted_gadget_probe(2**27 - 39, 5, 6)
+    return {
+        "ckks.ops.keyswitch_gadget": (fn, args),
+        "ckks.ops.hoisted_gadget": (hfn, hargs),
+    }

@@ -662,3 +662,119 @@ def decrypt_fused_pallas(
         jnp.asarray(tabs.tw_inv), jnp.asarray(tabs.tw_inv_shoup),
     )
     return jnp.moveaxis(out.reshape(num_l, b, ctx.n), 0, 1).reshape(*batch, num_l, ctx.n)
+
+
+def _hoist_products_kernel(
+    p_ref, pinv_ref, c0_ref, d_ref, bk_ref, ak_ref, o0_ref, o1_ref,
+    *, num_r: int,
+):
+    """Hoisted-rotation inner products for one (prime, step, ciphertext)
+    grid cell (ISSUE 18): the shared eval-domain digit tensors against the
+    step's pre-permuted Galois key rows, accumulated with exact modular
+    adds — acc0 = c0 + sum_c D_c * B'_c, acc1 = sum_c D_c * A'_c. No NTT
+    anywhere in this kernel: the decomposition's forward NTTs were paid
+    once outside (that is the whole point of hoisting), and the per-step
+    eval permutation is a static gather the caller applies after.
+
+    Bitwise-exact vs `ops._hoisted_products_xla`: same component order,
+    same Montgomery products, and zero-seeded `add_mod` accumulation is
+    exact on canonical residues, so the fori_loop form cannot change the
+    result.
+    """
+    l = pl.program_id(0)
+    p = p_ref[l, 0]
+    pinv = pinv_ref[l, 0]
+
+    def body(c, carry):
+        a0, a1 = carry
+        dc = d_ref[0, c, 0]
+        t0 = mont_mul(dc, bk_ref[0, c, 0], p, pinv)
+        t1 = mont_mul(dc, ak_ref[0, c, 0], p, pinv)
+        return add_mod(a0, t0, p), add_mod(a1, t1, p)
+
+    zero = jnp.zeros(c0_ref.shape[2:], jnp.uint32)
+    acc0, acc1 = jax.lax.fori_loop(0, num_r, body, (zero, zero))
+    o0_ref[0, 0, 0] = add_mod(acc0, c0_ref[0, 0], p)
+    o1_ref[0, 0, 0] = acc1
+
+
+def hoisted_rotations_pallas(
+    ctx: NTTContext,
+    c0: jnp.ndarray,
+    d_eval: jnp.ndarray,
+    b_mont: jnp.ndarray,
+    a_mont: jnp.ndarray,
+    *,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Every step of a hoisted rotation sweep as ONE fused dispatch
+    (ISSUE 18).
+
+    `c0` is the query's eval-domain c0 uint32[..., L, N]; `d_eval` the
+    SHARED uncentered gadget digits uint32[..., R, L, N] (R = L*d, from
+    `ops.hoisted_digits`); `b_mont`/`a_mont` the pre-permuted key tensors
+    uint32[S, R, L, N] (from `ops.hoisted_rotation_tables` — correction
+    row already dropped, inverse eval permutation already applied).
+    Returns pre-permutation (acc0, acc1) uint32[S, ..., L, N], bitwise vs
+    `ops._hoisted_products_xla`; `ops.hoisted_rotations_core` applies the
+    per-step output permutation (a static XLA gather) either way.
+
+    Grid is (L, S, B) — primes outer so a prime's key/digit blocks stay
+    VMEM-resident across the step x ciphertext sweep; each cell runs the
+    2R Montgomery products + exact modular tree in-register.
+    """
+    _check_supported(ctx)
+    interpret = _resolve_interpret(interpret)
+    tabs = _tables(ctx)
+    n = ctx.n
+    s_rows = n // LANES
+    batch = c0.shape[:-2]
+    num_l = c0.shape[-2]
+    num_s, num_r = b_mont.shape[0], b_mont.shape[1]
+    b = 1
+    for dim in batch:
+        b *= dim
+    if num_s == 0:
+        shape = (0,) + batch + (num_l, n)
+        return jnp.zeros(shape, jnp.uint32), jnp.zeros(shape, jnp.uint32)
+    c0_rows = jnp.moveaxis(
+        c0.reshape(b, num_l, n), 0, 1
+    ).reshape(num_l, b, s_rows, LANES)
+    d_rows = d_eval.reshape(b, num_r, num_l, s_rows, LANES)
+    keys = [
+        k.reshape(num_s, num_r, num_l, s_rows, LANES)
+        for k in (b_mont, a_mont)
+    ]
+    scalars = [jnp.asarray(tabs.p), jnp.asarray(tabs.pinv_neg)]
+    smem = lambda: pl.BlockSpec(  # noqa: E731
+        (num_l, 1), lambda l, s, i: (0, 0), memory_space=pltpu.SMEM
+    )
+    c0_spec = pl.BlockSpec(
+        (1, 1, s_rows, LANES), lambda l, s, i: (l, i, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    d_spec = pl.BlockSpec(
+        (1, num_r, 1, s_rows, LANES), lambda l, s, i: (i, 0, l, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    key_spec = pl.BlockSpec(
+        (1, num_r, 1, s_rows, LANES), lambda l, s, i: (s, 0, l, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    out_spec = pl.BlockSpec(
+        (1, 1, 1, s_rows, LANES), lambda l, s, i: (l, s, i, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    out_shape = jax.ShapeDtypeStruct((num_l, num_s, b, s_rows, LANES), jnp.uint32)
+    acc0, acc1 = pl.pallas_call(
+        functools.partial(_hoist_products_kernel, num_r=num_r),
+        grid=(num_l, num_s, b),
+        in_specs=[smem(), smem(), c0_spec, d_spec] + [key_spec] * 2,
+        out_specs=(out_spec, out_spec),
+        out_shape=(out_shape, out_shape),
+        interpret=interpret,
+    )(*scalars, c0_rows, d_rows, *keys)
+    unrow = lambda o: jnp.moveaxis(  # noqa: E731
+        o.reshape(num_l, num_s, b, n), 0, 2
+    ).reshape(num_s, *batch, num_l, n)
+    return unrow(acc0), unrow(acc1)

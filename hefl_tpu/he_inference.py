@@ -167,6 +167,22 @@ def stack_rotation_ladder(ctx: CkksContext, gks: dict[int, GaloisKey]):
     )
 
 
+def ladder_stage_forward_ntts(ctx: CkksContext) -> int:
+    """Forward [L, N] transforms ONE `rotate_and_sum_scan` stage pays:
+    L*d gadget-digit NTTs + the rotated-c0 re-NTT. Pinned by a trace-count
+    assertion in tests/test_hoisted.py (`ntt.transform_trace_counts`).
+
+    Why the ladder CANNOT ride the hoisted decomposition
+    (`ops.hoisted_rotations`, ISSUE 18): hoisting shares one gadget
+    decomposition across rotations of the SAME ciphertext, but each ladder
+    stage rotates the PREVIOUS stage's output — the scan carry
+    ct <- ct + rot(ct) feeds stage k's c1 from stage k-1's key-switch, so
+    there is no shared input to decompose. Every stage pays this full
+    per-rotation cost by construction; the BSGS baby sweep (all rotations
+    of one fixed query) is where hoisting applies."""
+    return ctx.num_primes * ctx.ksk_num_digits + 1
+
+
 def rotate_and_sum_scan(ctx: CkksContext, ct: Ciphertext, ladder) -> Ciphertext:
     """`rotate_and_sum` as ONE `lax.scan` over the ladder stages.
 
@@ -178,7 +194,12 @@ def rotate_and_sum_scan(ctx: CkksContext, ct: Ciphertext, ladder) -> Ciphertext:
     tables and Galois keys in as data (`stack_rotation_ladder`); the
     automorphism was already a gather, so tables-as-data costs nothing
     extra. Same arithmetic, same result — pinned by the parity test in
-    tests/test_he_inference.py."""
+    tests/test_he_inference.py.
+
+    Per-stage cost stays `ladder_stage_forward_ntts(ctx)` forward NTTs:
+    the scan CARRY (each stage rotates the previous stage's output) is
+    what keeps this ladder outside the hoisted-decomposition fast path —
+    see `ladder_stage_forward_ntts` for the full argument."""
     from hefl_tpu.ckks.modular import add_mod
     from hefl_tpu.ckks.ntt import ntt_forward, ntt_inverse
     from hefl_tpu.ckks.ops import _keyswitch_coeff
@@ -460,6 +481,23 @@ class BsgsPlan:
         """The Galois-key bundle the serving server must hold."""
         return tuple(sorted(set(self.baby_steps) | set(self.giant_steps)))
 
+    def forward_ntts(self, gadget_rows: int, hoisted: bool) -> int:
+        """Forward [L, N] polynomial transforms one score pays in the
+        rotation sweeps (baby + giant), for a context with `gadget_rows`
+        = L*d gadget components (ISSUE 18 — the printed, gated number).
+
+        Unhoisted, every baby rotation pays its own decomposition:
+        gadget_rows digit NTTs + the rotated-c0 re-NTT. Hoisted, the
+        whole baby sweep shares ONE decomposition (gadget_rows NTTs
+        total; c0 needs no NTT — its eval form is permuted in place).
+        Giant rotations act on DISTINCT partial sums, so they stay
+        per-rotation in both plans."""
+        per_rot = gadget_rows + 1
+        giant = len(self.giant_steps) * per_rot
+        if hoisted:
+            return gadget_rows + giant
+        return len(self.baby_steps) * per_rot + giant
+
 
 def ladder_keyswitches(slots: int, num_classes: int) -> int:
     """Key-switches one score costs under the rotate-and-sum ladder —
@@ -573,15 +611,36 @@ def _bsgs_diag_tables(
 
 def _bsgs_apply(
     ctx: CkksContext, plan: BsgsPlan, pt_scale: float, ct_x: Ciphertext,
-    u_mont, b_res, baby_tables, giant_tables,
+    u_mont, b_res, baby_tables, giant_tables, mode: str = "hoisted",
 ):
     """The BSGS scoring program body (any leading batch shape on ct_x).
 
-    Three scanned sweeps, each body compiled once: baby rotations of the
-    query (inverse NTT hoisted — computed ONCE, outside the sweep),
-    the modular contraction of the pre-rotated diagonals against the
-    rotation stack, and the giant rotate-and-accumulate. All K class
-    scores land in one ciphertext at scale ct_scale * pt_scale.
+    Three sweeps: baby rotations of the query, the modular contraction of
+    the pre-rotated diagonals against the rotation stack, and the giant
+    rotate-and-accumulate. All K class scores land in one ciphertext at
+    scale ct_scale * pt_scale.
+
+    `mode` selects the baby sweep's decomposition (ISSUE 18):
+
+      "hoisted"   — ONE shared gadget decomposition (`ops.hoisted_digits`)
+                    feeds every baby step as a batched inner product +
+                    eval permutation (`ops.hoisted_rotations_core`); the
+                    serving default. `baby_tables` are
+                    `ops.hoisted_rotation_tables`.
+      "unhoisted" — the same uncentered decomposition applied step-by-step
+                    (coefficient automorphism of the digit polys + per-step
+                    NTTs). BITWISE-equal to "hoisted" (exact modular
+                    arithmetic on identical digits) — the parity anchor
+                    and the honest per-step cost model. `baby_tables` are
+                    `stack_rotation_steps`.
+      "legacy"    — the original centered-digit `ct_rotate` decomposition
+                    (per-step, correction row). Same rotation, different
+                    noise bits: equal to the others only after decryption,
+                    to tolerance. `baby_tables` are `stack_rotation_steps`.
+
+    Giant rotations act on DISTINCT partial sums, so they stay on the
+    legacy per-rotation path in every mode (and stay bitwise-identical
+    across the hoisted/unhoisted pair).
     """
     from hefl_tpu.ckks import modular
     from hefl_tpu.ckks.modular import add_mod
@@ -609,16 +668,63 @@ def _bsgs_apply(
         cc0 = ntt_inverse(ntt, ct_x.c0)
         cc1 = ntt_inverse(ntt, ct_x.c1)
 
-    def baby_stage(carry, inp):
-        return carry, rotate(cc0, cc1, *inp)
+    if not plan.baby_steps:
+        rots0 = ct_x.c0[None]
+        rots1 = ct_x.c1[None]
+    elif mode == "hoisted":
+        # Shared-prefix sweep: decompose once, serve every step as a
+        # batched digit x key product + output permutation.
+        with jax.named_scope(obs_scopes.SERVE_HOIST):
+            d_eval = ops.hoisted_digits(ctx, cc1)
+            r0, r1 = ops.hoisted_rotations_core(
+                ctx, ct_x.c0, d_eval, *baby_tables
+            )
+        rots0 = jnp.concatenate([ct_x.c0[None], r0], axis=0)
+        rots1 = jnp.concatenate([ct_x.c1[None], r1], axis=0)
+    elif mode == "unhoisted":
+        # The bitwise twin: identical uncentered digits, but the
+        # automorphism + NTTs re-run per step (the cost hoisting removes).
+        with jax.named_scope(obs_scopes.SERVE_HOIST):
+            num_r = ctx.num_primes * ctx.ksk_num_digits
+            w = ctx.ksk_digit_bits
+            mask = jnp.uint32((1 << w) - 1)
+            digs = jnp.stack(
+                [(cc1 >> jnp.uint32(w * k)) & mask
+                 for k in range(ctx.ksk_num_digits)], axis=-2
+            )
+            comp = digs.reshape(*cc1.shape[:-2], num_r, ctx.n)
+            lifted = jnp.broadcast_to(
+                comp[..., :, None, :],
+                (*cc1.shape[:-2], num_r, ctx.num_primes, ctx.n),
+            )
 
-    if plan.baby_steps:
-        _, (r0, r1) = jax.lax.scan(baby_stage, 0, baby_tables)
+        def unhoisted_stage(carry, inp):
+            src, flip, b_mont, a_mont = inp
+            with jax.named_scope(obs_scopes.SERVE_HOIST):
+                pd = galois.apply_automorphism(lifted, p, src, flip)
+                d_eval = ntt_forward(ntt, pd)
+                bk, ak = b_mont[:num_r], a_mont[:num_r]
+                t0 = modular.mont_mul(d_eval, bk, p, pinv)
+                t1 = modular.mont_mul(d_eval, ak, p, pinv)
+                k0, k1 = t0[..., 0, :, :], t1[..., 0, :, :]
+                for c in range(1, num_r):
+                    k0 = add_mod(k0, t0[..., c, :, :], p)
+                    k1 = add_mod(k1, t1[..., c, :, :], p)
+                pc0 = galois.apply_automorphism(cc0, p, src, flip)
+                r0 = add_mod(ntt_forward(ntt, pc0), k0, p)
+            return carry, (r0, k1)
+
+        _, (r0, r1) = jax.lax.scan(unhoisted_stage, 0, baby_tables)
         rots0 = jnp.concatenate([ct_x.c0[None], r0], axis=0)
         rots1 = jnp.concatenate([ct_x.c1[None], r1], axis=0)
     else:
-        rots0 = ct_x.c0[None]
-        rots1 = ct_x.c1[None]
+
+        def baby_stage(carry, inp):
+            return carry, rotate(cc0, cc1, *inp)
+
+        _, (r0, r1) = jax.lax.scan(baby_stage, 0, baby_tables)
+        rots0 = jnp.concatenate([ct_x.c0[None], r0], axis=0)
+        rots1 = jnp.concatenate([ct_x.c1[None], r1], axis=0)
 
     # Giant partial sums: contract the diagonal table against the baby
     # rotation stack, mod p, scanning the baby axis (body compiled once).
@@ -663,15 +769,17 @@ def _bsgs_apply(
 
 
 @functools.lru_cache(maxsize=16)
-def _bsgs_program(ctx: CkksContext, plan: BsgsPlan, pt_scale: float):
-    """ONE jitted BSGS scoring program per (context, plan, scale) — shared
-    by every batch bucket shape through the jit shape cache."""
+def _bsgs_program(
+    ctx: CkksContext, plan: BsgsPlan, pt_scale: float, mode: str = "hoisted"
+):
+    """ONE jitted BSGS scoring program per (context, plan, scale, mode) —
+    shared by every batch bucket shape through the jit shape cache."""
 
     @jax.jit
     def run(ct_x: Ciphertext, u_mont, b_res, baby_tables, giant_tables):
         return _bsgs_apply(
             ctx, plan, pt_scale, ct_x, u_mont, b_res, baby_tables,
-            giant_tables,
+            giant_tables, mode,
         )
 
     return run
@@ -704,6 +812,14 @@ class BsgsLinearScorer:
     of the output holds query r's scores at slots r*D .. r*D+K-1
     (decrypt with `decrypt_class_scores(..., queries_per_ct=q)`). The
     per-QUERY key-switch cost divides by q on top of the BSGS saving.
+
+    `rotation_mode` (ISSUE 18) picks the baby sweep's decomposition — see
+    `_bsgs_apply`. The default "hoisted" shares ONE gadget decomposition
+    across the whole sweep (`self.hoisted_ntts` forward NTTs vs
+    `self.unhoisted_ntts` for the per-step twin); "unhoisted" is its
+    bitwise parity anchor; "legacy" keeps the original centered-digit
+    per-step plan (equal scores to tolerance only — a different
+    decomposition carries different noise bits).
     """
 
     def __init__(
@@ -716,7 +832,13 @@ class BsgsLinearScorer:
         ct_scale: float | None = None,
         baby: int | None = None,
         queries_per_ct: int = 1,
+        rotation_mode: str = "hoisted",
     ):
+        if rotation_mode not in ("hoisted", "unhoisted", "legacy"):
+            raise ValueError(
+                f"rotation_mode must be hoisted|unhoisted|legacy, got "
+                f"{rotation_mode!r}"
+            )
         weights = np.asarray(weights, np.float64)
         bias = np.asarray(bias, np.float64)
         slots = encoding.num_slots(ctx.ntt)
@@ -744,14 +866,26 @@ class BsgsLinearScorer:
         self.pt_scale = pt_scale
         self.ct_scale = ctx.scale if ct_scale is None else ct_scale
         self.queries_per_ct = q
+        self.rotation_mode = rotation_mode
         self.num_classes, d = weights.shape
         self.plan = bsgs_plan(slots, d, self.num_classes, baby)
-        self._baby_tables = stack_rotation_steps(
-            ctx, gks, self.plan.baby_steps
-        )
+        if rotation_mode == "hoisted":
+            self._baby_tables = ops.hoisted_rotation_tables(
+                ctx, gks, self.plan.baby_steps
+            )
+        else:
+            self._baby_tables = stack_rotation_steps(
+                ctx, gks, self.plan.baby_steps
+            )
         self._giant_tables = stack_rotation_steps(
             ctx, gks, self.plan.giant_steps
         )
+        # The printed, gated hoisting numbers: forward NTTs one score pays
+        # in the rotation sweeps under each decomposition.
+        rows = ctx.num_primes * ctx.ksk_num_digits
+        self.gadget_rows = rows
+        self.hoisted_ntts = self.plan.forward_ntts(rows, hoisted=True)
+        self.unhoisted_ntts = self.plan.forward_ntts(rows, hoisted=False)
         self._u_mont = _bsgs_diag_tables(
             ctx, self.plan, weights, pt_scale, q
         )
@@ -760,7 +894,7 @@ class BsgsLinearScorer:
         self._b_res = jnp.asarray(
             encoding.encode_slots(ctx.ntt, bz, self.ct_scale * pt_scale)
         )
-        self._run = _bsgs_program(ctx, self.plan, pt_scale)
+        self._run = _bsgs_program(ctx, self.plan, pt_scale, rotation_mode)
 
     def _check_scale(self, ct: Ciphertext) -> None:
         if ct.scale != self.ct_scale:
@@ -931,12 +1065,16 @@ def rotation_ladder_range_probe(prime: int, digit_bits: int, num_digits: int):
 
 
 def exact_int_probes() -> dict:
-    """The serving side's declared exact-integer region (analysis.lint):
-    the ladder probe — now a region that CONTAINS the loop, so its
-    carried residues are watched by the no-float / no-stray-div rules
-    (the `%` is the allowlisted probe modulo)."""
+    """The serving side's declared exact-integer regions (analysis.lint):
+    the ladder probe and the composed two-layer BSGS probe — regions that
+    CONTAIN their loops, so carried residues are watched by the no-float /
+    no-stray-div rules (the `%` is the allowlisted probe modulo)."""
     fn, args = rotation_ladder_range_probe(2**27 - 39, 9, 3)
-    return {"he_inference.rotate_ladder": (fn, args)}
+    mfn, margs = mlp_bsgs_range_probe(2**27 - 39, 5, 6)
+    return {
+        "he_inference.rotate_ladder": (fn, args),
+        "he_inference.mlp_compose": (mfn, margs),
+    }
 
 
 def _const_eval_residues(ctx: CkksContext, c: np.ndarray, scale: float) -> np.ndarray:
@@ -1188,3 +1326,324 @@ class MlpScorer:
         return _mlp_tail_batch_program(self.ctx, self.pt_scale, self._rescales)(
             hs, self.rlk, self._w2m, self._b2e
         )
+
+
+# ---------------------------------------------------------------------------
+# Composed diagonal plans (ISSUE 18): the MLP hidden layer as BSGS. The
+# ladder MlpScorer runs H per-class rotate-and-sum ladders for the hidden
+# layer; BsgsMlpScorer replaces them with TWO composed Halevi-Shoup plans —
+# layer-1 BSGS lands all H hidden pre-activations in slots 0..H-1 of ONE
+# ciphertext (slots >= H are exactly zero by the diagonal construction), the
+# square activation is a single ct_mul + relinearization (vs H of them),
+# and after `rescales` rescale stages layer-2 BSGS reads those same slots as
+# its d=H feature block. No re-layout between layers: the BSGS output
+# layout IS the BSGS input layout.
+# ---------------------------------------------------------------------------
+
+
+def mlp_sub_context(ctx: CkksContext, rescales: int) -> CkksContext:
+    """The statically-known post-rescale context a depth-2 MLP program ends
+    at — layer-2 keys/tables must be built against THIS context."""
+    cur = ctx
+    for _ in range(int(rescales)):
+        cur = _sliced_context(cur)
+    return cur
+
+
+def bsgs_mlp_plans(
+    slots: int, d: int, hidden: int, num_classes: int,
+    baby1: int | None = None, baby2: int | None = None,
+) -> tuple[BsgsPlan, BsgsPlan]:
+    """The two composed plans of a BSGS MLP: (d -> hidden) at full level,
+    (hidden -> num_classes) at the post-rescale level. Callers use these
+    to generate the two Galois-key bundles BEFORE building the scorer
+    (layer 2's keys live on `mlp_sub_context(ctx, rescales)` under
+    `slice_secret_key(sk, sub_ctx.num_primes)`)."""
+    return (
+        bsgs_plan(slots, d, hidden, baby1),
+        bsgs_plan(slots, hidden, num_classes, baby2),
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _mlp_bsgs_program(
+    ctx: CkksContext, plan1: BsgsPlan, plan2: BsgsPlan, pt_scale: float,
+    rescales: int, mode: str,
+):
+    """ONE jitted program for the whole composed MLP: layer-1 BSGS ->
+    square (ct_mul + relin) -> rescales -> layer-2 BSGS. Three
+    key-switch sweeps + one relinearization, two diagonal contractions,
+    one compiled dispatch."""
+
+    @jax.jit
+    def run(
+        ct_x: Ciphertext, rlk, u1, b1_res, baby1, giant1,
+        u2, b2_res, baby2, giant2,
+    ):
+        h = _bsgs_apply(
+            ctx, plan1, pt_scale, ct_x, u1, b1_res, baby1, giant1, mode
+        )
+        with jax.named_scope(obs_scopes.SERVE_SCORE):
+            sq = ops.ct_mul(ctx, h, h, rlk)
+        cur = ctx
+        for _ in range(rescales):
+            with jax.named_scope(obs_scopes.SERVE_SCORE):
+                cur, sq = ops.rescale(cur, sq)
+        return _bsgs_apply(
+            cur, plan2, pt_scale, sq, u2, b2_res, baby2, giant2, mode
+        )
+
+    return run
+
+
+class BsgsMlpScorer:
+    """Precompiled depth-2 MLP server on COMPOSED diagonal plans
+    (ISSUE 18): scores = W2 · (W1 x + b1)² + b2 with both linear layers as
+    BSGS sweeps riding the hoisted-rotation fast path.
+
+    vs `MlpScorer` (the ladder MLP): the hidden layer drops from
+    H·log2(slots) ladder key-switches + H squarings to
+    plan1.num_keyswitches + ONE squaring, and the output layer's
+    constant-multiply contraction becomes a second diagonal plan (which,
+    unlike the constant path, also works when hidden values must move
+    between slots). Same circuit, same depth, same `rescales` budget —
+    the decrypted scores match the ladder MLP to noise tolerance
+    (different rotation sets carry different noise bits; the BITWISE
+    anchor is rotation_mode "hoisted" vs "unhoisted", which share exact
+    arithmetic — see `_bsgs_apply`).
+
+    Keys: `gks1` on `ctx` covers plan1.rotation_steps_needed; `gks2` on
+    `mlp_sub_context(ctx, rescales)` (generated under
+    `slice_secret_key(sk, sub_ctx.num_primes)`) covers plan2's. Decrypt
+    with `decrypt_class_scores(self.sub_ctx, sliced_sk, out, K)`.
+    """
+
+    def __init__(
+        self,
+        ctx: CkksContext,
+        w1: np.ndarray,
+        b1: np.ndarray,
+        w2: np.ndarray,
+        b2: np.ndarray,
+        gks1: dict[int, GaloisKey],
+        rlk,
+        gks2: dict[int, GaloisKey],
+        pt_scale: float = 2.0**14,
+        rescales: int = 2,
+        ct_scale: float | None = None,
+        baby1: int | None = None,
+        baby2: int | None = None,
+        rotation_mode: str = "hoisted",
+    ):
+        if rotation_mode not in ("hoisted", "unhoisted", "legacy"):
+            raise ValueError(
+                f"rotation_mode must be hoisted|unhoisted|legacy, got "
+                f"{rotation_mode!r}"
+            )
+        w1 = np.asarray(w1, np.float64)
+        b1 = np.asarray(b1, np.float64)
+        w2 = np.asarray(w2, np.float64)
+        b2 = np.asarray(b2, np.float64)
+        slots = encoding.num_slots(ctx.ntt)
+        if w1.ndim != 2 or w1.shape[1] > slots:
+            raise ValueError(f"w1 must be [H, d<= {slots}], got {w1.shape}")
+        if b1.shape != (w1.shape[0],):
+            raise ValueError(f"b1 must be [{w1.shape[0]}], got {b1.shape}")
+        if w2.ndim != 2 or w2.shape[1] != w1.shape[0]:
+            raise ValueError(f"w2 must be [K, {w1.shape[0]}], got {w2.shape}")
+        if b2.shape != (w2.shape[0],):
+            raise ValueError(f"b2 must be [{w2.shape[0]}], got {b2.shape}")
+        hidden = int(w1.shape[0])
+        if hidden > slots:
+            raise ValueError(f"{hidden} hidden units exceed {slots} slots")
+        self.ctx = ctx
+        self.pt_scale = pt_scale
+        self.ct_scale = ctx.scale if ct_scale is None else ct_scale
+        self.rotation_mode = rotation_mode
+        self.num_classes = int(w2.shape[0])
+        self._rescales = int(rescales)
+        self.plan1, self.plan2 = bsgs_mlp_plans(
+            slots, w1.shape[1], hidden, self.num_classes, baby1, baby2
+        )
+        self.rlk = rlk
+        self.sub_ctx = mlp_sub_context(ctx, rescales)
+        # Statically-derived scales, mirroring MlpScorer: the hidden
+        # ciphertext squares to h_scale**2, each rescale divides by the
+        # dropped prime, layer 2 multiplies by pt_scale once more.
+        h_scale = self.ct_scale * pt_scale
+        sq_scale = h_scale * h_scale
+        p_np = np.asarray(ctx.ntt.p)[:, 0]
+        for i in range(self._rescales):
+            sq_scale /= float(p_np[ctx.num_primes - 1 - i])
+        self.sq_scale = sq_scale
+
+        def tables(c, plan, gks, m):
+            if m == "hoisted":
+                baby = ops.hoisted_rotation_tables(c, gks, plan.baby_steps)
+            else:
+                baby = stack_rotation_steps(c, gks, plan.baby_steps)
+            return baby, stack_rotation_steps(c, gks, plan.giant_steps)
+
+        self._baby1, self._giant1 = tables(ctx, self.plan1, gks1, rotation_mode)
+        self._baby2, self._giant2 = tables(
+            self.sub_ctx, self.plan2, gks2, rotation_mode
+        )
+        self._u1 = _bsgs_diag_tables(ctx, self.plan1, w1, pt_scale, 1)
+        self._u2 = _bsgs_diag_tables(self.sub_ctx, self.plan2, w2, pt_scale, 1)
+        bz1 = np.zeros(slots)
+        bz1[:hidden] = b1
+        self._b1_res = jnp.asarray(
+            encoding.encode_slots(ctx.ntt, bz1, h_scale)
+        )
+        bz2 = np.zeros(slots)
+        bz2[: self.num_classes] = b2
+        self._b2_res = jnp.asarray(
+            encoding.encode_slots(self.sub_ctx.ntt, bz2, sq_scale * pt_scale)
+        )
+        # The printed, gated hoisting numbers for the COMPOSED circuit.
+        rows1 = ctx.num_primes * ctx.ksk_num_digits
+        rows2 = self.sub_ctx.num_primes * self.sub_ctx.ksk_num_digits
+        self.hoisted_ntts = (
+            self.plan1.forward_ntts(rows1, True)
+            + self.plan2.forward_ntts(rows2, True)
+        )
+        self.unhoisted_ntts = (
+            self.plan1.forward_ntts(rows1, False)
+            + self.plan2.forward_ntts(rows2, False)
+        )
+        self._run = _mlp_bsgs_program(
+            ctx, self.plan1, self.plan2, pt_scale, self._rescales,
+            rotation_mode,
+        )
+
+    @property
+    def num_keyswitches(self) -> int:
+        """Key-switches per score: both plans' sweeps + the relinearization."""
+        return self.plan1.num_keyswitches + self.plan2.num_keyswitches + 1
+
+    def _check_scale(self, ct: Ciphertext) -> None:
+        if ct.scale != self.ct_scale:
+            raise ValueError(
+                f"scorer was built for ct scale {self.ct_scale}, got "
+                f"{ct.scale}"
+            )
+
+    def score(self, ct_x: Ciphertext) -> Ciphertext:
+        """All K class scores of one sample as ONE ciphertext at
+        `self.sub_ctx`'s level (slot k = class k)."""
+        self._check_scale(ct_x)
+        if ct_x.c0.ndim != 2:
+            raise ValueError(
+                f"score takes one sample [L, N], got {ct_x.c0.shape}; "
+                "use score_many for a batch"
+            )
+        return self._run(
+            ct_x, self.rlk, self._u1, self._b1_res, self._baby1,
+            self._giant1, self._u2, self._b2_res, self._baby2, self._giant2,
+        )
+
+    def score_many(self, ct_xs: Ciphertext) -> Ciphertext:
+        """Score a whole batch [B, L, N] in one device dispatch, padded to
+        the power-of-two bucket like `BsgsLinearScorer.score_many`."""
+        self._check_scale(ct_xs)
+        if ct_xs.c0.ndim != 3:
+            raise ValueError(
+                f"score_many needs a batched ciphertext [B, L, N], got "
+                f"limbs of shape {ct_xs.c0.shape}; use score() for a "
+                "single sample"
+            )
+        batch = ct_xs.c0.shape[0]
+        bucket = serving_batch_bucket(batch)
+        if bucket != batch:
+            pad = ((0, bucket - batch), (0, 0), (0, 0))
+            ct_xs = Ciphertext(
+                c0=jnp.pad(ct_xs.c0, pad), c1=jnp.pad(ct_xs.c1, pad),
+                scale=ct_xs.scale,
+            )
+        out = self._run(
+            ct_xs, self.rlk, self._u1, self._b1_res, self._baby1,
+            self._giant1, self._u2, self._b2_res, self._baby2, self._giant2,
+        )
+        if bucket != batch:
+            out = Ciphertext(
+                c0=out.c0[:batch], c1=out.c1[:batch], scale=out.scale
+            )
+        return out
+
+
+def mlp_bsgs_range_probe(prime: int, digit_bits: int, num_digits: int):
+    """The two-layer composed BSGS circuit's carrier arithmetic as a
+    traceable mirror (analysis.ranges.certify_inference, ISSUE 18).
+
+    Mirrors, per RNS limb, what `_mlp_bsgs_program` computes: a layer-1
+    HOISTED sweep (uncentered shared digits, digit x key products, eval
+    permutation) as a `lax.while_loop` over an abstract step count, the
+    square activation's Montgomery-contract products (d0/d1/d2 of
+    `ops.ct_mul` at canonical inputs), the relinearization's centered
+    gadget key-switch of d2, the rescale stage's subtract-and-scale
+    ((x - rep) * p_last^{-1} mod p, at a canonical stand-in for the
+    dropped limb's representative), and a layer-2 hoisted sweep on the
+    result. Both sweeps are abstract-depth loops, so the carried
+    invariants hold for ANY plan geometry. Int64 carrier, `%` as the
+    allowlisted probe modulo; trace under `jax.experimental.enable_x64()`.
+    -> (fn, example_args).
+    """
+    p = int(prime)
+    w = int(digit_bits)
+    half = 1 << max(w - 1, 0)
+    mask = (1 << w) - 1
+    m = 4  # coefficients per probe limb; ranges are per-element anyway
+
+    def hoisted_sweep(steps, x0, x1, key_b, key_a, perm):
+        digits = [((x1 >> (w * k)) & mask) for k in range(int(num_digits))]
+
+        def cond(state):
+            return state[0] > 0
+
+        def body(state):
+            remaining, a0, a1 = state
+            k0 = jnp.zeros_like(x0)
+            k1 = jnp.zeros_like(x1)
+            for k in range(int(num_digits)):
+                k0 = (k0 + digits[k] * key_b) % p
+                k1 = (k1 + digits[k] * key_a) % p
+            r0 = jnp.take((x0 + k0) % p, perm, axis=-1)
+            r1 = jnp.take(k1, perm, axis=-1)
+            return remaining - 1, (a0 + r0) % p, (a1 + r1) % p
+
+        _, a0, a1 = jax.lax.while_loop(
+            cond, body, (steps, jnp.zeros_like(x0), jnp.zeros_like(x1))
+        )
+        return a0, a1
+
+    def probe(steps1, steps2, c0, c1, key_b, key_a, perm, rs_inv):
+        # Layer 1: hoisted BSGS sweep.
+        h0, h1 = hoisted_sweep(steps1, c0, c1, key_b, key_a, perm)
+        # Square activation: ct_mul's d0/d1/d2 Montgomery-contract mirror.
+        d0 = (h0 * h0) % p
+        d1 = ((h0 * h1) % p + (h1 * h0) % p) % p
+        d2 = (h1 * h1) % p
+        # Relinearization: centered gadget key-switch of d2 (the
+        # keyswitch_gadget_probe body, inline).
+        k0 = jnp.zeros_like(d2)
+        k1 = jnp.zeros_like(d2)
+        for k in range(int(num_digits)):
+            digit = (d2 >> (w * k)) & mask
+            centered = (digit + (p - half)) % p
+            k0 = (k0 + centered * key_b) % p
+            k1 = (k1 + centered * key_a) % p
+        s0 = (d0 + (k0 + key_b) % p) % p
+        s1 = (d1 + (k1 + key_a) % p) % p
+        # Rescale: (x - rep) * p_last^{-1} mod p, rep canonical (the
+        # dropped limb's representative re-embedded under the head prime).
+        rep = jnp.take(s0, perm, axis=-1)   # canonical stand-in
+        s0 = (((s0 + (p - rep)) % p) * rs_inv) % p
+        s1 = (((s1 + (p - rep)) % p) * rs_inv) % p
+        # Layer 2: hoisted BSGS sweep on the rescaled hidden ciphertext.
+        y0, y1 = hoisted_sweep(steps2, s0, s1, key_b, key_a, perm)
+        return y0, y1
+
+    z = np.zeros((m,), np.int64)
+    return probe, (
+        np.int64(0), np.int64(0), z, z, z, z, np.zeros((m,), np.int64), z,
+    )

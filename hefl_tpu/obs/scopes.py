@@ -42,6 +42,7 @@ EVALUATE = "hefl.evaluate"            # test-set forward + softmax
 SERVE_SCORE = "hefl.serve_score"      # inference ct x plain mul + bias
 SERVE_ROTATE = "hefl.serve_rotate"    # rotation sweep bodies (ladder/BSGS)
 SERVE_KEYSWITCH = "hefl.serve_keyswitch"  # gadget key-switch (fused kernel)
+SERVE_HOIST = "hefl.serve_hoist"      # hoisted decompose + per-step products
 
 # HOST-side spans (jax.profiler.TraceAnnotation, not named_scope): driver
 # work that owns wall-clock but runs no device ops. The trace parser
@@ -66,6 +67,7 @@ PHASES = (
     SERVE_SCORE,
     SERVE_ROTATE,
     SERVE_KEYSWITCH,
+    SERVE_HOIST,
 )
 
 

@@ -56,7 +56,12 @@
 #       row, the certify_keyswitch gadget certificates alongside the
 #       ladder ones, the he_backend record, and a batched-vs-single
 #       serving speedup (slot-packed + ct-batched BSGS vs single-query)
-#       clearing the >= 1.3x floor on the CPU smoke;
+#       clearing the >= 1.3x floor on the CPU smoke; additionally
+#       (ISSUE 18) the hoisted-rotation gates — hoisted/unhoisted BSGS
+#       parity shas bitwise-equal, strictly fewer forward NTTs per score
+#       hoisted, >= 1.3x hoisted QPS over the per-step twin — and the
+#       composed mlp_bsgs gates (parity shas equal, fewer key-switches
+#       than the per-class hidden ladders);
 #   (n) cohort-only training (ISSUE 15): the cohort_compare record
 #       (full-C vs cohort-only producer seconds, bucket chosen, devices
 #       per mesh axis) must be present with bitwise_equal true — the
@@ -144,8 +149,57 @@ for r in rows:
     if r.get("argmax_ok") is not True:
         fail.append(f"BENCH_INFER row {r.get('row')}: argmax_ok false")
 plans = {r.get("plan") for r in rows}
-if not {"ladder", "bsgs", "mlp"} <= plans:
-    fail.append(f"BENCH_INFER: plans {plans} missing ladder/bsgs/mlp rows")
+if not {"ladder", "bsgs", "mlp", "bsgs_hoisted", "bsgs_unhoisted",
+        "mlp_bsgs"} <= plans:
+    fail.append(
+        f"BENCH_INFER: plans {plans} missing "
+        "ladder/bsgs/mlp/bsgs_hoisted/bsgs_unhoisted/mlp_bsgs rows"
+    )
+
+# Hoisted-rotation gates (ISSUE 18): the hoisted and unhoisted runs of
+# the SAME plan must be bitwise-equal (shared uncentered decomposition —
+# identical digits, exact modular arithmetic), the hoisted run must pay
+# strictly fewer forward NTTs per score, and the saved NTTs must show up
+# as QPS: >= 1.3x over the per-step twin even on the CPU smoke geometry.
+hoist = art.get("hoisted") or {}
+if hoist.get("parity") is not True or not hoist.get("parity_sha_hoisted"):
+    fail.append(
+        "BENCH_INFER: hoisted/unhoisted BSGS parity shas differ — the "
+        "shared decomposition changed the ciphertext bits"
+    )
+hn, un = hoist.get("hoisted_ntts_per_score"), hoist.get(
+    "unhoisted_ntts_per_score")
+if not (isinstance(hn, int) and isinstance(un, int) and hn < un):
+    fail.append(
+        f"BENCH_INFER: hoisted forward NTTs/score ({hn}) must be strictly "
+        f"below unhoisted ({un})"
+    )
+hs = hoist.get("speedup")
+if not isinstance(hs, (int, float)):
+    fail.append("BENCH_INFER: missing hoisted.speedup")
+elif hs < 1.3:
+    fail.append(
+        f"BENCH_INFER: hoisted-vs-unhoisted QPS speedup {hs}x is below "
+        "the 1.3x floor (sharing the gadget decomposition across the "
+        "baby sweep should save far more than this)"
+    )
+
+# Composed MLP gates (ISSUE 18): the two-layer BSGS program's hoisted and
+# unhoisted runs must also be bitwise-equal, and it must beat the
+# per-class hidden ladders on key-switches per score.
+mcmp = art.get("mlp_compare") or {}
+if mcmp.get("parity") is not True or not mcmp.get("parity_sha_hoisted"):
+    fail.append(
+        "BENCH_INFER: mlp_bsgs hoisted/unhoisted parity shas differ"
+    )
+lks = mcmp.get("ladder_keyswitches_per_score")
+bks = mcmp.get("mlp_bsgs_keyswitches_per_score")
+if not (isinstance(lks, (int, float)) and isinstance(bks, (int, float))
+        and bks < lks):
+    fail.append(
+        f"BENCH_INFER: mlp_bsgs keyswitches/score ({bks}) must be below "
+        f"the ladder MLP's ({lks})"
+    )
 
 check = art.get("analysis_check") or {}
 if check.get("violations") != 0:
@@ -184,7 +238,10 @@ if fail:
 print(
     f"inference smoke OK: {len(rows)} serving rows with QPS/p50/p95/p99, "
     f"{len(certs)} certificates (ladder + keyswitch gadget per ring), "
-    f"analysis.violations=0, batched-vs-single {speedup}x (>= 1.3x)"
+    f"analysis.violations=0, batched-vs-single {speedup}x (>= 1.3x), "
+    f"hoisted-vs-unhoisted {hs}x (>= 1.3x, parity shas equal, "
+    f"{hn} < {un} forward NTTs/score), mlp_bsgs {bks} < {lks} "
+    "keyswitches/score (parity shas equal)"
 )
 PY
 

@@ -85,10 +85,14 @@ echo "== hhe shard (pallas-interpret): $((SECONDS - t0))s"
 # keyswitch dispatch family (fused kernel on tileable rings, documented
 # XLA fallback on the small test rings) alongside the fast tier's XLA
 # default. The file lives in the slow tier, so this shard runs it
-# explicitly, without the marker filter.
+# explicitly, without the marker filter. The hoisted-rotation suite
+# (ISSUE 18: eval-permutation identity, hoisted/unhoisted bitwise parity,
+# the composed MLP plan, the fused product-kernel parity on a tileable
+# ring) rides the same pin so the hoisted dispatch path is the one under
+# test.
 t0=$SECONDS
 HEFL_NTT=pallas-interpret HEFL_HE=pallas python -m pytest -q \
-  tests/test_he_inference.py
+  tests/test_he_inference.py tests/test_hoisted.py
 echo "== serving shard (pallas-interpret, HEFL_HE=pallas): $((SECONDS - t0))s"
 # 2-D mesh shard (ISSUE 15): the stream + secure suites (and the cohort
 # suite itself) re-run on the virtual 8-device ("clients", "ct") = (2, 4)

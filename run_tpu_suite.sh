@@ -179,4 +179,34 @@ echo "=== stage 9: hierarchical-aggregation DCN bench (flat vs two-tier)"
 run_stage stage9 900 BENCH_DCN.json dcn_err.log \
   python -m hefl_tpu.fl.hierarchy --out BENCH_DCN.json
 
+echo "=== stage 10: BENCH_INFER hoisting gate (on-hardware parity + NTT floor)"
+# The ISSUE-18 evidence check on stage 6's artifact: the hoisted and
+# unhoisted BSGS runs (and the composed MLP pair) must be bitwise-equal
+# ON HARDWARE — the sha pair was computed from device outputs — and the
+# hoisted plan must pay strictly fewer forward NTTs per score. A parity
+# break here is a real kernel/XLA divergence at flagship shape, the same
+# class of evidence as stage 1's NTT parity gate.
+run_stage stage10 300 "" infer_gate_err.log python - <<'PY'
+import json, sys
+art = json.load(open("BENCH_INFER.json"))
+fail = []
+for blk in ("hoisted", "mlp_compare"):
+    b = art.get(blk) or {}
+    if b.get("parity") is not True or not b.get("parity_sha_hoisted"):
+        fail.append(f"{blk}: hoisted/unhoisted parity shas differ or missing")
+    hn, un = b.get("hoisted_ntts_per_score"), b.get("unhoisted_ntts_per_score")
+    if not (isinstance(hn, int) and isinstance(un, int) and hn < un):
+        fail.append(f"{blk}: forward NTTs/score not strictly lower ({hn} vs {un})")
+if not isinstance((art.get("hoisted") or {}).get("speedup"), (int, float)):
+    fail.append("hoisted: missing speedup record")
+if fail:
+    print("BENCH_INFER hoisting gate FAILED:")
+    [print(" -", f) for f in fail]
+    sys.exit(1)
+h = art["hoisted"]
+print(f"hoisting gate OK: parity shas equal, {h['hoisted_ntts_per_score']} < "
+      f"{h['unhoisted_ntts_per_score']} forward NTTs/score, "
+      f"{h['speedup']}x QPS on hardware")
+PY
+
 echo "=== suite pass complete: $(ls suite_state)"

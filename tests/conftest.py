@@ -53,6 +53,11 @@ import pytest  # noqa: E402
 #   full suite: python -m pytest tests/ -q   (add -n auto on multicore)
 _SLOW_PATTERNS = (
     "test_he_inference.py",                  # full serving circuits, big rings
+    "test_hoisted.py::test_bsgs_scorer",     # full BSGS programs, 2 modes each
+    "test_hoisted.py::test_identity_merged_giant_scorer",
+    "test_hoisted.py::test_score_many_no_new_compile_hoisted",
+    "test_hoisted.py::test_bsgs_mlp_scorer",  # depth-2 chain on 5-prime n=512
+    "test_hoisted.py::test_hoisted_products_pallas_parity",  # n=1024 interpret
     "test_ckks_mul.py",                      # ct x ct + relin at full ring
     "test_secure.py::test_secure_round",
     "test_secure.py::test_with_plain_reference",

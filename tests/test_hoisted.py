@@ -1,0 +1,363 @@
+"""Hoisted-rotation BSGS (ISSUE 18): the eval-domain automorphism
+permutation, the shared gadget decomposition, and the composed MLP plan.
+
+The bitwise anchor throughout is hoisted vs UNHOISTED — the same
+uncentered digit decomposition applied per-step (`ops.
+hoisted_rotations_reference`, `rotation_mode="unhoisted"`). Exact modular
+arithmetic makes those two paths bit-equal; the legacy centered
+`ct_rotate` path differs in the integers and is compared after decryption
+only. The trace-time NTT counters (`ntt.transform_trace_counts`) pin the
+cost model the bench prints: one decomposition (L*d forward NTTs) for the
+whole baby sweep, vs L*d+1 per rotation unhoisted — and why the
+rotate-and-sum ladder can never ride the hoisted path (its scan carry
+rotates the PREVIOUS stage's output, so there is no shared c1)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hefl_tpu import he_inference as hei
+from hefl_tpu.ckks import encoding, galois, ops
+from hefl_tpu.ckks import ntt as nttlib
+from hefl_tpu.ckks.keys import CkksContext, keygen
+
+
+@pytest.fixture(scope="module")
+def setup():
+    ctx = CkksContext.create(n=256)   # 128 slots: fast CI, same code path
+    sk, pk = keygen(ctx, jax.random.key(20))
+    return ctx, sk, pk
+
+
+def _step_keys(ctx, sk, steps, seed):
+    return hei.gen_rotation_keys_for_steps(
+        ctx, sk, jax.random.key(seed), steps
+    )
+
+
+# ---------------------------------------------------------------------------
+# The eval-domain automorphism is a pure permutation
+# ---------------------------------------------------------------------------
+
+
+def test_eval_permutation_matches_coefficient_automorphism(setup):
+    # NTT(phi_g(a)) == take(NTT(a), perm) bitwise for rotations AND the
+    # conjugation — the identity `eval_permutation`'s docstring pins. The
+    # coefficient path has sign flips; the eval path must reproduce them
+    # through pure index relabeling (zeta_j -> zeta_j^g is a bijection on
+    # the evaluation points).
+    ctx, _, _ = setup
+    ntt = ctx.ntt
+    p = jnp.asarray(ntt.p)
+    p_np = np.asarray(ntt.p)[:, 0]
+    rng = np.random.default_rng(21)
+    a = jnp.asarray(
+        rng.integers(0, 2**31, (ctx.num_primes, ctx.n)).astype(np.uint32)
+        % p_np[:, None].astype(np.uint32)
+    )
+    gs = [galois.galois_elt_rotation(ctx.n, s) for s in (1, 2, 5, 31)]
+    gs.append(galois.galois_elt_conjugation(ctx.n))
+    for g in gs:
+        src, flip = galois.automorphism_tables(ctx.n, g)
+        coeff = nttlib.ntt_forward(ntt, galois.apply_automorphism(a, p, src, flip))
+        perm, inv_perm = galois.eval_permutation(ntt, g)
+        evald = jnp.take(nttlib.ntt_forward(ntt, a), jnp.asarray(perm), axis=-1)
+        np.testing.assert_array_equal(np.asarray(coeff), np.asarray(evald))
+        assert (perm[inv_perm] == np.arange(ctx.n)).all()
+
+
+# ---------------------------------------------------------------------------
+# Hoisted sweep == per-step uncentered reference, bitwise
+# ---------------------------------------------------------------------------
+
+
+def test_hoisted_rotations_bitwise_vs_reference(setup):
+    ctx, sk, pk = setup
+    rng = np.random.default_rng(22)
+    x = rng.normal(0, 0.5, encoding.num_slots(ctx.ntt))
+    ct = hei.encrypt_features(ctx, pk, x, jax.random.key(23))
+    steps = (1, 2, 5, 31)
+    gks = _step_keys(ctx, sk, steps, 24)
+    got = ops.hoisted_rotations(ctx, ct, steps, gks)
+    ref = ops.hoisted_rotations_reference(ctx, ct, steps, gks)
+    np.testing.assert_array_equal(np.asarray(got.c0), np.asarray(ref.c0))
+    np.testing.assert_array_equal(np.asarray(got.c1), np.asarray(ref.c1))
+    assert got.scale == ref.scale
+
+    # Every stacked slice decrypts to the rotated slot vector (the legacy
+    # centered ct_rotate is a DIFFERENT integer program — decrypt-level
+    # agreement is the right comparison against it).
+    for i, s in enumerate(steps):
+        ct_s = ops.Ciphertext(c0=got.c0[i], c1=got.c1[i], scale=got.scale)
+        z = encoding.decode_slots(
+            ctx.ntt, np.asarray(ops.decrypt(ctx, sk, ct_s)), ct_s.scale
+        )
+        np.testing.assert_allclose(np.real(z), np.roll(x, -s), atol=0.01)
+        legacy = ops.ct_rotate(ctx, ct, gks[s], s)
+        zl = encoding.decode_slots(
+            ctx.ntt, np.asarray(ops.decrypt(ctx, sk, legacy)), legacy.scale
+        )
+        np.testing.assert_allclose(np.real(z), np.real(zl), atol=0.01)
+
+
+def test_hoisted_digit_width_guard():
+    # The uncentered identity needs 2**w <= min(p): a context whose digit
+    # width exceeds the smallest prime must be refused loudly, not produce
+    # wrapped digits.
+    import dataclasses
+
+    ctx = CkksContext.create(n=256)
+    wide = dataclasses.replace(ctx, ksk_digit_bits=31)
+    with pytest.raises(ValueError, match="overflow the smallest prime"):
+        ops.hoisted_digits(wide, jnp.zeros((ctx.num_primes, ctx.n), jnp.uint32))
+
+
+# ---------------------------------------------------------------------------
+# The cost model, pinned by trace-time counters
+# ---------------------------------------------------------------------------
+
+
+def test_ntt_trace_counts_pin_the_cost_model(setup):
+    # Trace-time counters bump ONCE per scan body — exactly the per-stage
+    # (ladder) vs shared-prefix (hoisted) cost model bench_inference
+    # prints and run_perf_smoke.sh gates.
+    ctx, sk, pk = setup
+    rng = np.random.default_rng(25)
+    x = rng.normal(0, 0.5, encoding.num_slots(ctx.ntt))
+    ct = hei.encrypt_features(ctx, pk, x, jax.random.key(26))
+    rows = ctx.num_primes * ctx.ksk_num_digits
+    steps = (1, 2, 5, 31)
+    gks = _step_keys(ctx, sk, steps, 27)
+    ops.hoisted_rotation_tables(ctx, gks, steps)   # warm eval-perm caches
+    lad_gks = hei.gen_rotation_keys(ctx, sk, jax.random.key(28))
+    ladder = hei.stack_rotation_ladder(ctx, lad_gks)
+
+    def delta(fn):
+        before = nttlib.transform_trace_counts()
+        jax.make_jaxpr(fn)(ct.c0, ct.c1)
+        after = nttlib.transform_trace_counts()
+        return {k: after[k] - before[k] for k in after}
+
+    # The ladder's scan CARRY (ct <- ct + rot(ct)) feeds each stage's c1
+    # from the previous key-switch: no shared input to decompose, so every
+    # stage pays the full per-rotation cost by construction.
+    lad = delta(lambda c0, c1: hei.rotate_and_sum_scan(
+        ctx, ops.Ciphertext(c0, c1, ct.scale), ladder))
+    assert lad["forward"] == hei.ladder_stage_forward_ntts(ctx) == rows + 1
+
+    # Hoisted: ONE decomposition (rows forward NTTs, 1 inverse) however
+    # many steps ride it; c0 never leaves the eval domain.
+    hoi = delta(lambda c0, c1: ops.hoisted_rotations(
+        ctx, ops.Ciphertext(c0, c1, ct.scale), steps, gks))
+    assert hoi == {"forward": rows, "inverse": 1}
+
+    # Unhoisted twin: rows digit NTTs + the c0 re-NTT per step.
+    ref = delta(lambda c0, c1: ops.hoisted_rotations_reference(
+        ctx, ops.Ciphertext(c0, c1, ct.scale), steps, gks))
+    assert ref == {"forward": len(steps) * (rows + 1), "inverse": 2}
+
+    # The plan-level formula the scorers print agrees with the counters.
+    plan = hei.bsgs_plan(encoding.num_slots(ctx.ntt), 37, 3)
+    assert plan.forward_ntts(rows, hoisted=True) == (
+        rows + len(plan.giant_steps) * (rows + 1)
+    )
+    assert plan.forward_ntts(rows, hoisted=False) == (
+        (len(plan.baby_steps) + len(plan.giant_steps)) * (rows + 1)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Scorer-level parity (slow tier: full serving programs)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("d", [37, 100])
+def test_bsgs_scorer_hoisted_unhoisted_bitwise(setup, d):
+    # The whole scoring program — hoisted baby sweep, giants, diagonal
+    # products, bias — must be BIT-equal to its unhoisted twin, and both
+    # must still score correctly. d=37 exercises a ragged diagonal window,
+    # d=100 the near-full-width plan from the serving bench.
+    ctx, sk, pk = setup
+    rng = np.random.default_rng(30 + d)
+    num_classes = 3
+    x = rng.normal(0, 0.5, d)
+    W = rng.normal(0, 0.3, (num_classes, d))
+    b = rng.normal(0, 0.2, num_classes)
+    plan = hei.bsgs_plan(encoding.num_slots(ctx.ntt), d, num_classes)
+    gks = _step_keys(ctx, sk, plan.rotation_steps_needed, 40 + d)
+    ct = hei.encrypt_features(ctx, pk, x, jax.random.key(41 + d))
+
+    hoisted = hei.BsgsLinearScorer(ctx, W, b, gks)
+    assert hoisted.rotation_mode == "hoisted"
+    unhoisted = hei.BsgsLinearScorer(
+        ctx, W, b, gks, rotation_mode="unhoisted"
+    )
+    out_h = hoisted.score(ct)
+    out_u = unhoisted.score(ct)
+    np.testing.assert_array_equal(np.asarray(out_h.c0), np.asarray(out_u.c0))
+    np.testing.assert_array_equal(np.asarray(out_h.c1), np.asarray(out_u.c1))
+    assert out_h.scale == out_u.scale
+
+    got = hei.decrypt_class_scores(ctx, sk, out_h, num_classes)
+    want = x @ W.T + b
+    np.testing.assert_allclose(got, want, atol=0.05)
+    assert hoisted.hoisted_ntts < hoisted.unhoisted_ntts
+    assert hoisted.plan.num_keyswitches == unhoisted.plan.num_keyswitches
+
+
+def test_identity_merged_giant_scorer(setup):
+    # K near the slot count: the diagonal window spans a full block cycle
+    # and the wrapped block i*baby = -slots lands on step 0 — it must
+    # merge into the identity group (no step-0 Galois key exists) and the
+    # scorer must still be exact. d=8, K=121, baby=16 on 128 slots hits
+    # exactly that geometry.
+    ctx, sk, pk = setup
+    slots = encoding.num_slots(ctx.ntt)
+    d, num_classes, baby = 8, 121, 16
+    plan = hei.bsgs_plan(slots, d, num_classes, baby)
+    assert len(plan.giants[0]) >= 2          # identity-merged block group
+    assert 0 not in plan.giant_steps
+
+    rng = np.random.default_rng(50)
+    x = rng.normal(0, 0.5, d)
+    W = rng.normal(0, 0.3, (num_classes, d))
+    b = rng.normal(0, 0.2, num_classes)
+    gks = _step_keys(ctx, sk, plan.rotation_steps_needed, 51)
+    ct = hei.encrypt_features(ctx, pk, x, jax.random.key(52))
+    hoisted = hei.BsgsLinearScorer(ctx, W, b, gks, baby=baby)
+    unhoisted = hei.BsgsLinearScorer(
+        ctx, W, b, gks, baby=baby, rotation_mode="unhoisted"
+    )
+    out_h = hoisted.score(ct)
+    out_u = unhoisted.score(ct)
+    np.testing.assert_array_equal(np.asarray(out_h.c0), np.asarray(out_u.c0))
+    np.testing.assert_array_equal(np.asarray(out_h.c1), np.asarray(out_u.c1))
+    got = hei.decrypt_class_scores(ctx, sk, out_h, num_classes)
+    np.testing.assert_allclose(got, x @ W.T + b, atol=0.05)
+
+
+def test_score_many_no_new_compile_hoisted(setup):
+    # The serving bucket guard must hold for the hoisted program too:
+    # batch sizes padding into a warmed bucket reuse its compile.
+    ctx, sk, pk = setup
+    rng = np.random.default_rng(60)
+    d, num_classes = 16, 2
+    W = rng.normal(0, 0.3, (num_classes, d))
+    b = rng.normal(0, 0.2, num_classes)
+    plan = hei.bsgs_plan(encoding.num_slots(ctx.ntt), d, num_classes)
+    gks = _step_keys(ctx, sk, plan.rotation_steps_needed, 61)
+    scorer = hei.BsgsLinearScorer(ctx, W, b, gks)
+
+    def score_batch(batch, seed):
+        xs = rng.normal(0, 0.5, (batch, d))
+        ct = hei.encrypt_features(ctx, pk, xs, jax.random.key(seed))
+        out = scorer.score_many(ct)
+        assert out.c0.shape[0] == batch
+        return hei.decrypt_class_scores(ctx, sk, out, num_classes)
+
+    score_batch(4, 62)                   # warm the 4-bucket
+    warmed = scorer._run._cache_size()
+    score_batch(3, 63)                   # pads to 4: no new compile
+    assert scorer._run._cache_size() == warmed
+
+
+# ---------------------------------------------------------------------------
+# The composed two-layer MLP plan (slow tier: deep chain, bigger ring)
+# ---------------------------------------------------------------------------
+
+
+def test_bsgs_mlp_scorer(setup):
+    # Layer-1 BSGS leaves hidden unit j in slot j and zeros above — the
+    # layer-2 plan composes with NO layout change. The scorer must be
+    # bit-equal to its unhoisted twin, agree with the per-class-ladder
+    # MlpScorer to CKKS noise, and match the plaintext circuit.
+    from hefl_tpu.ckks.keys import gen_relin_key
+
+    ctx = CkksContext.create(n=512, num_primes=5)
+    sk, pk = keygen(ctx, jax.random.key(70))
+    rlk = gen_relin_key(ctx, sk, jax.random.key(71))
+    rng = np.random.default_rng(72)
+    d, hidden, num_classes = 16, 4, 3
+    x = rng.normal(0, 0.4, d)
+    w1 = rng.normal(0, 0.3, (hidden, d))
+    b1 = rng.normal(0, 0.2, hidden)
+    w2 = rng.normal(0, 0.3, (num_classes, hidden))
+    b2 = rng.normal(0, 0.2, num_classes)
+
+    slots = encoding.num_slots(ctx.ntt)
+    plan1, plan2 = hei.bsgs_mlp_plans(slots, d, hidden, num_classes)
+    gks1 = _step_keys(ctx, sk, plan1.rotation_steps_needed, 73)
+    sub = hei.mlp_sub_context(ctx, 2)
+    sub_sk = hei.slice_secret_key(sk, sub.num_primes)
+    gks2 = _step_keys(sub, sub_sk, plan2.rotation_steps_needed, 74)
+
+    ct = hei.encrypt_features(ctx, pk, x, jax.random.key(75))
+    scorer = hei.BsgsMlpScorer(ctx, w1, b1, w2, b2, gks1, rlk, gks2)
+    assert scorer.sub_ctx.num_primes == sub.num_primes
+    out = scorer.score(ct)
+    got = hei.decrypt_class_scores(scorer.sub_ctx, sub_sk, out, num_classes)
+    want = ((x @ w1.T + b1) ** 2) @ w2.T + b2
+    np.testing.assert_allclose(got, want, atol=0.05)
+    assert np.argmax(got) == np.argmax(want)
+
+    twin = hei.BsgsMlpScorer(
+        ctx, w1, b1, w2, b2, gks1, rlk, gks2, rotation_mode="unhoisted"
+    )
+    out_u = twin.score(ct)
+    np.testing.assert_array_equal(np.asarray(out.c0), np.asarray(out_u.c0))
+    np.testing.assert_array_equal(np.asarray(out.c1), np.asarray(out_u.c1))
+    assert out.scale == out_u.scale
+
+    # Against the per-class hidden-ladder MlpScorer: same circuit, wildly
+    # different rotation program — decrypt-level agreement only.
+    lad_gks = hei.gen_rotation_keys(ctx, sk, jax.random.key(76))
+    ladder = hei.MlpScorer(ctx, w1, b1, w2, b2, lad_gks, rlk)
+    got_l = hei.decrypt_scores(ladder.sub_ctx, sub_sk, ladder.score(ct))
+    np.testing.assert_allclose(got, got_l, atol=0.05)
+
+    # The structural win the bench prints: composition costs one relin
+    # key-switch on top of the two plans, fewer than the per-class ladder.
+    assert scorer.num_keyswitches == (
+        plan1.num_keyswitches + plan2.num_keyswitches + 1
+    )
+    assert scorer.num_keyswitches < hei.ladder_keyswitches(slots, hidden)
+    assert scorer.hoisted_ntts < scorer.unhoisted_ntts
+
+
+# ---------------------------------------------------------------------------
+# Fused product kernel parity (slow tier: tileable ring, interpret mode)
+# ---------------------------------------------------------------------------
+
+
+def test_hoisted_products_pallas_parity():
+    # The fused digit x key accumulation must be BIT-equal to the XLA
+    # graph on a tileable ring — zero-seeded add_mod accumulation is exact
+    # on canonical residues. n=1024 is the smallest ring the kernel
+    # accepts (n//128 >= 8); interpret mode keeps this on CPU CI.
+    from hefl_tpu.ckks import pallas_ntt
+
+    ctx = CkksContext.create(n=1024)
+    ntt = ctx.ntt
+    assert pallas_ntt.supported(ntt)
+    num_l = ctx.num_primes
+    num_r = num_l * ctx.ksk_num_digits
+    num_s = 3
+    p_np = np.asarray(ntt.p)[:, 0].astype(np.uint32)
+    rng = np.random.default_rng(80)
+
+    def canon(*shape):
+        raw = rng.integers(0, 2**31, (*shape, num_l, ctx.n)).astype(np.uint32)
+        return jnp.asarray(raw % p_np[:, None])
+
+    b_mont = canon(num_s, num_r)
+    a_mont = canon(num_s, num_r)
+    for batch in ((), (2,)):
+        c0 = canon(*batch)
+        d_eval = canon(*batch, num_r)
+        want0, want1 = ops._hoisted_products_xla(ctx, c0, d_eval, b_mont, a_mont)
+        got0, got1 = pallas_ntt.hoisted_rotations_pallas(
+            ntt, c0, d_eval, b_mont, a_mont, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(got0), np.asarray(want0))
+        np.testing.assert_array_equal(np.asarray(got1), np.asarray(want1))

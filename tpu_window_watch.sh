@@ -7,7 +7,7 @@
 cd /root/repo
 MAX_HOURS=${MAX_HOURS:-10}
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
-want="stage1.done seed0.done seed1.done seed2.done stage3.done stage4.done stage5.done stage6.done stage7.done stage8.done stage9.done"
+want="stage1.done seed0.done seed1.done seed2.done stage3.done stage4.done stage5.done stage6.done stage7.done stage8.done stage9.done stage10.done"
 
 complete() {
   # stageN.skip counts as resolved (e.g. stage 1's parity gate failing
