@@ -99,6 +99,13 @@ class PackedSpec:
     # flat vector. None = the historical scalar grid, bit-for-bit.
     clips: "tuple[float, ...] | None" = None
     spans: "tuple[int, ...] | None" = None
+    # Error-feedback quantization (ISSUE 19): the upload paths quantize
+    # `update + residual` and return the new residual (pack_quantized_
+    # flat_ef) instead of the plain one-shot grid. Geometry is UNCHANGED
+    # — EF codes live in the same [-qmax, qmax] alphabet — the flag only
+    # selects the residual-carrying quantizer and makes the producers
+    # thread the per-client residual state.
+    error_feedback: bool = False
 
     @classmethod
     def for_params(
@@ -162,6 +169,7 @@ class PackedSpec:
             error_budget=quantize.quant_error_budget(cfg),
             clips=clips,
             spans=spans,
+            error_feedback=bool(cfg.error_feedback),
         )
 
     @property
@@ -204,6 +212,7 @@ class PackedSpec:
             "n_ct": self.n_ct,
             "n_ct_unpacked": self.base.n_ct,
             "error_budget": self.error_budget,
+            "error_feedback": self.error_feedback,
         }
 
 
@@ -317,15 +326,55 @@ def pack_quantized_flat(
     steps = step_vector(spec)
     step = spec.step if steps is None else jnp.asarray(steps)
     sat = quantize.saturation_count(flat, step, spec.bits)
-    u = (quantize.quantize(flat, step, spec.bits) + spec.offset).astype(
-        jnp.uint32
-    )
+    hi, lo = _interleave_codes(quantize.quantize(flat, step, spec.bits), spec)
+    return hi, lo, sat
+
+
+def _interleave_codes(
+    q: jax.Array, spec: PackedSpec
+) -> tuple[jax.Array, jax.Array]:
+    """int32 codes [total] -> (hi, lo) uint32[n_ct, n]: the shared integer
+    tail of the plain and error-feedback pack paths — offset to
+    non-negative, pad to k*n_ct blocks (padding carries code 0), reshape,
+    bit-interleave k blocks per packed row."""
+    from hefl_tpu.ckks import quantize
+
+    u = (q + spec.offset).astype(jnp.uint32)
     pad = spec.n_ct * spec.k * spec.n - spec.total
     if pad:
         u = jnp.concatenate([u, jnp.zeros((pad,), jnp.uint32)])
     u = u.reshape(spec.n_ct, spec.k, spec.n)
-    hi, lo = quantize.interleave_fields(u, spec.k, spec.field_bits, spec.guard)
-    return hi, lo, sat
+    return quantize.interleave_fields(u, spec.k, spec.field_bits, spec.guard)
+
+
+def pack_quantized_flat_ef(
+    flat: jax.Array, residual: jax.Array, spec: PackedSpec
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The error-feedback twin of `pack_quantized_flat` (ISSUE 19):
+    quantize `flat + residual` and return the NEW residual alongside the
+    wire pair — the caller carries it into the next round's pack.
+
+    -> ((hi, lo) uint32[n_ct, n], saturation int32, residual' f32[total]).
+    Identical wire geometry: EF codes are clipped to the same
+    [-qmax, qmax] alphabet, so the carry-free certificate and every
+    downstream path (fold, transcipher, decode) are untouched.
+    `saturation` counts coefficients whose CARRIED value clipped — under
+    EF a clipped coefficient parks its excess in the residual instead of
+    losing it, but the count still reports (sustained saturation means
+    the clip is wrong for this model and the residual grows without
+    bound; the on_overflow machinery must see it).
+    """
+    from hefl_tpu.ckks import quantize
+
+    steps = step_vector(spec)
+    step = spec.step if steps is None else jnp.asarray(steps)
+    carried = flat.astype(jnp.float32) + residual.astype(jnp.float32)
+    sat = quantize.saturation_count(carried, step, spec.bits)
+    q, new_residual = quantize.ef_quantize(
+        flat.astype(jnp.float32), residual, step, spec.bits
+    )
+    hi, lo = _interleave_codes(q, spec)
+    return hi, lo, sat, new_residual
 
 
 def pack_quantized_delta(
@@ -336,6 +385,20 @@ def pack_quantized_delta(
     base_flat, _ = ravel_pytree(base_params)
     return pack_quantized_flat(
         flat.astype(jnp.float32) - base_flat.astype(jnp.float32), spec
+    )
+
+
+def pack_quantized_delta_ef(
+    params: Any, base_params: Any, residual: jax.Array, spec: PackedSpec
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Quantize-and-pack one client's UPDATE with error feedback:
+    `residual` is the client's carried f32[total] quantization error from
+    its previous upload; -> (hi, lo, saturation, residual')."""
+    flat, _ = ravel_pytree(params)
+    base_flat, _ = ravel_pytree(base_params)
+    return pack_quantized_flat_ef(
+        flat.astype(jnp.float32) - base_flat.astype(jnp.float32),
+        residual, spec,
     )
 
 

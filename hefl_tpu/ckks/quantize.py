@@ -99,6 +99,25 @@ class PackingConfig:
                   it; the margin covers the unpacked reference's own CKKS
                   decode error). Tests and the chaos gate assert against
                   whatever is declared here.
+    error_feedback:
+                  residual-carrying quantization (ISSUE 19): each client
+                  keeps a per-coefficient residual, adds it to the update
+                  BEFORE quantizing, and stores back the quantization
+                  error (`ef_quantize`). The signal a b-bit grid cannot
+                  express in round r re-enters the quantizer in round
+                  r+1, so the MULTI-round quantization error stays O(step)
+                  instead of accumulating — which is what makes b in
+                  {2, 4} (and their ~2x deeper interleave from the same
+                  headroom formula) usable. The residual state lives in
+                  the STREAMING engine (fl.stream.StreamEngine holds the
+                  per-client rows across rounds; the batched one-shot
+                  round has nowhere to carry it and refuses). Refused in
+                  combination with dp: the residual carries one round's
+                  clipped-and-noised signal into the next upload, so a
+                  client's round-r data influences round r+1's release —
+                  per-round sensitivity accounting and cohort-subsampling
+                  amplification both break (same hazard class as
+                  staleness carry; fl.stream pins the refusal).
     """
 
     bits: int = 0
@@ -106,6 +125,7 @@ class PackingConfig:
     clip: "float | tuple[float, ...]" = 0.5
     guard_bits: int = 16
     error_budget: float = 0.0
+    error_feedback: bool = False
 
     def __post_init__(self):
         if self.bits and not 2 <= self.bits <= 16:
@@ -136,6 +156,12 @@ class PackingConfig:
                 f"PackingConfig.guard_bits={self.guard_bits}: need 4..30 "
                 "(too small loses low fields to decrypt noise; too large "
                 "starves the payload)"
+            )
+        if self.error_feedback and not self.bits:
+            raise ValueError(
+                "PackingConfig.error_feedback carries the QUANTIZER's "
+                "residual; it is meaningless without packing (bits=0) — "
+                "set bits (2 or 4 are the intended low-bit grids)"
             )
 
     @property
@@ -220,6 +246,29 @@ def quantize(x: jnp.ndarray, step, bits: int) -> jnp.ndarray:
 def dequantize(q: jnp.ndarray, step) -> jnp.ndarray:
     """int code -> float32 value on the quantization grid."""
     return q.astype(jnp.float32) * jnp.asarray(step, jnp.float32)
+
+
+def ef_quantize(
+    x: jnp.ndarray, residual: jnp.ndarray, step, bits: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Error-feedback quantization (ISSUE 19): quantize `x + residual` and
+    return the new residual — the part of the carried signal the b-bit
+    grid could not express this round.
+
+        q           = quantize(x + residual)         # int32 in [-qmax, qmax]
+        residual'   = (x + residual) - dequantize(q)
+
+    While the carried value stays inside the clip, |residual'| <= step/2;
+    a saturating coefficient parks its excess in the residual instead of
+    losing it, so the signal re-enters the quantizer next round. The codes
+    are CLIPPED exactly like the plain quantizer's, so the carry-free
+    interleave invariant (`certify_packing`) is untouched by error
+    feedback — the wire sees the same [-qmax, qmax] alphabet either way.
+    Jit-safe; `step` may be scalar or per-tensor broadcastable.
+    """
+    carried = x.astype(jnp.float32) + residual.astype(jnp.float32)
+    q = quantize(carried, step, bits)
+    return q, carried - dequantize(q, step)
 
 
 def saturation_count(x: jnp.ndarray, step, bits: int) -> jnp.ndarray:
@@ -384,12 +433,29 @@ def packing_sum_probe(
 
 def exact_int_probes() -> dict:
     """This module's declared exact-integer regions as shaped jaxpr probes
-    (analysis.lint walks them: no rem/div, no float contamination)."""
+    (analysis.lint walks them: no rem/div, no float contamination).
+
+    The `ef_interleave_fields` region (ISSUE 19) is the error-feedback
+    path's wire tail at the DEEPER low-bit grid EF exists to unlock
+    (b=4 -> 7-bit fields at C<=8, k=4): `ef_quantize`'s residual add is
+    float by construction, but its CODES are clipped to the same
+    [-qmax, qmax] alphabet as the plain quantizer's, so everything from
+    the non-negativity offset on is exact integers in the carry-free
+    band — the claim this region keeps statically watched.
+    """
     u = jnp.zeros((2, 4), jnp.uint32)
+
+    def ef_tail(q):
+        # q: EF-quantized codes (int32, |q| <= qmax(4) = 7 by clipping).
+        u4 = (q + qmax(4)).astype(jnp.uint32)   # [..., k, n] >= 0
+        return interleave_fields(u4, 4, 7, 5)
+
+    q4 = jnp.zeros((2, 4, 4), jnp.int32)
     return {
         "ckks.quantize.interleave_fields": (
             lambda v: interleave_fields(v, 2, 9, 5), (u,)
         ),
+        "ckks.quantize.ef_interleave_fields": (ef_tail, (q4,)),
     }
 
 
@@ -422,6 +488,7 @@ def describe(cfg: PackingConfig, modulus: int, clients: int) -> dict:
         "step": cfg.step,
         "payload_bits": payload_bits(modulus, guard_eff),
         "error_budget": quant_error_budget(cfg),
+        "error_feedback": bool(cfg.error_feedback),
         "clients": int(clients),
         "headroom_ok": guard_eff + k * fb
         <= min(modulus.bit_length() - 2, MAX_PACKED_BITS),
@@ -440,6 +507,7 @@ __all__ = [
     "exact_int_probes",
     "quantize",
     "dequantize",
+    "ef_quantize",
     "saturation_count",
     "interleave_fields",
     "packed_value_int64",

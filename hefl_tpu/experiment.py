@@ -318,6 +318,32 @@ def run_experiment(
             "crash injection without a write-ahead journal is just data "
             "loss; add journal_path (--journal-path) or serve (--serve)"
         )
+    ef_on = (
+        cfg.packing is not None
+        and cfg.packing.enabled
+        and getattr(cfg.packing, "error_feedback", False)
+    )
+    if ef_on and cfg.stream is None:
+        # The EF residual is CROSS-ROUND state only the streaming engine
+        # carries (fl.stream.StreamEngine._ef_residual); the batched
+        # one-shot round has nowhere to hold it — fl.secure refuses too,
+        # but this catches it before any dataset/compile work.
+        raise ValueError(
+            "PackingConfig.error_feedback requires the streaming engine's "
+            "cross-round residual state; add a stream config (--stream) "
+            "or drop error_feedback"
+        )
+    if ef_on and cfg.dp is not None:
+        # Mirrors fl.stream.run_round's refusal: the residual carries
+        # round r's clipped-and-noised signal into round r+1's upload,
+        # breaking per-round sensitivity accounting and the
+        # cohort-subsampling amplification.
+        raise ValueError(
+            "dp cannot be combined with error-feedback packing: the "
+            "residual gives a client cross-round influence the per-round "
+            "sensitivity accounting does not cover — drop error_feedback "
+            "for dp runs"
+        )
     hhe_on = cfg.stream is not None and cfg.stream.upload_kind == "hhe"
     if hhe_on and (cfg.packing is None or not cfg.packing.enabled):
         # The symmetric cipher lives in the PACKED integer domain: without

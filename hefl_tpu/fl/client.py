@@ -73,6 +73,27 @@ def _eval_metrics(module, params, x_u8, y_onehot):
         return cross_entropy(logits, y_onehot), accuracy(logits, y_onehot)
 
 
+def init_ef_residuals(template_params, num_clients: int) -> jnp.ndarray:
+    """Fresh error-feedback residual state (ISSUE 19): one f32 row per
+    REGISTERED client over the raveled parameter count, all zeros — the
+    first EF round quantizes the bare update, exactly like the plain
+    quantizer.
+
+    The residual is deliberately NOT a `ClientState` field: ClientState is
+    the carry of ONE round's local-training scan, rebuilt fresh at the
+    round's global weights every round, while the residual must survive
+    ACROSS rounds (it is the quantizer's memory, not the optimizer's).
+    `fl.stream.StreamEngine` owns the rows as cross-round state and
+    threads each cohort's slice through the upload program as a donated
+    traced input — the same donation discipline `local_train_epochs_jit`
+    applies to the optimizer state, for the same buffer-reuse reason.
+    """
+    from jax.flatten_util import ravel_pytree
+
+    flat, _ = ravel_pytree(template_params)
+    return jnp.zeros((int(num_clients), int(flat.size)), jnp.float32)
+
+
 def init_client_state(global_params) -> ClientState:
     """Fresh per-client training state at the round's global weights — the
     carry of the pure epoch program (and the unit a chunk-resumable driver
@@ -317,7 +338,7 @@ def hoist_streams(cfg: TrainConfig, backend: str) -> bool:
 
 def hoisted_streams_jit(
     fn, cfg: TrainConfig, x_index: int, key_index: int,
-    insert_after: int | None = None,
+    insert_after: int | None = None, donate_argnums=(),
 ):
     """Wrap a shard_map'd round body in the un-sharded stream hoist and
     jit it — the ONE wrapper all three round factories share, so the
@@ -328,7 +349,9 @@ def hoisted_streams_jit(
     the per-client train-key block the streams derive from; the secure
     factories insert after their enc-key block instead); `x_index` names
     the federated data array whose axis 1 is the per-client sample
-    count.
+    count. `donate_argnums` indexes the OUTER signature (without the two
+    inserted stream arrays) — used for pure carry buffers like the
+    error-feedback residual rows (ISSUE 19).
     """
     if insert_after is None:
         insert_after = key_index
@@ -341,7 +364,7 @@ def hoisted_streams_jit(
         rest = args[insert_after + 1:]
         return fn(*head, perms, aug, *rest)
 
-    return jax.jit(outer)
+    return jax.jit(outer, donate_argnums=tuple(donate_argnums))
 
 
 def _local_train_epochs_flat(

@@ -89,6 +89,10 @@ FSYNC_POLICIES = ("always", "commit", "never")
 _COMMIT_KINDS = frozenset(
     {"journal_open", "commit", "degrade", "round_close"}
 )
+# Group-commit batching cap (ISSUE 19): buffered frames are written out in
+# one write(2) no later than this many appends, bounding both the
+# in-process buffer and the window an external tail-reader lags behind.
+_GROUP_COMMIT_MAX = 256
 # Record kinds that belong to one round's lifecycle (everything but the
 # file header); recovery groups these by their "round" field.
 ROUND_KINDS = (
@@ -320,6 +324,23 @@ class JournalWriter:
     Use `open_journal` to construct: it scans (and repairs) an existing
     file so the chain resumes from the last intact frame, and writes the
     `journal_open` header on a fresh file.
+
+    **Group commit** (ISSUE 19, `group_commit=True`, the default): under
+    `fsync_policy="commit"` the writer BUFFERS encoded frames in process
+    and writes them in one `write(2)` at each transaction boundary
+    (commit / degrade / round_close / journal_open), immediately before
+    the boundary's single fsync — one syscall pair per transaction
+    instead of one write+flush per append. The hash chain still advances
+    per LOGICAL append (each digest is a pure function of the payload
+    sequence), so a group-committed journal is BYTE-IDENTICAL to the
+    unbatched writer's on the same record stream — the sha-equality twin
+    gate tests/test_journal.py pins. Durability is unchanged: the
+    "commit" contract only ever promised the platter at transaction
+    boundaries, and a crash mid-transaction loses at most the open
+    round's tail, which replay re-derives. A buffer that reaches
+    `_GROUP_COMMIT_MAX` frames is written out early (no fsync) so the
+    buffer stays bounded under fold storms. `always`/`never` policies
+    are never buffered.
     """
 
     def __init__(
@@ -327,6 +348,7 @@ class JournalWriter:
         path: str,
         fsync_policy: str | None = None,
         count_metrics: bool = True,
+        group_commit: bool = True,
     ):
         pol = fsync_policy or default_fsync_policy()
         if pol not in FSYNC_POLICIES:
@@ -339,8 +361,10 @@ class JournalWriter:
         # compaction's rewrite of surviving records passes False so the
         # telemetry doesn't inflate on every checkpoint.
         self.count_metrics = count_metrics
+        self.group_commit = bool(group_commit) and pol == "commit"
         self._chain = _CHAIN_SEED
         self._f = None
+        self._buf: list[bytes] = []
 
     def _open(self, chain: bytes) -> None:
         d = os.path.dirname(self.path)
@@ -348,6 +372,27 @@ class JournalWriter:
             os.makedirs(d, exist_ok=True)
         self._f = open(self.path, "ab")
         self._chain = chain
+
+    def _flush_buf(self, fsync: bool) -> None:
+        """Write all buffered frames in one write(2); optionally fsync.
+        The single write keeps the on-disk byte stream identical to the
+        per-append writer's (frames land whole and in order; a kill mid-
+        write leaves a torn SUFFIX that truncates to the last whole
+        frame, exactly like a torn single append)."""
+        if self._buf:
+            self._f.write(b"".join(self._buf))
+            self._f.flush()
+            self._buf.clear()
+            if self.count_metrics:
+                from hefl_tpu.obs import metrics as obs_metrics
+
+                obs_metrics.counter("journal.write_batches").inc()
+        if fsync:
+            os.fsync(self._f.fileno())
+            if self.count_metrics:
+                from hefl_tpu.obs import metrics as obs_metrics
+
+                obs_metrics.counter("journal.fsyncs").inc()
 
     def append(self, kind: str, fields: dict, body: bytes | None = None) -> dict:
         rec = {"kind": kind, **_canon(fields)}
@@ -359,13 +404,24 @@ class JournalWriter:
             + chain
             + payload
         )
-        self._f.write(frame)
-        self._f.flush()
         from hefl_tpu.obs import metrics as obs_metrics
 
         if self.count_metrics:
             obs_metrics.counter("journal.appends").inc()
             obs_metrics.counter("journal.bytes_written").inc(len(frame))
+        if self.group_commit:
+            # Chain advancement stays per LOGICAL append; only the
+            # write/flush/fsync syscalls batch to the transaction
+            # boundary.
+            self._buf.append(frame)
+            self._chain = chain
+            if kind in _COMMIT_KINDS:
+                self._flush_buf(fsync=True)
+            elif len(self._buf) >= _GROUP_COMMIT_MAX:
+                self._flush_buf(fsync=False)
+            return rec
+        self._f.write(frame)
+        self._f.flush()
         if self.fsync_policy == "always" or (
             self.fsync_policy == "commit" and kind in _COMMIT_KINDS
         ):
@@ -381,7 +437,10 @@ class JournalWriter:
         """Write only the first `nbytes` of the frame — the REAL torn
         record a kill mid-`write(2)` leaves (crash injection's mid_append
         point). The chain state is NOT advanced: this frame never
-        completed."""
+        completed. Buffered group-commit frames are written out first:
+        they logically precede the torn append, and a real kill mid-batch
+        tears the batch's SUFFIX — complete predecessors, one partial
+        tail — which is exactly this layout."""
         rec = {"kind": kind, **_canon(fields)}
         payload = _encode_payload(rec, body)
         chain = hashlib.sha256(self._chain + payload).digest()
@@ -392,12 +451,16 @@ class JournalWriter:
             + payload
         )
         nbytes = max(1, min(int(nbytes), len(frame) - 1))
+        if self._buf:
+            self._f.write(b"".join(self._buf))
+            self._buf.clear()
         self._f.write(frame[:nbytes])
         self._f.flush()
         os.fsync(self._f.fileno())
 
     def close(self) -> None:
         if self._f is not None:
+            self._flush_buf(fsync=False)
             self._f.close()
             self._f = None
 
@@ -406,6 +469,7 @@ def open_journal(
     path: str,
     fsync_policy: str | None = None,
     meta: dict | None = None,
+    group_commit: bool = True,
 ) -> tuple[JournalWriter, list[dict], int]:
     """Open (creating or recovering) a journal for appending.
 
@@ -413,8 +477,10 @@ def open_journal(
     a `journal_open` header carrying `meta` (the stream-config echo the
     server verifies on recovery); an existing file is scanned with torn-
     tail repair and the chain resumed from its last intact frame.
+    `group_commit=False` forces the historical one-write-per-append
+    writer (the sha-equality twin the load harness compares against).
     """
-    w = JournalWriter(path, fsync_policy)
+    w = JournalWriter(path, fsync_policy, group_commit=group_commit)
     if os.path.exists(path) and os.path.getsize(path) > 0:
         scan = scan_journal(path)
         torn = scan.torn_bytes

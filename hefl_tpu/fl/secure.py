@@ -39,6 +39,7 @@ from hefl_tpu.ckks.packing import (
     PackSpec,
     pack_pytree,
     pack_quantized_delta,
+    pack_quantized_delta_ef,
     unpack_blocks,
     unpack_quantized,
 )
@@ -259,6 +260,48 @@ def encrypt_stack_packed(
     )
 
 
+def encrypt_stack_packed_ef(
+    ctx: CkksContext,
+    pk: PublicKey,
+    p_out,
+    base_params,
+    enc_keys,
+    spec: PackedSpec,
+    residual_blk,
+    ct_shards: int = 1,
+) -> tuple[Ciphertext, jax.Array, jax.Array]:
+    """The error-feedback twin of `encrypt_stack_packed` (ISSUE 19): each
+    client's update is quantized THROUGH its carried residual
+    (`ckks.packing.pack_quantized_delta_ef`) and the new residual rows
+    come back as a third output for the engine's cross-round state.
+
+    `residual_blk` is f32[C, spec.total] (one residual row per client,
+    same client order as `p_out`). Wire geometry, encrypt core, and the
+    saturation slot are identical to the plain packed path — EF only
+    changes WHICH codes ride, never their alphabet.
+    -> (Ciphertext [C, spec.n_ct, L, N], saturation int32[C],
+    residual' f32[C, spec.total]).
+    """
+
+    def enc_one(prm, res):
+        hi, lo, sat, new_res = pack_quantized_delta_ef(
+            prm, base_params, res, spec
+        )
+        return encoding.encode_packed(ctx.ntt, hi, lo), sat, new_res
+
+    m_res, sat, new_res = jax.vmap(enc_one)(p_out, residual_blk)
+    n_ct = int(m_res.shape[1])
+    u, e0, e1 = jax.vmap(
+        lambda k: ops.encrypt_samples(ctx, k, (n_ct,))
+    )(enc_keys)
+    ct = _ct_sharded_encrypt_core(ctx, pk, m_res, u, e0, e1, ct_shards)
+    return (
+        Ciphertext(c0=ct.c0, c1=ct.c1, scale=spec.guard_scale),
+        sat,
+        new_res,
+    )
+
+
 def hhe_encrypt_stack(
     p_out,
     base_params,
@@ -286,6 +329,31 @@ def hhe_encrypt_stack(
         return w_hi, w_lo, sat
 
     return jax.vmap(enc_one)(p_out, hhe_keys)
+
+
+def hhe_encrypt_stack_ef(
+    p_out,
+    base_params,
+    hhe_keys: jax.Array,
+    round_index,
+    spec: PackedSpec,
+    residual_blk,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The error-feedback twin of `hhe_encrypt_stack` (ISSUE 19): the
+    symmetric cipher rides the EF-quantized codes and the new residual
+    rows come back for the engine's cross-round state. Keystream math and
+    the transcipher contract are untouched — EF changes the codes, not
+    the wire format. -> (w_hi, w_lo, saturation, residual')."""
+    from hefl_tpu.hhe import cipher as hhe_cipher
+
+    def enc_one(prm, key, res):
+        hi, lo, sat, new_res = pack_quantized_delta_ef(
+            prm, base_params, res, spec
+        )
+        w_hi, w_lo = hhe_cipher.stream_encrypt(hi, lo, key, round_index)
+        return w_hi, w_lo, sat, new_res
+
+    return jax.vmap(enc_one)(p_out, hhe_keys, residual_blk)
 
 
 def _pad_rows(arr: jax.Array, mult: int) -> jax.Array:
@@ -683,7 +751,7 @@ def client_upload_body(
     gp, pk, x_blk, y_blk, kt_blk, ke_blk,
     kd_blk=None, m_blk=None, po_blk=None,
     hhe_keys_blk=None, hhe_round=None, ct_shards: int = 1,
-    streams_blk=None,
+    streams_blk=None, ef_blk=None,
 ):
     """The per-client half of BOTH round programs: train -> dp sanitize
     (shares calibrated to dp_k) -> poison -> pack/encode/encrypt (+
@@ -709,8 +777,24 @@ def client_upload_body(
     (`_ct_sharded_encrypt_core`) — bitwise-identical uploads, NTT work
     divided by the shard count; the HHE symmetric cipher has no NTTs, so
     its leg ignores the knob.
-    -> (cts, mets, overflow, bits | None, p_out).
+
+    `ef_blk` (f32[cpd, packing.total], ISSUE 19) is the per-client
+    error-feedback residual block, REQUIRED when `packing.error_feedback`
+    — the streaming engine owns the cross-round rows and threads them in;
+    the batched one-shot round has nowhere to carry them, so an EF spec
+    without an `ef_blk` refuses at trace time rather than silently
+    quantizing without the residual.
+    -> (cts, mets, overflow, bits | None, p_out, ef_out | None).
     """
+    ef_on = packing is not None and getattr(packing, "error_feedback", False)
+    if ef_on and ef_blk is None:
+        raise ValueError(
+            "PackingConfig.error_feedback needs the per-client residual "
+            "rows (ef_blk), which only the STREAMING engine carries across "
+            "rounds (fl.stream.StreamEngine) — the batched one-shot round "
+            "has no cross-round state to hold them; run under a "
+            "StreamConfig or drop error_feedback"
+        )
     p_out, mets = train_block(
         module, cfg, gp, x_blk, y_blk, kt_blk, m_blk=m_blk, backend=backend,
         streams_blk=streams_blk,
@@ -733,21 +817,33 @@ def client_upload_body(
             p_out = jax.vmap(poison_tree)(p_out, po_blk)
     # Phase scope (obs): pack/encode/overflow-count + the encrypt core
     # are one hefl.encrypt trace bucket.
+    ef_out = None
     with jax.named_scope(obs_scopes.ENCRYPT):
         if hhe_keys_blk is not None:
             # Hybrid-HE symmetric upload: one PRF sweep + add per slot,
             # no CKKS work on the client (the repo's cheapest upload).
-            w_hi, w_lo, overflow = hhe_encrypt_stack(
-                p_out, gp, hhe_keys_blk, hhe_round, packing
-            )
+            if ef_on:
+                w_hi, w_lo, overflow, ef_out = hhe_encrypt_stack_ef(
+                    p_out, gp, hhe_keys_blk, hhe_round, packing, ef_blk
+                )
+            else:
+                w_hi, w_lo, overflow = hhe_encrypt_stack(
+                    p_out, gp, hhe_keys_blk, hhe_round, packing
+                )
             cts = (w_hi, w_lo)
         elif packing is not None:
             # Quantized bit-interleaved upload: k-fold fewer ciphertext
             # rows; `overflow` carries the quantizer saturation count
             # (same slot, same on_overflow machinery).
-            cts, overflow = encrypt_stack_packed(
-                ctx, pk, p_out, gp, ke_blk, packing, ct_shards=ct_shards
-            )                                          # [cpd, n_ct/k, ...]
+            if ef_on:
+                cts, overflow, ef_out = encrypt_stack_packed_ef(
+                    ctx, pk, p_out, gp, ke_blk, packing, ef_blk,
+                    ct_shards=ct_shards,
+                )
+            else:
+                cts, overflow = encrypt_stack_packed(
+                    ctx, pk, p_out, gp, ke_blk, packing, ct_shards=ct_shards
+                )                                      # [cpd, n_ct/k, ...]
         else:
             # Saturation diagnostic on exactly what gets encoded (the
             # packed blocks); XLA CSEs the duplicate pack with
@@ -763,7 +859,7 @@ def client_upload_body(
     if want_bits:
         with jax.named_scope(obs_scopes.SANITIZE):
             bits = exclusion_bits(cfg, gp, p_out, m_blk, overflow)
-    return cts, mets, overflow, bits, p_out
+    return cts, mets, overflow, bits, p_out, ef_out
 
 
 @functools.lru_cache(maxsize=32)
@@ -796,6 +892,18 @@ def _build_secure_round_fn(
     faulted run shares this one executable.
     """
 
+    if packing is not None and getattr(packing, "error_feedback", False):
+        # The batched round is ONE-SHOT: there is no cross-round state to
+        # carry the quantizer residual in, so an EF spec here would
+        # silently degenerate to plain low-bit quantization — exactly the
+        # accuracy loss EF exists to prevent. The streaming engine owns
+        # the residual rows (fl.stream.StreamEngine); refuse loudly.
+        raise ValueError(
+            "PackingConfig.error_feedback requires the streaming engine's "
+            "cross-round residual state (fl.stream); the batched secure "
+            "round cannot carry it — add a StreamConfig or drop "
+            "error_feedback"
+        )
     axes = client_axes(mesh)   # ("clients",) or ("hosts", "clients")
     n_dev = client_mesh_size(mesh)
     # In-round HE sharding (ISSUE 15): on a 2-D ("clients", "ct") mesh the
@@ -826,7 +934,7 @@ def _build_secure_round_fn(
         if dp is not None:
             kd_blk, i = rest[i], i + 1
         m_blk, po_blk = (rest[i], rest[i + 1]) if masked else (None, None)
-        cts, mets, overflow, bits, p_out = client_upload_body(
+        cts, mets, overflow, bits, p_out, _ = client_upload_body(
             module, cfg, backend, ctx, dp, dp_k, packing, masked,
             gp, pk, x_blk, y_blk, kt_blk, ke_blk,
             kd_blk=kd_blk, m_blk=m_blk, po_blk=po_blk,

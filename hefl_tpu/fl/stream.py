@@ -184,6 +184,45 @@ class OnlineAccumulator:
         self.folded += 1
         return True
 
+    def fold_batch(self, nonces, c0_batch, c1_batch) -> int:
+        """Fold a BATCH of arrivals in one vectorized dispatch (ISSUE 19,
+        the server hot path at load): sum the batch's uint32 rows in int64
+        and take ONE modular reduction, then fold the batch sum into the
+        running accumulator.
+
+        BITWISE-equal to folding the same uploads one at a time in any
+        order: every row is a canonical residue < p < 2**32, so the int64
+        batch sum is exact for any realistic batch (< 2**31 rows) and
+        `((a % p) + (b % p)) % p == (a + b) % p` — associativity of
+        modular addition is the same fact the one-at-a-time fold's
+        equality with the batched psum already rests on (pinned by
+        tests/test_stream.py). Duplicate nonces — against the window AND
+        within the batch — are rejected idempotently exactly like
+        `fold`'s, first occurrence wins. -> number of uploads folded.
+        """
+        fresh_rows = []
+        for i, nonce in enumerate(nonces):
+            if nonce in self._nonces:
+                self.duplicates += 1
+                continue
+            self._nonces.add(nonce)
+            fresh_rows.append(i)
+        if not fresh_rows:
+            return 0
+        idx = np.asarray(fresh_rows, dtype=np.int64)
+        b0 = np.asarray(c0_batch, dtype=np.int64)[idx]
+        b1 = np.asarray(c1_batch, dtype=np.int64)[idx]
+        s0 = (b0.sum(axis=0) % self.p).astype(np.uint32)
+        s1 = (b1.sum(axis=0) % self.p).astype(np.uint32)
+        if self._c0 is None:
+            z = np.zeros_like(s0)
+            self._c0, self._c1 = self._add(z, s0), self._add(z, s1)
+        else:
+            self._c0 = self._add(self._c0, s0)
+            self._c1 = self._add(self._c1, s1)
+        self.folded += len(fresh_rows)
+        return len(fresh_rows)
+
     def value(self, like_shape=None) -> tuple[np.ndarray, np.ndarray]:
         """The running sum (canonical residues); zeros of `like_shape` when
         nothing folded (the encryption-of-zero an empty round yields)."""
@@ -278,24 +317,45 @@ class DedupWindow:
     cross-round state: a failed round must leave the previous window
     untouched for the retry). Serialization for the journal's round_close
     record is plain iteration (sorted nonce pairs).
+
+    `peak_entries` (ISSUE 19) is the high-water mark of live nonces over
+    the window's whole lineage — `advanced` carries it forward, so a
+    multi-day run's peak survives every round boundary. The documented
+    bound is (tau + 2) x cohort: tau + 2 distinct origin rounds can be
+    live at once (the commit round plus tau + 1 trailing), each
+    contributing at most one nonce per cohort client. The engine surfaces
+    it through the `stream.dedup_window_peak` gauge; the load harness
+    (fl.load) asserts the bound at 10^5-client scale.
     """
 
-    __slots__ = ("_nonces",)
+    __slots__ = ("_nonces", "_peak")
 
-    def __init__(self, nonces=()):
+    def __init__(self, nonces=(), peak: int = 0):
         self._nonces = {tuple(n) for n in nonces}
+        self._peak = max(int(peak), len(self._nonces))
 
     def advanced(self, round_index: int, tau: int) -> "DedupWindow":
         """The window as round `round_index` sees it: expired nonces
         (older than the duplicate-reachability horizon tau + 1) evicted,
-        live ones all kept. A new instance — transactional."""
+        live ones all kept. A new instance — transactional; the lineage
+        peak carries forward."""
         return DedupWindow(
-            n for n in self._nonces
-            if int(round_index) - int(n[1]) <= int(tau) + 1
+            (
+                n for n in self._nonces
+                if int(round_index) - int(n[1]) <= int(tau) + 1
+            ),
+            peak=self._peak,
         )
+
+    @property
+    def peak_entries(self) -> int:
+        """High-water mark of live nonces over this window's lineage."""
+        return self._peak
 
     def add(self, nonce) -> None:
         self._nonces.add(tuple(nonce))
+        if len(self._nonces) > self._peak:
+            self._peak = len(self._nonces)
 
     def __contains__(self, nonce) -> bool:
         return tuple(nonce) in self._nonces
@@ -345,6 +405,12 @@ def _build_upload_fn(
     the server-side transcipher instead of ciphertext residues. The round
     counter is TRACED, so every round of an experiment shares this one
     executable (the no-new-compile guarantee, pinned in tests/test_hhe.py).
+
+    An error-feedback spec (`packing.error_feedback`, ISSUE 19) appends
+    ONE more traced input — the per-client residual rows f32[C, total],
+    sharded with the client axis — and one more output, the new residual
+    rows. The engine owns the rows across rounds and donates the input
+    buffer (the residual is pure carry state, like the optimizer's).
     """
     from hefl_tpu.fl.fusion import resolve_fusion_backend
     from hefl_tpu.fl.secure import client_upload_body
@@ -355,6 +421,7 @@ def _build_upload_fn(
     ct_shards = ct_shard_count(mesh)
     backend = resolve_fusion_backend(cfg.client_fusion, module)
     dp_k = calibration_clients(dp, num_clients) if dp is not None else 0
+    ef = packing is not None and getattr(packing, "error_feedback", False)
     # Hoisted shuffle streams (ISSUE 15): the permutation sort must lower
     # OUTSIDE the manual-sharding region — see client.epoch_index_streams.
     from hefl_tpu.fl.client import hoist_streams, hoisted_streams_jit
@@ -373,13 +440,16 @@ def _build_upload_fn(
         hk_blk = hhe_round = None
         if hhe:
             hk_blk, hhe_round = rest[i + 2], rest[i + 3]
-        cts, mets, overflow, bits, _ = client_upload_body(
+        ef_blk = rest[-1] if ef else None
+        cts, mets, overflow, bits, _, ef_out = client_upload_body(
             module, cfg, backend, ctx, dp, dp_k, packing, True,
             gp, pk, x_blk, y_blk, kt_blk, ke_blk,
             kd_blk=kd_blk, m_blk=m_blk, po_blk=po_blk,
             hhe_keys_blk=hk_blk, hhe_round=hhe_round, ct_shards=ct_shards,
-            streams_blk=streams_blk,
+            streams_blk=streams_blk, ef_blk=ef_blk,
         )
+        if ef:
+            return cts, mets, overflow, bits, ef_out
         return cts, mets, overflow, bits
 
     in_specs = (P(), P(), P(axes), P(axes), P(axes), P(axes))
@@ -392,18 +462,35 @@ def _build_upload_fn(
         # Per-client keys shard with the client axis; the round counter is
         # a replicated scalar.
         in_specs = in_specs + (P(axes), P())
+    if ef:
+        in_specs = in_specs + (P(axes),)   # EF residual rows (LAST arg)
+    out_specs = (P(axes), P(axes), P(axes), P(axes))
+    if ef:
+        out_specs = out_specs + (P(axes),)
     fn = shard_map(
         body,
         mesh=mesh,
         in_specs=in_specs,
-        out_specs=(P(axes), P(axes), P(axes), P(axes)),
+        out_specs=out_specs,
         check_vma=False,
     )
     if not hoist:
-        return jax.jit(fn)
+        # The EF residual is pure carry state: donate its buffer like the
+        # optimizer state's (it is consumed and replaced every round).
+        # It is the LAST positional argument by construction.
+        return jax.jit(
+            fn, donate_argnums=(len(in_specs) - 1,) if ef else ()
+        )
     # Streams derive from the train keys (arg 4) and insert after the
     # enc keys (arg 5) — one shared wrapper, see client.hoisted_streams_jit.
-    return hoisted_streams_jit(fn, cfg, x_index=2, key_index=4, insert_after=5)
+    # The hoist wrapper inserts the two stream arrays mid-signature; the
+    # EF residual stays the OUTER signature's last argument (the hoist
+    # wrapper passes it through), so its donation index is outer-arg
+    # count - 1: len(in_specs) - 2 before the streams are inserted.
+    return hoisted_streams_jit(
+        fn, cfg, x_index=2, key_index=4, insert_after=5,
+        donate_argnums=(len(in_specs) - 3,) if ef else (),
+    )
 
 
 def produce_uploads(
@@ -424,6 +511,7 @@ def produce_uploads(
     hhe=None,
     round_index: int = 0,
     cohort=None,
+    ef_residual=None,
 ):
     """Train every client and return its ENCRYPTED upload, per client.
 
@@ -457,6 +545,13 @@ def produce_uploads(
     what makes the HHE-vs-direct parity gate hold by construction.
     `round_index` keys the keystream counter (traced — no recompile per
     round).
+
+    `ef_residual` (f32[num_clients, total], ISSUE 19) is REQUIRED when
+    `packing.error_feedback` is set: the per-client quantization residual
+    rows the engine carries across rounds. Each client's residual is
+    added to its update before quantizing at the low-bit grid and the
+    new residual is RETURNED as a fifth output (cohort-rowed in cohort
+    mode), to be scattered back into the engine's full-registry carry.
     """
     n_dev = client_mesh_size(mesh)
     num_clients, pad_idx, prepadded = _round_geometry(
@@ -474,6 +569,16 @@ def produce_uploads(
             "stream cipher; add a PackingConfig (the symmetric cipher "
             "lives in the packed integer domain)"
         )
+    ef = packing is not None and getattr(packing, "error_feedback", False)
+    if ef and ef_residual is None:
+        raise ValueError(
+            "PackingConfig.error_feedback needs the per-client residual "
+            "rows (ef_residual) the StreamEngine carries across rounds — "
+            "pass f32[num_clients, total] (zeros on round 0; see "
+            "fl.client.init_ef_residuals)"
+        )
+    if ef:
+        ef_residual = jnp.asarray(ef_residual, jnp.float32)
     if dp is None:
         k_train, k_enc = jax.random.split(key)
         dp_keys = None
@@ -572,7 +677,11 @@ def produce_uploads(
         args = args + (jnp.asarray(part_g), jnp.asarray(pois_g))
         if hhe is not None:
             args = args + (hhe_keys, jnp.uint32(round_index))
-        cts, mets, overflow, bits = fn(*args)
+        if ef:
+            args = args + (ef_residual[gidx],)
+        out = fn(*args)
+        cts, mets, overflow, bits = out[:4]
+        ef_tail = (out[4][:n_c],) if ef else ()
         if hhe is not None:
             w_hi, w_lo = cts
             return (
@@ -580,13 +689,13 @@ def produce_uploads(
                 mets[:n_c],
                 overflow[:n_c],
                 bits[:n_c],
-            )
+            ) + ef_tail
         return (
             Ciphertext(c0=cts.c0[:n_c], c1=cts.c1[:n_c], scale=cts.scale),
             mets[:n_c],
             overflow[:n_c],
             bits[:n_c],
-        )
+        ) + ef_tail
     part, pois = _mask_inputs(num_clients, participation, poison, pad_idx)
     if pad_idx is not None:
         train_keys, enc_keys = train_keys[pad_idx], enc_keys[pad_idx]
@@ -596,6 +705,8 @@ def produce_uploads(
             hhe_keys = hhe_keys[pad_idx]
         if not prepadded:
             xs, ys = xs[pad_idx], ys[pad_idx]
+        if ef:
+            ef_residual = ef_residual[pad_idx]
     fn = _build_upload_fn(
         module, cfg, mesh, ctx, dp, num_clients, packing, hhe is not None
     )
@@ -605,7 +716,11 @@ def produce_uploads(
     args = args + (part, pois)
     if hhe is not None:
         args = args + (hhe_keys, jnp.uint32(round_index))
-    cts, mets, overflow, bits = fn(*args)
+    if ef:
+        args = args + (ef_residual,)
+    out = fn(*args)
+    cts, mets, overflow, bits = out[:4]
+    ef_tail = (out[4][:num_clients],) if ef else ()
     if hhe is not None:
         w_hi, w_lo = cts
         return (
@@ -613,7 +728,7 @@ def produce_uploads(
             mets[:num_clients],
             overflow[:num_clients],
             bits[:num_clients],
-        )
+        ) + ef_tail
     return (
         Ciphertext(
             c0=cts.c0[:num_clients], c1=cts.c1[:num_clients], scale=cts.scale
@@ -621,7 +736,7 @@ def produce_uploads(
         mets[:num_clients],
         overflow[:num_clients],
         bits[:num_clients],
-    )
+    ) + ef_tail
 
 
 def cohort_compare_record(
@@ -878,6 +993,14 @@ class StreamEngine:
         # Dedup nonce window, bounded to the duplicate-reachability
         # horizon (tau + 1 rounds past a nonce's origin) — see DedupWindow.
         self._seen: DedupWindow = DedupWindow()
+        # Error-feedback residual rows (ISSUE 19): f32[num_clients, total]
+        # per-client quantization error carried across rounds when
+        # PackedSpec.error_feedback is set. Lazily zero-initialized on the
+        # first EF round (the engine does not know the parameter count
+        # until it sees global_params); committed transactionally with
+        # _pending/_seen — a round that dies mid-execution leaves the
+        # previous residuals intact for the retry.
+        self._ef_residual: np.ndarray | None = None
 
     # -- deterministic retry timeline --------------------------------------
 
@@ -1077,6 +1200,25 @@ class StreamEngine:
                 "sensitivity and breaking cohort-subsampling amplification "
                 "— set host_staleness_rounds=0 for dp runs"
             )
+        ef_on = packing is not None and getattr(
+            packing, "error_feedback", False
+        )
+        if dp is not None and ef_on:
+            # Same hazard class as the staleness carries above, one layer
+            # down: the EF residual carries round r's clipped-and-noised
+            # signal INTO round r+1's upload, so a client's round-(r+1)
+            # contribution is no longer a function of only its round-(r+1)
+            # data — per-round sensitivity accounting and the
+            # cohort-subsampling amplification both break. Until an
+            # EF-aware accountant exists, refuse loudly.
+            raise ValueError(
+                "dp cannot be combined with error-feedback packing "
+                "(PackedSpec.error_feedback): the residual carries round "
+                "r's signal into round r+1's upload, giving a client "
+                "cross-round influence the per-round sensitivity "
+                "accounting does not cover and breaking cohort-subsampling "
+                "amplification — drop error_feedback for dp runs"
+            )
         n_dev = client_mesh_size(mesh)
         num_clients, _, _ = _round_geometry(xs, n_dev, num_real_clients)
         cohort = sample_cohort(s, round_index, num_clients)
@@ -1118,16 +1260,46 @@ class StreamEngine:
         # maps client index -> upload row. A full cohort (cohort_size=0 /
         # >= C) keeps the historical full-C shapes bit-for-bit.
         use_cohort = bool(s.cohort_only) and len(cohort) < num_clients
-        cts, mets_dev, overflow_dev, bits_dev = produce_uploads(
+        ef_full = None
+        if ef_on:
+            # Lazy zero-init of the cross-round residual carry — sized by
+            # the model's raveled parameter count, rows for the FULL
+            # registry (a cohort round gathers/scatters its rows).
+            from jax.flatten_util import ravel_pytree
+
+            total = int(ravel_pytree(global_params)[0].size)
+            if (
+                self._ef_residual is None
+                or self._ef_residual.shape != (num_clients, total)
+            ):
+                self._ef_residual = np.zeros(
+                    (num_clients, total), np.float32
+                )
+            ef_full = self._ef_residual
+        out = produce_uploads(
             module, cfg, mesh, ctx, pk, global_params, xs, ys, key,
             participation=part, poison=pois, dp=dp,
             num_real_clients=num_real_clients, packing=packing,
             hhe=hhe if hhe_mode else None, round_index=round_index,
             cohort=cohort if use_cohort else None,
+            ef_residual=ef_full,
         )
+        cts, mets_dev, overflow_dev, bits_dev = out[:4]
+        ef_new = out[4] if ef_on else None
         rows = cohort if use_cohort else np.arange(num_clients)
         row_of = np.full(num_clients, -1, dtype=np.int64)
         row_of[rows] = np.arange(len(rows))
+        ef_next = None
+        if ef_on:
+            # Residuals update at PRODUCTION time, not on the fold/commit
+            # verdict: the client quantized its upload carrying the old
+            # residual, so the new residual is what its next upload must
+            # carry regardless of whether this one survived delivery —
+            # re-adding a dropped upload's error would double-count it if
+            # the carried upload later folds. Staged here, committed with
+            # the other cross-round state at the end of the round.
+            ef_next = ef_full.copy()
+            ef_next[rows] = np.asarray(ef_new, np.float32)
         hhe_rd = None
         if hhe_mode:
             # Server-side transciphering (hhe.transcipher): the arrived
@@ -1728,6 +1900,14 @@ class StreamEngine:
         self._pending = pending_next
         self._pending_tiers = pending_tiers_next
         self._seen = seen
+        if ef_on:
+            self._ef_residual = ef_next
+        # Peak dedup-window occupancy (ISSUE 19): gauged every round so a
+        # duplicate storm's memory high-water mark is observable against
+        # the (tau + 2) x cohort bound DedupWindow documents.
+        obs_metrics.gauge("stream.dedup_window_peak").set(
+            seen.peak_entries
+        )
 
         if committed:
             sum_c0, sum_c1 = acc.value(like_shape=row_shape)

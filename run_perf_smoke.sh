@@ -72,7 +72,14 @@
 #       smoke record — flat O(cohort) vs two-tier O(hosts) cross-host
 #       bytes at cohort 8-of-16 over 4 hosts — must clear the
 #       cohort/hosts*0.8 bytes-ratio floor with the committed aggregates
-#       bitwise-equal in every tested arrival order.
+#       bitwise-equal in every tested arrival order;
+#   (p) server hot path at load (ISSUE 19): the standalone BENCH_LOAD
+#       smoke trace (10**4 simulated clients, synthetic bodies, REAL
+#       journal/dedup/fold machinery) — group-commit journal sha-equal
+#       to the unbatched twin with fsyncs/round <= 1/10 of
+#       fsync_policy=always, vectorized fold ingest sha-equal to the
+#       sequential fold, dedup-window peak within the (tau+2)*cohort
+#       bound, and the folds/s + appends-per-fsync throughput floors.
 # Wired into run_tpu_suite.sh as stage 0 (cheap pre-stage, no backend
 # probe needed — both harnesses pin themselves to CPU in smoke mode).
 set -euo pipefail
@@ -313,6 +320,99 @@ print(
     f"dcn smoke OK: flat {rec['flat_dcn_bytes']}B vs hier "
     f"{rec['hier_dcn_bytes']}B = {ratio}x (floor {floor}x), "
     f"bitwise-equal across {len(rec['arrival_orders'])} arrival orders"
+)
+PY
+
+# (p) server hot path at load (ISSUE 19): the BENCH_LOAD smoke trace.
+# The module itself exits nonzero when any of its gates fail (group-
+# commit sha-equality, fsync ratio, batched-fold sha-equality, dedup
+# bound, EF geometry); the schema gate below adds the CI throughput
+# floors so a silent order-of-magnitude regression in the hot path
+# cannot ship with a green artifact.
+JAX_PLATFORMS=cpu python -m hefl_tpu.fl.load --smoke \
+  --out "$workdir/BENCH_LOAD_SMOKE.json" > "$workdir/load_smoke.out" || {
+  echo "PERF SMOKE FAILED: BENCH_LOAD gates (sha equality / fsync ratio):"
+  tail -20 "$workdir/load_smoke.out"
+  exit 1
+}
+python - "$workdir/BENCH_LOAD_SMOKE.json" <<'PY'
+import json
+import sys
+
+fail = []
+art = json.load(open(sys.argv[1]))
+rec = art.get("bench_load")
+if not isinstance(rec, dict):
+    fail.append("BENCH_LOAD: missing bench_load record")
+    rec = {}
+for field in ("config", "runs", "group_commit", "batched_fold", "dedup",
+              "fold_throughput", "recovery", "gather", "ef_packing", "ok"):
+    if rec.get(field) is None:
+        fail.append(f"BENCH_LOAD: bench_load.{field} missing/null")
+if rec.get("ok") is not True:
+    fail.append("BENCH_LOAD: harness gates not ok")
+g = rec.get("group_commit") or {}
+if g.get("sha_equal") is not True:
+    fail.append(
+        "BENCH_LOAD: group-commit journal NOT sha-equal to the "
+        "unbatched twin"
+    )
+ratio = g.get("fsync_ratio")
+if not (isinstance(ratio, (int, float)) and ratio <= 0.1):
+    fail.append(
+        f"BENCH_LOAD: grouped fsyncs/round ratio {ratio} exceeds the "
+        "1/10-of-always budget"
+    )
+runs = rec.get("runs") or {}
+grouped = runs.get("commit_grouped") or {}
+for name, run in runs.items():
+    for field in ("appends", "fsyncs", "fsyncs_per_round", "appends_per_s",
+                  "folds_per_s", "commit_latency_s", "dedup_window_peak",
+                  "sum_sha", "journal_bytes_sha"):
+        if run.get(field) is None:
+            fail.append(f"BENCH_LOAD: runs.{name}.{field} missing/null")
+# CI throughput floors (CPU smoke, deliberately conservative: the
+# observed hot path runs orders of magnitude above both).
+folds_s = grouped.get("folds_per_s") or 0
+if folds_s < 2000:
+    fail.append(
+        f"BENCH_LOAD: commit_grouped folds/s = {folds_s} below the 2000 "
+        "CPU floor — the vectorized ingest hot path regressed"
+    )
+appends = grouped.get("appends") or 0
+fsyncs = max(grouped.get("fsyncs") or 0, 1)
+if appends / fsyncs < 10:
+    fail.append(
+        f"BENCH_LOAD: {appends} appends over {fsyncs} fsyncs < 10 "
+        "appends/fsync — group commit is not actually batching"
+    )
+bf = rec.get("batched_fold") or {}
+if bf.get("sha_equal") is not True:
+    fail.append(
+        "BENCH_LOAD: batched fold ingest NOT sha-equal to sequential"
+    )
+dd = rec.get("dedup") or {}
+if not (isinstance(dd.get("peak"), int) and dd.get("ok") is True):
+    fail.append(
+        f"BENCH_LOAD: dedup window peak {dd.get('peak')} outside the "
+        f"(tau+2)*cohort bound {dd.get('bound')}"
+    )
+ef = rec.get("ef_packing") or {}
+if ef.get("bytes_ratio_ok") is not True or ef.get("certified") is not True:
+    fail.append(
+        "BENCH_LOAD: EF b=4 deeper-k geometry missing its bytes-ratio "
+        "<= 0.55 budget or its carry-free certification"
+    )
+if fail:
+    print("PERF SMOKE FAILED (LOAD stage):")
+    for f in fail:
+        print(" -", f)
+    sys.exit(1)
+print(
+    f"load smoke OK: {rec['config']['num_clients']} clients, "
+    f"folds/s={folds_s}, fsync_ratio={ratio} (budget 0.1), "
+    f"{appends} appends / {fsyncs} fsyncs, "
+    f"ef_bytes={ef.get('bytes_ratio_b4_vs_b8')} (budget 0.55)"
 )
 PY
 
@@ -734,7 +834,9 @@ print(
     "packing + bytes_on_wire rows present with the k-fold reduction and "
     ">=1.5x HE speedups, cohort_compare bitwise-equal with the >=2x "
     "cohort-only floor, BENCH_DCN flat-vs-hier ratio over the "
-    "cohort/hosts floor with arrival-order bitwise equality, hefl-lint "
-    "clean with analysis.violations=0 embedded in the run metrics"
+    "cohort/hosts floor with arrival-order bitwise equality, BENCH_LOAD "
+    "group-commit sha-equal under the fsync + throughput floors, "
+    "hefl-lint clean with analysis.violations=0 embedded in the run "
+    "metrics"
 )
 PY

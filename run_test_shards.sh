@@ -68,6 +68,19 @@ t0=$SECONDS
 HEFL_NTT=pallas-interpret python -m pytest -q -m "not slow" \
   tests/test_packing.py
 echo "== packing shard (pallas-interpret): $((SECONDS - t0))s"
+# EF-packing shard (ISSUE 19): the error-feedback deeper-k suite — the
+# EF quantizer (residual bound, telescoping, saturation parking), the
+# certified b<=4 interleave grid, the engine's cross-round residual
+# carry, the EF+DP refusal pins — plus the load-harness and journal
+# group-commit suites, re-run with every journal under fsync policy
+# "commit" (the shipped group-commit default, pinned explicitly so an
+# env-default drift cannot silently drop the batching path from CI).
+t0=$SECONDS
+HEFL_JOURNAL_FSYNC=commit python -m pytest -q -m "not slow" \
+  tests/test_packing.py tests/test_load.py tests/test_journal.py \
+  tests/test_stream.py \
+  -k "ef_ or error_feedback or group_commit or load or fold_batch or dedup_window_peak"
+echo "== EF-packing + load shard (fsync=commit): $((SECONDS - t0))s"
 # HHE shard (ISSUE 11): the hybrid-HE uplink suite — stream-cipher units,
 # transcipher-vs-direct parity, engine/journal integration, the static
 # gate — re-run under the Pallas-interpret NTT selector so the symmetric

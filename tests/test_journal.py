@@ -113,6 +113,105 @@ def test_invalid_fsync_env_fails_loud(tmp_path, monkeypatch):
     assert jr.JournalWriter(str(tmp_path / "j.wal")).fsync_policy == "never"
 
 
+# ---------------------------------------------------------- group commit
+
+
+def _drive_record_stream(path, group_commit):
+    """Same deterministic record stream through either writer flavor."""
+    base = obs_metrics.snapshot()
+    w, recs, torn = jr.open_journal(
+        path, "commit", meta={"twin": True}, group_commit=group_commit
+    )
+    assert recs == [] and torn == 0
+    for r in range(3):
+        w.append("round_open", {"round": r, "cohort": [0, 1, 2, 3]})
+        for i in range(40):
+            w.append(
+                "fold", {"round": r, "seq": i, "client": i % 4},
+                bytes([i % 251]) * 64,
+            )
+        w.append("commit", {"round": r, "surviving": 4})
+        w.append("round_close", {"round": r, "committed": True})
+    w.close()
+    return obs_metrics.snapshot_delta(base)
+
+
+def test_group_commit_sha_equal_twin_and_fsync_counting(tmp_path):
+    # ISSUE 19: the group-commit writer batches write/flush/fsync to the
+    # transaction boundaries, but the hash chain advances per LOGICAL
+    # append — so its journal is BYTE-identical to the historical
+    # one-write-per-append twin's on the same record stream.
+    gp, up = str(tmp_path / "g.wal"), str(tmp_path / "u.wal")
+    d_g = _drive_record_stream(gp, group_commit=True)
+    d_u = _drive_record_stream(up, group_commit=False)
+    with open(gp, "rb") as f:
+        g_bytes = f.read()
+    with open(up, "rb") as f:
+        u_bytes = f.read()
+    assert g_bytes == u_bytes
+    # Logical-append telemetry identical; fsyncs at the same boundaries
+    # (journal_open + 3 x (commit + round_close) = 7 under "commit").
+    assert d_g["journal.appends"] == d_u["journal.appends"] == 130
+    assert d_g["journal.fsyncs"] == d_u["journal.fsyncs"] == 7
+    assert d_g["journal.bytes_written"] == d_u["journal.bytes_written"]
+    # The grouped writer's physical writes batch to the boundaries: at
+    # most one batch per fsync boundary (the buffer never hit its cap).
+    assert d_g.get("journal.write_batches", 0) <= 7
+    assert d_u.get("journal.write_batches", 0) == 0
+    # The chain verifies end to end (strict read, no repair).
+    recs = jr.read_journal(gp)
+    assert len(recs) == 1 + 3 * 43
+    # group_commit is forced off for non-"commit" policies: "always"
+    # keeps its one-fsync-per-append durability contract.
+    wa = jr.JournalWriter(str(tmp_path / "b.wal"), "always",
+                          group_commit=True)
+    assert not wa.group_commit
+
+
+def test_group_commit_torn_batch_tail_truncates_to_whole_frame(tmp_path):
+    # Kill mid-batch (ISSUE 19 satellite): the buffered complete frames
+    # land first, the torn append is a partial TAIL — repair truncates to
+    # the last whole frame, the chain verifies, and appending resumes.
+    path = str(tmp_path / "g.wal")
+    w, _, _ = jr.open_journal(path, "commit", meta={})
+    w.append("round_open", {"round": 0, "cohort": [0]})
+    for i in range(5):
+        w.append("fold", {"round": 0, "seq": i, "client": 0}, b"y" * 32)
+    # mid-write(2) kill: complete predecessors + a 10-byte torn prefix
+    w.append_torn("fold", {"round": 0, "seq": 5, "client": 0}, b"y" * 32, 10)
+    w.close()
+    with pytest.raises(jr.JournalError, match="torn tail"):
+        jr.read_journal(path, repair=False)
+    recs = jr.read_journal(path, repair=True)
+    assert [r["kind"] for r in recs] == (
+        ["journal_open", "round_open"] + ["fold"] * 5
+    )
+    # the repaired journal resumes its chain for further appends
+    w2, recs2, torn2 = jr.open_journal(path, "commit")
+    assert len(recs2) == 7 and torn2 == 0
+    w2.append("commit", {"round": 0, "surviving": 5})
+    w2.close()
+    assert [r["kind"] for r in jr.read_journal(path)][-1] == "commit"
+
+
+def test_group_commit_buffer_cap_flushes_early(tmp_path):
+    # A fold storm past _GROUP_COMMIT_MAX appends must spill to disk
+    # (bounded buffer) without an fsync; the commit boundary still lands
+    # everything and the strict chain verifies.
+    base = obs_metrics.snapshot()
+    path = str(tmp_path / "g.wal")
+    w, _, _ = jr.open_journal(path, "commit", meta={})
+    n = jr._GROUP_COMMIT_MAX + 50
+    for i in range(n):
+        w.append("fold", {"round": 0, "seq": i, "client": 0})
+    w.append("commit", {"round": 0, "surviving": n})
+    w.close()
+    d = obs_metrics.snapshot_delta(base)
+    assert d["journal.fsyncs"] == 2   # journal_open + commit only
+    assert d.get("journal.write_batches", 0) >= 2   # cap spill + boundary
+    assert len(jr.read_journal(path)) == n + 2
+
+
 def test_crc_corruption_rejected(tmp_path):
     path = str(tmp_path / "j.wal")
     _write_sample(path)

@@ -1,0 +1,138 @@
+"""BENCH_LOAD harness tests (ISSUE 19 tentpole B).
+
+The load generator drives the REAL server machinery — JournalWriter,
+RoundSession, DedupWindow, OnlineAccumulator, cohort_gather_index — with
+synthetic ciphertext bodies, so these tests pin the harness itself: trace
+determinism, the group-commit sha-equality twin, the vectorized-fold
+equality, the dedup-window bound, the EF geometry gates, and the CLI
+artifact contract CI's perf-smoke stage schema-gates.
+"""
+
+import dataclasses
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from hefl_tpu.fl import journal as jr
+from hefl_tpu.fl.load import (
+    LoadConfig,
+    bench_load_record,
+    drive_trace,
+    ef_packing_record,
+    gather_record,
+    synthetic_rows,
+)
+
+TINY = LoadConfig(
+    num_clients=1_000, rounds=2, cohort_size=64, duplicate_clients=16,
+    stale_replays=8, seed=3,
+)
+
+
+def test_synthetic_rows_canonical_and_deterministic():
+    rows = synthetic_rows(8, seed=5)
+    assert rows.shape == (8, 2, 2, 64) and rows.dtype == np.uint32
+    p = np.array([2**27 - 39, 2**26 - 5], np.uint32).reshape(1, 2, 1)
+    assert np.all(rows < p)      # canonical residues: fold-able as-is
+    np.testing.assert_array_equal(rows, synthetic_rows(8, seed=5))
+    assert not np.array_equal(rows, synthetic_rows(8, seed=6))
+
+
+def test_drive_trace_sha_twins_and_policy_independence(tmp_path):
+    # The record stream is a pure function of the config — so the journal
+    # bytes (and the released sum) are identical across group-commit
+    # on/off AND across fsync policies; only the syscall counts differ.
+    runs = {}
+    for name, pol, grp in (
+        ("always", "always", False),
+        ("grouped", "commit", True),
+        ("unbatched", "commit", False),
+    ):
+        runs[name] = drive_trace(
+            TINY, str(tmp_path / f"{name}.jl"), pol, group_commit=grp
+        )
+    shas = {r["journal_bytes_sha"] for r in runs.values()}
+    sums = {r["sum_sha"] for r in runs.values()}
+    assert len(shas) == 1 and len(sums) == 1
+    # the journal parses strictly (intact chain) on every twin
+    recs = jr.read_journal(str(tmp_path / "grouped.jl"))
+    assert recs[0]["kind"] == "journal_open"
+    assert sum(r["kind"] == "commit" for r in recs) == TINY.rounds
+    # group commit batches fsyncs to the transaction boundaries
+    assert runs["grouped"]["fsyncs"] < runs["always"]["fsyncs"]
+    assert runs["grouped"]["fsyncs"] == runs["unbatched"]["fsyncs"]
+    # duplicate storm was actually exercised and deduped
+    assert runs["grouped"]["dedup_hits"] > 0
+    assert runs["grouped"]["dedup_bound_ok"]
+
+
+def test_drive_trace_batched_fold_sum_sha_equal(tmp_path):
+    seq = drive_trace(TINY, str(tmp_path / "s.jl"), "commit")
+    bat = drive_trace(
+        TINY, str(tmp_path / "b.jl"), "commit", fold_batched=True
+    )
+    assert bat["fold_batched"] and not seq["fold_batched"]
+    assert bat["sum_sha"] == seq["sum_sha"]
+    assert bat["folds"] == seq["folds"]
+
+
+def test_bench_load_record_tiny_gates_and_schema(tmp_path):
+    rec = bench_load_record(TINY, workdir=str(tmp_path))
+    assert rec["ok"] is True
+    g = rec["group_commit"]
+    assert g["sha_equal"] and g["fsync_ratio"] <= g["fsync_ratio_budget"]
+    assert rec["batched_fold"]["sha_equal"]
+    assert rec["dedup"]["peak"] <= rec["dedup"]["bound"]
+    # artifact schema the CI stage gates on
+    for k in ("config", "runs", "group_commit", "batched_fold", "dedup",
+              "fold_throughput", "recovery", "gather", "ef_packing", "ok"):
+        assert k in rec, k
+    run = rec["runs"]["commit_grouped"]
+    for k in ("appends", "fsyncs", "fsyncs_per_round", "appends_per_s",
+              "folds_per_s", "commit_latency_s", "dedup_window_peak",
+              "sum_sha", "journal_bytes_sha"):
+        assert k in run, k
+    assert set(run["commit_latency_s"]) == {"p50", "p95", "p99"}
+    assert run["folds_per_s"] > 0 and run["appends_per_s"] > 0
+    # recovery curve: scanning the full journal costs >= the half scan's
+    # records, monotone in length
+    recv = rec["recovery"]
+    assert len(recv) == 2 and recv[1]["records"] > recv[0]["records"]
+
+
+def test_gather_record_flat_in_registry_size():
+    # PR-15 residual: cohort_gather_index is O(cohort) — growing the
+    # registry 10x must not grow the gather cost with it (generous 50x
+    # slack absorbs timer noise; the real signal is orders of magnitude).
+    rows = gather_record(registry_sizes=(1_000, 10_000), cohort_size=64)
+    assert [r["registry"] for r in rows] == [1_000, 10_000]
+    assert all(r["cohort"] == 64 for r in rows)
+    assert rows[1]["gather_seconds"] <= rows[0]["gather_seconds"] * 50 + 1e-3
+
+
+def test_ef_packing_record_grid_and_budgets():
+    rec = ef_packing_record()
+    grid = rec["grid"]
+    assert grid["2"]["k"] > grid["4"]["k"] > grid["8"]["k"]
+    assert rec["certified"] and rec["bytes_ratio_ok"]
+    assert rec["bytes_ratio_b4_vs_b8"] <= 0.55
+    assert rec["fold_ratio_ok"]       # deeper k folds >= 1.5x faster
+
+
+@pytest.mark.slow
+def test_load_cli_writes_artifact_and_exits_zero(tmp_path):
+    out = tmp_path / "BENCH_LOAD_TINY.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "hefl_tpu.fl.load", "--smoke",
+         "--clients", "2000", "--out", str(out)],
+        capture_output=True, text=True, env=None,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    artifact = json.loads(out.read_text())
+    assert artifact["bench_load"]["ok"] is True
+    assert artifact["bench_load"]["config"]["num_clients"] == 2000
+    assert "metrics" in artifact
+    assert "ok=True" in proc.stdout

@@ -571,3 +571,132 @@ def test_folded_backward_keeps_bf16_between_layers():
     assert all(r[2] == "bfloat16" for r in selects), (
         f"inter-layer cotangents regressed to f32: {selects}"
     )
+
+
+# --------------------------------------------- error-feedback quantizer
+
+
+def test_ef_quantize_zero_residual_matches_plain():
+    # With an all-zero residual the EF quantizer IS the plain quantizer:
+    # same codes, and the returned residual is exactly the roundoff.
+    x = jax.random.normal(jax.random.key(3), (512,)) * 0.1
+    step = 0.5 / quantize.qmax(4)
+    q_plain = quantize.quantize(x, step, 4)
+    q_ef, res = quantize.ef_quantize(x, jnp.zeros_like(x), step, 4)
+    np.testing.assert_array_equal(np.asarray(q_ef), np.asarray(q_plain))
+    np.testing.assert_allclose(
+        np.asarray(res), np.asarray(x - quantize.dequantize(q_plain, step)),
+        rtol=0, atol=0,
+    )
+
+
+def test_ef_quantize_residual_bound_and_telescoping():
+    # Over R rounds: |residual| <= step/2 whenever the carried value stays
+    # inside the clip, and the sums TELESCOPE — the dequantized codes plus
+    # the final residual recover the true signal sum exactly (up to f32).
+    step = 0.5 / quantize.qmax(4)
+    key = jax.random.key(11)
+    res = jnp.zeros((512,))
+    dq_sum = np.zeros((512,), np.float64)
+    x_sum = np.zeros((512,), np.float64)
+    for r in range(8):
+        key, sub = jax.random.split(key)
+        x = jax.random.normal(sub, (512,)) * 0.05   # well inside the clip
+        q, res = quantize.ef_quantize(x, res, step, 4)
+        assert float(jnp.max(jnp.abs(res))) <= step / 2 + 1e-7
+        dq_sum += np.asarray(quantize.dequantize(q, step), np.float64)
+        x_sum += np.asarray(x, np.float64)
+    np.testing.assert_allclose(dq_sum + np.asarray(res), x_sum, atol=1e-5)
+
+
+def test_ef_quantize_saturation_parks_excess_in_residual():
+    # A coefficient past the clip saturates the code but KEEPS its excess
+    # in the residual (plain quantization would lose it permanently).
+    step = 0.5 / quantize.qmax(2)
+    x = jnp.array([0.9, -0.9, 0.1])
+    q, res = quantize.ef_quantize(x, jnp.zeros_like(x), step, 2)
+    assert int(q[0]) == quantize.qmax(2) and int(q[1]) == -quantize.qmax(2)
+    np.testing.assert_allclose(
+        np.asarray(res[:2]), [0.9 - 0.5, -0.9 + 0.5], atol=1e-6
+    )
+
+
+def test_ef_deeper_interleave_grid_certified_and_bytes_ratio():
+    # The ISSUE-19 (b, k, C) grid at C=8, guard=16 on the n=256 ring:
+    # max_interleave cross-checks the headroom formula against the jaxpr
+    # range certifier on every call, so these are certified carry-free.
+    from hefl_tpu.analysis import ranges
+
+    ctx = CkksContext.create(n=256)
+    q = ctx.modulus
+    ks = {b: quantize.max_interleave(q, b, 8, 16) for b in (2, 4, 8)}
+    assert ks[2] > ks[4] > ks[8] >= 2
+    for b, k in ks.items():
+        assert ranges.certify_packing(q, b, k, 8, 16).ok
+    # Bytes-on-wire ratio: ciphertext count scales as ceil(T / (k * n)).
+    total = 225_034
+    n_ct = {b: -(-total // (k * ctx.n)) for b, k in ks.items()}
+    assert n_ct[4] / n_ct[8] <= 0.55
+    assert n_ct[2] / n_ct[8] <= 0.55
+
+
+def test_packing_config_error_feedback_validation():
+    with pytest.raises(ValueError, match="error_feedback"):
+        PackingConfig(error_feedback=True)
+    cfg = PackingConfig(bits=4, error_feedback=True)
+    assert cfg.enabled and cfg.error_feedback
+    ctx = CkksContext.create(n=256)
+    assert quantize.describe(cfg, ctx.modulus, 8)["error_feedback"] is True
+
+
+def test_pack_quantized_flat_ef_same_wire_geometry(ctx_keys):
+    # EF packing at zero residual produces the SAME wire pair as the
+    # plain packer — the downstream fold/transcipher/decode paths cannot
+    # tell EF is on; only the caller-carried residual differs.
+    from hefl_tpu.ckks.packing import pack_quantized_flat_ef
+
+    ctx, sk, pk = ctx_keys
+    tmpl = {"w": jnp.zeros((700,)), "b": jnp.zeros((40,))}
+    cfg = PackingConfig(bits=4, clip=0.5, guard_bits=16, error_feedback=True)
+    spec = PackedSpec.for_params(tmpl, ctx, cfg, num_clients=8)
+    assert spec.error_feedback
+    flat = jax.random.normal(jax.random.key(5), (spec.total,)) * 0.1
+    hi_p, lo_p, sat_p = pack_quantized_flat(flat, spec)
+    hi_e, lo_e, sat_e, res = pack_quantized_flat_ef(
+        flat, jnp.zeros_like(flat), spec
+    )
+    np.testing.assert_array_equal(np.asarray(hi_e), np.asarray(hi_p))
+    np.testing.assert_array_equal(np.asarray(lo_e), np.asarray(lo_p))
+    assert int(sat_e) == int(sat_p)
+    assert float(jnp.max(jnp.abs(res))) <= spec.step / 2 + 1e-7
+
+
+def test_ef_b4_multiround_fidelity_within_budget():
+    # The declared fidelity budget (ISSUE 19): after R rounds the EF b=4
+    # CUMULATIVE mean update deviates from the true signal by at most
+    # step4/2 per coordinate (the telescoping residual bound) — while
+    # plain b=4's roundoff random-walks past that, which is exactly why
+    # the deeper-k wire needs error feedback to ride at b<=4.
+    bits, clients, rounds = 4, 4, 8
+    step = 0.5 / quantize.qmax(bits)
+    key = jax.random.key(23)
+    res = jnp.zeros((clients, 512))
+    cum_true = np.zeros((512,), np.float64)
+    cum_ef = np.zeros((512,), np.float64)
+    cum_plain = np.zeros((512,), np.float64)
+    for r in range(rounds):
+        key, sub = jax.random.split(key)
+        x = jax.random.normal(sub, (clients, 512)) * 0.08
+        q_ef, res = quantize.ef_quantize(x, res, step, bits)
+        q_pl = quantize.quantize(x, step, bits)
+        cum_true += np.asarray(jnp.mean(x, 0), np.float64)
+        cum_ef += np.asarray(
+            jnp.mean(quantize.dequantize(q_ef, step), 0), np.float64
+        )
+        cum_plain += np.asarray(
+            jnp.mean(quantize.dequantize(q_pl, step), 0), np.float64
+        )
+    err_ef = np.max(np.abs(cum_ef - cum_true))
+    err_plain = np.max(np.abs(cum_plain - cum_true))
+    assert err_ef <= step / 2 + 1e-6        # deterministic telescoping bound
+    assert err_ef < err_plain               # EF strictly beats plain b=4

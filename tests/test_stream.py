@@ -847,3 +847,163 @@ def test_engine_carried_tier_partial_folds_next_round_conserved():
     avg = decrypt_average(ctx, sk, ct1, None, spec, meta=s1.meta)
     for leaf in _leaves(avg):
         assert np.all(np.isfinite(leaf))
+
+
+# ------------------------------------- ISSUE 19: hot path + error feedback
+
+
+def _canonical_rows(n_rows, seed, shape=(2, 2, 64)):
+    p = np.array([2**27 - 39, 2**26 - 5], np.int64).reshape(1, 2, 1)
+    rng = np.random.default_rng(seed)
+    c0 = (rng.integers(0, 2**62, size=(n_rows,) + shape) % p).astype(np.uint32)
+    c1 = (rng.integers(0, 2**62, size=(n_rows,) + shape) % p).astype(np.uint32)
+    return p.reshape(2, 1), c0, c1
+
+
+def test_fold_batch_bitwise_equals_sequential_any_order():
+    # The vectorized ingest (ISSUE 19): fold_batch's int64 row-sum + one
+    # modular reduction is BITWISE-equal to one-at-a-time folds in any
+    # order, duplicates (cross-window and intra-batch) rejected the same.
+    p, c0, c1 = _canonical_rows(12, seed=7)
+    seq = OnlineAccumulator(p)
+    for i in range(12):
+        assert seq.fold(("c", i), c0[i], c1[i])
+    perm = np.random.default_rng(1).permutation(12)
+    bat = OnlineAccumulator(p)
+    # first batch: a permuted prefix, with an intra-batch duplicate
+    head = list(perm[:7]) + [int(perm[0])]
+    n = bat.fold_batch(
+        [("c", int(i)) for i in head], c0[head], c1[head]
+    )
+    assert n == 7 and bat.duplicates == 1
+    # second batch: the rest, plus a cross-window duplicate
+    tail = list(perm[7:]) + [int(perm[3])]
+    n = bat.fold_batch(
+        [("c", int(i)) for i in tail], c0[tail], c1[tail]
+    )
+    assert n == 5 and bat.duplicates == 2 and bat.folded == 12
+    s0, s1 = seq.value()
+    b0, b1 = bat.value()
+    assert ct_hash(s0, s1) == ct_hash(b0, b1)
+    # an all-duplicate batch folds nothing and leaves the sum untouched
+    assert bat.fold_batch([("c", 0), ("c", 1)], c0[:2], c1[:2]) == 0
+    b0b, b1b = bat.value()
+    assert ct_hash(b0b, b1b) == ct_hash(b0, b1)
+
+
+def test_engine_dedup_window_peak_bounded_under_duplicate_storm():
+    # ISSUE 19 satellite: the dedup window's high-water mark stays within
+    # the (tau + 2) x cohort reachability bound even under a duplicate
+    # storm, and the engine surfaces it via the stream.dedup_window_peak
+    # gauge after every committed round.
+    from hefl_tpu.obs import metrics as obs_metrics
+
+    num_clients, tau = 4, 2
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(61))
+    eng = StreamEngine(
+        StreamConfig(staleness_rounds=tau),
+        FaultConfig(seed=5, duplicate_clients=num_clients),
+    )
+    for r in range(3):
+        _, _, _, sm = eng.run_round(
+            model, CFG, mesh, ctx, pk, params, xs, ys,
+            jax.random.key(62 + r), r,
+        )
+        assert sm.committed and sm.duplicates > 0
+        peak = eng._seen.peak_entries
+        assert peak <= (tau + 2) * num_clients
+        assert obs_metrics.gauge("stream.dedup_window_peak").value == peak
+    assert eng._seen.peak_entries >= num_clients
+
+
+def test_engine_ef_round_carries_residual_cohort_rows_only():
+    # Tentpole A: the engine owns the per-client EF residual as
+    # cross-round state. A cohort round scatters residual updates ONLY
+    # into the sampled rows; the next round carries them forward.
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    sk, pk = keygen(ctx, jax.random.key(71))
+    pcfg = PackingConfig(bits=4, clip=0.5, guard_bits=16,
+                         error_feedback=True)
+    pspec = PackedSpec.for_params(params, ctx, pcfg, num_clients)
+    assert pspec.error_feedback
+    eng = StreamEngine(StreamConfig(cohort_size=2), None)
+    ct, mets, ov, s0 = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(72), 0,
+        packing=pspec,
+    )
+    assert s0.committed
+    res = eng._ef_residual
+    assert res is not None and res.shape[0] == num_clients
+    cohort = sample_cohort(eng.stream, 0, num_clients)
+    outside = np.setdiff1d(np.arange(num_clients), cohort)
+    assert np.any(res[cohort] != 0.0)       # quantization error was carried
+    assert not np.any(res[outside])         # unsampled rows untouched
+    # the carried residual stays within the quantizer's step/2 bound
+    assert float(np.max(np.abs(res))) <= pspec.step / 2 + 1e-6
+    avg = decrypt_average(
+        ctx, sk, ct, meta=s0.meta, packing=pspec, base_params=params
+    )
+    for leaf in _leaves(avg):
+        assert np.all(np.isfinite(leaf))
+    # round 1: the residual persists and keeps evolving
+    before = res.copy()
+    _, _, _, s1 = eng.run_round(
+        model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(73), 1,
+        packing=pspec,
+    )
+    assert s1.committed
+    assert not np.array_equal(eng._ef_residual, before)
+
+
+def test_engine_ef_dp_refused_and_missing_residual_refused():
+    # EF + DP is a privacy-accounting violation (cross-round influence)
+    # and must refuse loudly at the engine; produce_uploads without the
+    # engine-carried residual refuses too (EF is stream-engine-only).
+    from hefl_tpu.fl import DpConfig, produce_uploads
+
+    num_clients = 2
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(81))
+    pcfg = PackingConfig(bits=4, clip=0.5, guard_bits=16,
+                         error_feedback=True)
+    pspec = PackedSpec.for_params(params, ctx, pcfg, num_clients)
+    eng = StreamEngine(StreamConfig(), None)
+    with pytest.raises(ValueError, match="error-feedback"):
+        eng.run_round(
+            model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(82),
+            0, packing=pspec, dp=DpConfig(noise_multiplier=0.1),
+        )
+    with pytest.raises(ValueError, match="ef_residual"):
+        produce_uploads(
+            model, CFG, mesh, ctx, pk, params, xs, ys, jax.random.key(83),
+            packing=pspec,
+        )
+
+
+def test_cohort_refusal_names_both_escape_hatches():
+    # PR-15 residual (ISSUE 19 satellite): the nested-scan cohort refusal
+    # must name BOTH ways out — flat_scan=True (keep cohort training) and
+    # the --full-cohort-train CLI hatch (keep the nested layout).
+    from hefl_tpu.fl import produce_uploads
+
+    num_clients = 4
+    model, params, xs, ys = _setup(num_clients)
+    mesh = make_mesh(num_clients)
+    ctx = CkksContext.create(n=256)
+    _, pk = keygen(ctx, jax.random.key(85))
+    nested = dataclasses.replace(CFG, flat_scan=False)
+    with pytest.raises(ValueError) as ei:
+        produce_uploads(
+            model, nested, mesh, ctx, pk, params, xs, ys,
+            jax.random.key(86), cohort=np.array([0, 1]),
+        )
+    msg = str(ei.value)
+    assert "flat_scan=True" in msg and "--full-cohort-train" in msg
