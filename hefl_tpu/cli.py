@@ -106,6 +106,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "--no-events or HEFL_EVENTS=0 disables")
     p.add_argument("--no-events", action="store_const", const="",
                    dest="events")
+    p.add_argument("--span-trace", default=None, metavar="PATH",
+                   dest="span_trace",
+                   help="write every streaming round's lifecycle span tree "
+                        "(obs.spans: arrival/fold/ship/commit/recovery on "
+                        "the engine's virtual clock) as Chrome trace-viewer "
+                        "JSON (.gz honored); streaming runs only")
     p.add_argument("--json", action="store_true", help="emit history as JSON lines")
     p.add_argument("--dp-noise", type=float, default=0.0, metavar="SIGMA",
                    help="DP-FedAvg central noise multiplier (0 = off): clip "
@@ -553,6 +559,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         max_round_retries=args.max_round_retries,
         retry_backoff_s=args.retry_backoff,
         events_path=args.events,
+        span_trace_path=args.span_trace,
         mesh_ct=args.mesh_ct,
     )
 

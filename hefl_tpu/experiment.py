@@ -152,6 +152,13 @@ class ExperimentConfig:
     # "" = disabled for this run. HEFL_EVENTS=0 disables globally without
     # code changes (the test suite sets it).
     events_path: str | None = None
+    # Round-lifecycle span export (obs.spans, ISSUE 20): every streaming
+    # round's span tree (arrival/fold/ship/commit/recovery on the
+    # engine's virtual clock) written as ONE Chrome trace-viewer JSON
+    # (.gz honored) at the end of the run — the engine-side timeline
+    # rendered by the same tooling as device traces. Streaming runs
+    # only; None = no export.
+    span_trace_path: str | None = None
     # Durable aggregation service (fl.journal / fl.server): a write-ahead
     # round journal recording every streaming-engine transition, with
     # crash-anywhere recovery — on restart the server replays the journal,
@@ -694,6 +701,7 @@ def run_experiment(
         dp_sample_rate = cfg.stream.cohort_size / cfg.num_clients
 
     history: list[dict[str, Any]] = []
+    span_tracers: list[Any] = []   # one SpanTracer per streaming round
     for r in range(start_round, cfg.rounds):
         # Tracing (SURVEY.md §5): the reference brackets phases with
         # time.time()+print; we keep that (PhaseTimer below) and add a real
@@ -746,6 +754,18 @@ def run_experiment(
                                 )
                             )
                             meta = smeta.meta
+                            if cfg.span_trace_path:
+                                # The round's lifecycle span tree
+                                # (StreamEngine directly, or through the
+                                # journaled server's wrapped engine).
+                                tr = getattr(
+                                    engine, "last_spans", None
+                                ) or getattr(
+                                    getattr(engine, "engine", None),
+                                    "last_spans", None,
+                                )
+                                if tr is not None:
+                                    span_tracers.append(tr)
                         elif robust:
                             ct_sum, metrics, overflow, meta = (
                                 secure_fedavg_round(
@@ -1046,11 +1066,28 @@ def run_experiment(
 
     if server is not None:
         server.close()
+    span_trace = None
+    if cfg.span_trace_path and span_tracers:
+        from hefl_tpu.obs import spans as obs_spans
+
+        span_trace = obs_spans.export_chrome_trace(
+            cfg.span_trace_path, span_tracers
+        )
+        say(
+            f"span trace: {len(span_tracers)} round(s) -> {span_trace} "
+            "(Chrome trace-viewer / obs.trace loadable)"
+        )
+        obs_events.emit(
+            "span_trace", path=span_trace, rounds=len(span_tracers)
+        )
     obs_record = _finish_run_obs(metrics_base, rounds=len(history))
     return {
         "history": history,
         "final_metrics": history[-1] if history else None,
         "params": params,
+        # Round-lifecycle span export (ISSUE 20): the written trace path
+        # (None = not requested or no streaming rounds ran).
+        "span_trace": span_trace,
         # Durable-aggregation record (None = in-memory engine): journal
         # path, fsync policy, and what recovery found on startup.
         "journal": server.report() if server is not None else None,

@@ -78,6 +78,11 @@ from hefl_tpu.fl.faults import SimulatedCrash
 from hefl_tpu.fl.stream import OnlineAccumulator, ct_hash
 from hefl_tpu.obs import events as obs_events
 from hefl_tpu.obs import metrics as obs_metrics
+from hefl_tpu.obs import spans as obs_spans
+
+# dcn.ship_rtt_s histogram bounds (virtual seconds): commit point ->
+# partial landing at the root, per landed tier — delay + retry backoff.
+_SHIP_RTT_BUCKETS = (0.01, 0.1, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0)
 from hefl_tpu.parallel import dcn_link_names, host_of_clients
 
 # The injectable tier-crash boundaries, in tier-lifecycle order:
@@ -399,6 +404,7 @@ class HierarchicalAggregator:
             elif dup:
                 plan.append((send + 1e-6, False, False))
             w = self._writers[h]
+            tracer = obs_spans.current()
             landed_t = None
             cause = None
             for t, lost, retried in plan:
@@ -407,6 +413,12 @@ class HierarchicalAggregator:
                 if retried:
                     self.ship_retries += 1
                     obs_metrics.counter("dcn.retry.attempts").inc()
+                    if tracer is not None:
+                        # One span per retried delivery (== dcn.retry.
+                        # attempts); the first send rides the tier_ship
+                        # span below.
+                        tracer.add("ship_retry", float(t), host=int(h),
+                                   attempt=int(att), lost=bool(lost))
                 self.ship_log.append((h, att, float(t), bool(lost)))
                 if w is not None:
                     w.append("tier_ship", dict(
@@ -439,6 +451,25 @@ class HierarchicalAggregator:
             else:
                 self.ships_done_s = max(self.ships_done_s, landed_t)
                 obs_metrics.counter("dcn.ship.landed").inc()
+                # Commit point -> landing, per landed tier: the DCN leg
+                # of commit latency, queryable as p50/p95/p99.
+                obs_metrics.histogram(
+                    "dcn.ship_rtt_s", bounds=_SHIP_RTT_BUCKETS
+                ).observe(round(max(0.0, landed_t - float(t0)), 9))
+            if tracer is not None:
+                # One tier_ship span per shipped tier, landing or missing
+                # (== dcn.ship.landed + dcn.ship.missed): first send ->
+                # landing (or the last attempt, for a missed tier).
+                last_t = max((pt for pt, _l, _r in plan), default=send)
+                tracer.add(
+                    "tier_ship", send,
+                    landed_t if landed_t is not None else last_t,
+                    host=int(h), folded=int(tier.folded),
+                    attempts=int(self._ship_attempts[h]),
+                    landed=landed_t is not None,
+                    cause=(cause or "unreachable")
+                    if landed_t is None else None,
+                )
         self._sealed = True
 
     def take_late_partial(self, host: int):

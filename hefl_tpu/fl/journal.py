@@ -93,6 +93,10 @@ _COMMIT_KINDS = frozenset(
 # one write(2) no later than this many appends, bounding both the
 # in-process buffer and the window an external tail-reader lags behind.
 _GROUP_COMMIT_MAX = 256
+# journal.flush_latency_s histogram bounds (seconds): the durable
+# write+fsync pair at a flush point is syscall-scale work, so the healthy
+# regime is sub-millisecond on a local disk.
+_FLUSH_BUCKETS = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.25)
 # Record kinds that belong to one round's lifecycle (everything but the
 # file header); recovery groups these by their "round" field.
 ROUND_KINDS = (
@@ -379,20 +383,40 @@ class JournalWriter:
         per-append writer's (frames land whole and in order; a kill mid-
         write leaves a torn SUFFIX that truncates to the last whole
         frame, exactly like a torn single append)."""
+        import time as _time
+
+        from hefl_tpu.obs import metrics as obs_metrics
+        from hefl_tpu.obs import spans as obs_spans
+
+        tracer = obs_spans.current() if self.count_metrics else None
+        t0 = _time.perf_counter()
         if self._buf:
-            self._f.write(b"".join(self._buf))
-            self._f.flush()
+            nframes = len(self._buf)
+            if tracer is not None:
+                with tracer.measure("group_commit_flush", frames=nframes):
+                    self._f.write(b"".join(self._buf))
+                    self._f.flush()
+            else:
+                self._f.write(b"".join(self._buf))
+                self._f.flush()
             self._buf.clear()
             if self.count_metrics:
-                from hefl_tpu.obs import metrics as obs_metrics
-
                 obs_metrics.counter("journal.write_batches").inc()
         if fsync:
-            os.fsync(self._f.fileno())
+            if tracer is not None:
+                with tracer.measure("fsync"):
+                    os.fsync(self._f.fileno())
+            else:
+                os.fsync(self._f.fileno())
             if self.count_metrics:
-                from hefl_tpu.obs import metrics as obs_metrics
-
                 obs_metrics.counter("journal.fsyncs").inc()
+        if self.count_metrics and fsync:
+            # Flush latency: the durable write+fsync pair at a flush
+            # point — the journal's contribution to commit latency,
+            # queryable as p50/p95/p99 via Histogram.quantile.
+            obs_metrics.histogram(
+                "journal.flush_latency_s", bounds=_FLUSH_BUCKETS
+            ).observe(round(_time.perf_counter() - t0, 9))
 
     def append(self, kind: str, fields: dict, body: bytes | None = None) -> dict:
         rec = {"kind": kind, **_canon(fields)}
@@ -405,10 +429,18 @@ class JournalWriter:
             + payload
         )
         from hefl_tpu.obs import metrics as obs_metrics
+        from hefl_tpu.obs import spans as obs_spans
 
+        tracer = obs_spans.current() if self.count_metrics else None
         if self.count_metrics:
             obs_metrics.counter("journal.appends").inc()
             obs_metrics.counter("journal.bytes_written").inc(len(frame))
+        if tracer is not None:
+            # One point span per LOGICAL append (== journal.appends); the
+            # write(2)/fsync syscall spans come from _flush_buf / below.
+            t = tracer.wall()
+            tracer.add("journal_append", t, t, clock="wall", kind_=kind,
+                       bytes=len(frame))
         if self.group_commit:
             # Chain advancement stays per LOGICAL append; only the
             # write/flush/fsync syscalls batch to the transaction
@@ -425,9 +457,19 @@ class JournalWriter:
         if self.fsync_policy == "always" or (
             self.fsync_policy == "commit" and kind in _COMMIT_KINDS
         ):
-            os.fsync(self._f.fileno())
+            import time as _time
+
+            t0 = _time.perf_counter()
+            if tracer is not None:
+                with tracer.measure("fsync"):
+                    os.fsync(self._f.fileno())
+            else:
+                os.fsync(self._f.fileno())
             if self.count_metrics:
                 obs_metrics.counter("journal.fsyncs").inc()
+                obs_metrics.histogram(
+                    "journal.flush_latency_s", bounds=_FLUSH_BUCKETS
+                ).observe(round(_time.perf_counter() - t0, 9))
         self._chain = chain
         return rec
 

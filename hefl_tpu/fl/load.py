@@ -54,6 +54,7 @@ from hefl_tpu.fl.stream import (
     DedupWindow,
     OnlineAccumulator,
     ct_hash,
+    quorum_count,
     sample_cohort,
 )
 from hefl_tpu.obs import metrics as obs_metrics
@@ -113,7 +114,11 @@ def synthetic_rows(n_rows: int, seed: int, shape=_ROW_SHAPE) -> np.ndarray:
 
 
 def _pctl(xs, q: float) -> float:
-    return float(np.percentile(np.asarray(xs, np.float64), q)) if len(xs) else 0.0
+    """Delegates to the ONE shared percentile implementation (ISSUE 20:
+    `obs.metrics.exact_percentile`, the same math `Histogram.quantile`'s
+    small-N reservoir path uses) so BENCH_LOAD and the first-class span
+    metrics cannot drift."""
+    return obs_metrics.exact_percentile(xs, q)
 
 
 def _p_broadcast() -> np.ndarray:
@@ -279,6 +284,85 @@ def _file_sha(path: str) -> str:
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Commit-latency percentiles vs (cohort, quorum): the swept family.
+# ---------------------------------------------------------------------------
+
+# The default sweep grid: two cohort sizes x two quorum fractions (>= 3
+# points is the artifact gate; 4 gives both axes). Every point rides the
+# same fault schedule language as the main trace.
+_SWEEP_POINTS = ((256, 0.5), (256, 0.9), (512, 0.5), (512, 0.9))
+
+
+def commit_latency_sweep(
+    cfg: LoadConfig | None = None, points=_SWEEP_POINTS, rounds: int = 4
+) -> dict:
+    """Commit-latency percentiles as a FAMILY over (cohort, quorum)
+    points (ROADMAP: "commit-latency percentiles vs cohort size/quorum
+    as a swept family").
+
+    Per point: `rounds` deterministic `_round_trace` rounds at that
+    cohort size; the round's commit latency is the VIRTUAL arrival time
+    of the quorum-th fresh (non-stale, deduped) delivery — the same
+    quantity the engine's `stream.commit_latency_s` histogram observes —
+    and a round whose fresh deliveries never reach quorum contributes
+    nothing (it would have degraded). Percentiles go through the shared
+    `obs.metrics.Histogram.quantile` path (exact at these counts: the
+    reservoir covers them). Gates: >= 3 points, every point committed at
+    least once, and p50 <= p95 <= p99 per point."""
+    from hefl_tpu.fl.stream import _COMMIT_LATENCY_BUCKETS
+
+    cfg = cfg or LoadConfig.smoke()
+    out = []
+    for cohort_size, q_frac in points:
+        pt_cfg = dataclasses.replace(
+            cfg, cohort_size=int(cohort_size), rounds=int(rounds)
+        )
+        s = StreamConfig(
+            cohort_size=int(cohort_size), seed=pt_cfg.seed,
+            staleness_rounds=pt_cfg.staleness_rounds, quorum=float(q_frac),
+        )
+        hist = obs_metrics.Histogram(bounds=_COMMIT_LATENCY_BUCKETS)
+        committed = 0
+        for r in range(int(rounds)):
+            cohort, deliveries = _round_trace(pt_cfg, r)
+            qcount = quorum_count(s, len(cohort))
+            seen: set = set()
+            nth = 0
+            for t, _c, nonce, stale in deliveries:   # already time-sorted
+                if stale or nonce in seen:
+                    continue
+                seen.add(nonce)
+                nth += 1
+                if nth >= qcount:
+                    hist.observe(float(t))
+                    committed += 1
+                    break
+        p50, p95, p99 = (hist.quantile(q) for q in (0.50, 0.95, 0.99))
+        out.append({
+            "cohort_size": int(cohort_size),
+            "quorum": float(q_frac),
+            "rounds": int(rounds),
+            "committed_rounds": int(committed),
+            "commit_latency_s": {
+                "p50": round(p50, 6),
+                "p95": round(p95, 6),
+                "p99": round(p99, 6),
+            },
+        })
+    ok = (
+        len(out) >= 3
+        and all(p["committed_rounds"] >= 1 for p in out)
+        and all(
+            p["commit_latency_s"]["p50"]
+            <= p["commit_latency_s"]["p95"]
+            <= p["commit_latency_s"]["p99"]
+            for p in out
+        )
+    )
+    return {"points": out, "num_points": len(out), "ok": bool(ok)}
 
 
 # ---------------------------------------------------------------------------
@@ -560,12 +644,18 @@ def _main() -> int:
                     help="CI-budget trace (10**4 clients)")
     ap.add_argument("--clients", type=int, default=0,
                     help="override registry size (e.g. 1000000)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="add the commit-latency-percentiles-vs-(cohort, "
+                         "quorum) family (>= 3 points) to the artifact")
     args = ap.parse_args()
     cfg = LoadConfig.smoke() if args.smoke else LoadConfig()
     if args.clients:
         cfg = dataclasses.replace(cfg, num_clients=int(args.clients))
     t0 = time.perf_counter()
     rec = bench_load_record(cfg)
+    if args.sweep:
+        rec["commit_latency_sweep"] = commit_latency_sweep(cfg)
+        rec["ok"] = bool(rec["ok"] and rec["commit_latency_sweep"]["ok"])
     rec["wall_seconds"] = round(time.perf_counter() - t0, 3)
     artifact = {
         "bench_load": rec,
@@ -594,6 +684,7 @@ __all__ = [
     "LoadConfig",
     "bench_load_record",
     "bench_load_smoke_record",
+    "commit_latency_sweep",
     "drive_trace",
     "ef_packing_record",
     "fold_throughput_record",

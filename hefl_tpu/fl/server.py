@@ -55,6 +55,7 @@ from hefl_tpu.fl.stream import (
 )
 from hefl_tpu.obs import events as obs_events
 from hefl_tpu.obs import metrics as obs_metrics
+from hefl_tpu.obs import spans as obs_spans
 
 # Recovery-latency histogram bounds (seconds): journal replay is
 # host-side numpy work, so sub-second is the healthy regime.
@@ -296,6 +297,16 @@ class AggregationServer:
                 sess.replayed_folds
             )
             obs_metrics.counter("recovery.rounds_replayed").inc()
+            tracer = self.engine.last_spans
+            if tracer is not None:
+                # The replay marker (== recovery.rounds_replayed), wall
+                # clock: its presence is exactly what `tree_signature`
+                # ignores when a replayed round is compared against its
+                # uninterrupted twin.
+                tracer.add(
+                    "recovery_replay", 0.0, tracer.wall(), clock="wall",
+                    records=len(replay), refolded=int(sess.replayed_folds),
+                )
         return out
 
     def compact_to(self, round_index: int) -> tuple[int, int]:

@@ -53,6 +53,7 @@ O(1) either way.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import math
@@ -94,6 +95,7 @@ from hefl_tpu.fl.fedavg import (
 from hefl_tpu.obs import events as obs_events
 from hefl_tpu.obs import metrics as obs_metrics
 from hefl_tpu.obs import scopes as obs_scopes
+from hefl_tpu.obs import spans as obs_spans
 from hefl_tpu.parallel import (
     client_axes,
     client_mesh_size,
@@ -109,6 +111,17 @@ _REJECT_MASK = EXCLUDED_NONFINITE | EXCLUDED_NORM | EXCLUDED_OVERFLOW
 
 # The staleness histogram ("rounds late" per folded upload) uses the
 # registry's default bucket bounds — one source, obs.metrics.
+
+# First-class latency distributions (ISSUE 20): commit latency is the
+# virtual seconds from round open to the quorum-th fresh fold;
+# arrival-to-fold is each folded upload's position on the same axis (how
+# long into the round it landed — retries and stale carries push the
+# tail). Both are virtual-clock seconds, so the bounds track the fault
+# schedules' arrival spreads, not process wall time.
+_COMMIT_LATENCY_BUCKETS = (
+    0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0
+)
+_ARRIVAL_TO_FOLD_BUCKETS = _COMMIT_LATENCY_BUCKETS
 
 
 # ---------------------------------------------------------------------------
@@ -1001,6 +1014,10 @@ class StreamEngine:
         # _pending/_seen — a round that dies mid-execution leaves the
         # previous residuals intact for the retry.
         self._ef_residual: np.ndarray | None = None
+        # The most recent round's span tree (ISSUE 20): run_round installs
+        # one SpanTracer per round; drivers collect these for the Chrome
+        # trace export. Not cross-round state — purely observational.
+        self.last_spans: obs_spans.SpanTracer | None = None
 
     # -- deterministic retry timeline --------------------------------------
 
@@ -1058,10 +1075,18 @@ class StreamEngine:
             ids = np.asarray(client_ids, dtype=np.int64)
             keys = np.asarray(keys)[ids]
             enc_keys = enc_keys[jnp.asarray(ids)]
-        tc, pad = hhe_transcipher.transcipher_batch(
-            ctx, packing, pk, jnp.asarray(w_hi_dev), jnp.asarray(w_lo_dev),
-            keys, round_index, enc_keys,
-        )
+        tracer = obs_spans.current()
+        with (
+            tracer.measure(
+                "transcipher", uploads=int(np.asarray(w_hi_dev).shape[0])
+            )
+            if tracer is not None
+            else contextlib.nullcontext()
+        ):
+            tc, pad = hhe_transcipher.transcipher_batch(
+                ctx, packing, pk, jnp.asarray(w_hi_dev),
+                jnp.asarray(w_lo_dev), keys, round_index, enc_keys,
+            )
         rd = None
         if journaled:
             rd = _HheRound(
@@ -1080,6 +1105,36 @@ class StreamEngine:
     # -- one round ---------------------------------------------------------
 
     def run_round(
+        self,
+        module,
+        cfg: TrainConfig,
+        mesh,
+        ctx,
+        pk,
+        global_params,
+        xs,
+        ys,
+        key,
+        round_index: int,
+        dp=None,
+        packing=None,
+        num_real_clients: int | None = None,
+        session=None,
+        hhe=None,
+    ):
+        """Traced entry point: installs one `obs.spans.SpanTracer` for the
+        round (kept as `self.last_spans` for exporters), then runs
+        `_run_round_body` — see its docstring for the full contract."""
+        tracer = obs_spans.SpanTracer(int(round_index))
+        self.last_spans = tracer
+        with obs_spans.activate(tracer):
+            return self._run_round_body(
+                module, cfg, mesh, ctx, pk, global_params, xs, ys, key,
+                round_index, dp=dp, packing=packing,
+                num_real_clients=num_real_clients, session=session, hhe=hhe,
+            )
+
+    def _run_round_body(
         self,
         module,
         cfg: TrainConfig,
@@ -1120,6 +1175,7 @@ class StreamEngine:
         ciphertext bytes (the wire artifact) and replay re-transciphers
         them. `hhe` (fl.config.HheConfig) supplies the key-derivation
         knobs; omitted = defaults."""
+        tracer = obs_spans.current()
         s = self.stream
         hhe_mode = s.upload_kind == "hhe"
         if hhe_mode and packing is None:
@@ -1380,6 +1436,12 @@ class StreamEngine:
                 if session is not None:
                     for i, rt in enumerate(times):
                         session.retry(round_index, c, nonce, i + 1, rt)
+                if tracer is not None:
+                    for i, rt in enumerate(times):
+                        tracer.add(
+                            "retry", float(rt), client=int(c),
+                            attempt=i + 1, delivered=False,
+                        )
                 bits[c] |= EXCLUDED_UNREACHABLE
                 unreachable += 1
                 continue
@@ -1392,6 +1454,11 @@ class StreamEngine:
                 retries_made += 1
                 if session is not None:
                     session.retry(round_index, c, nonce, 1, retry_at[0])
+                if tracer is not None:
+                    tracer.add(
+                        "retry", float(retry_at[0]), client=int(c),
+                        attempt=1, delivered=True,
+                    )
                 events.append(_Delivery(
                     t=float(retry_at[0]), seq=seq, kind="fresh", client=int(c),
                     nonce=nonce, retried=True,
@@ -1470,6 +1537,15 @@ class StreamEngine:
                     tier_stale_clients.extend(int(c) for c in tp.clients)
                     for tc in tp.clients:
                         bits[int(tc)] &= ~EXCLUDED_UNSAMPLED
+                    if tracer is not None:
+                        # Carried partials fold before any arrival — a
+                        # point span at the round's virtual origin.
+                        tracer.add(
+                            "tier_fold", 0.0, host=int(tp.host),
+                            origin_round=int(tp.origin_round),
+                            clients=len(tp.clients),
+                            lateness=int(tp.lateness),
+                        )
         staleness_hist = obs_metrics.histogram("stream.staleness_rounds")
         committed_at: float | None = None
         fresh = stale_folded = arrivals = rejected = 0
@@ -1504,6 +1580,15 @@ class StreamEngine:
                     stale_folded += 1
                     folded_clients.append(up.client)
                     stale_used.append((up, ev.t))
+                    if tracer is not None:
+                        tracer.add(
+                            "fold", ev.t, client=int(up.client),
+                            src="stale", lateness=int(up.lateness),
+                        )
+                    obs_metrics.histogram(
+                        "stream.arrival_to_fold_s",
+                        bounds=_ARRIVAL_TO_FOLD_BUCKETS,
+                    ).observe(round(max(0.0, float(ev.t)), 9))
                     # The client participates via its late upload; clear
                     # ONLY the not-in-this-cohort attribution — same-round
                     # fresh-upload causes (nonfinite, unreachable, ...)
@@ -1528,6 +1613,11 @@ class StreamEngine:
                 if session is not None:
                     session.dedup(round_index, ev.seq, ev.client, ev.nonce)
                 acc.duplicates += 1
+                if tracer is not None:
+                    tracer.add(
+                        "arrival", ev.t, client=int(ev.client),
+                        outcome="duplicate", retried=bool(ev.retried),
+                    )
                 continue
             seen.add(ev.nonce)
             c = ev.client
@@ -1535,6 +1625,11 @@ class StreamEngine:
                 if session is not None:
                     session.reject(round_index, ev.seq, c, ev.nonce)
                 rejected += 1
+                if tracer is not None:
+                    tracer.add(
+                        "arrival", ev.t, client=int(c),
+                        outcome="rejected", retried=bool(ev.retried),
+                    )
                 continue
             row = int(row_of[c])    # upload row (== c on the full-C path)
             if (
@@ -1573,6 +1668,19 @@ class StreamEngine:
                 folded_clients.append(c)
                 fresh_used.append((c, ev.t))
                 staleness_hist.observe(0)
+                if tracer is not None:
+                    arr_sp = tracer.add(
+                        "arrival", ev.t, client=int(c),
+                        outcome="folded", retried=bool(ev.retried),
+                    )
+                    tracer.add(
+                        "fold", ev.t, parent=arr_sp, client=int(c),
+                        src="fresh",
+                    )
+                obs_metrics.histogram(
+                    "stream.arrival_to_fold_s",
+                    bounds=_ARRIVAL_TO_FOLD_BUCKETS,
+                ).observe(round(max(0.0, float(ev.t)), 9))
                 if fresh >= qcount:
                     committed_at = ev.t
             else:
@@ -1585,6 +1693,11 @@ class StreamEngine:
                 missed.append((
                     "fresh", c, ev.t, 0, c0[row], c1[row], ev.nonce,
                 ))
+                if tracer is not None:
+                    tracer.add(
+                        "arrival", ev.t, client=int(c),
+                        outcome="missed", retried=bool(ev.retried),
+                    )
         committed = committed_at is not None
         commit_s = (
             committed_at
@@ -1687,6 +1800,19 @@ class StreamEngine:
         surviving = 0
         if committed:
             surviving = int(released if released is not None else acc.folded)
+        if tracer is not None:
+            # The round verdict as a point span at the commit time — after
+            # every re-take (host quorum, dp floor), so args carry the
+            # FINAL outcome the session journals below.
+            tracer.add(
+                "commit", float(commit_s), committed=bool(committed),
+                degraded_reason=degraded_reason, surviving=int(surviving),
+                fresh=int(fresh), quorum=int(qcount),
+            )
+        if committed:
+            obs_metrics.histogram(
+                "stream.commit_latency_s", bounds=_COMMIT_LATENCY_BUCKETS
+            ).observe(round(float(commit_s), 9))
         if session is not None:
             # The transaction's verdict record. On replay the re-derived
             # canonical-sum sha256 must MATCH the journaled one — the
@@ -1840,6 +1966,7 @@ class StreamEngine:
         obs_metrics.counter("stream.arrivals").inc(arrivals)
         obs_metrics.counter("stream.duplicates").inc(acc.duplicates)
         obs_metrics.counter("stream.rejected").inc(rejected)
+        obs_metrics.counter("stream.folds").inc(fresh + stale_folded)
         obs_metrics.counter("stream.retries").inc(retries_made)
         obs_metrics.counter("stream.late_carried").inc(carried)
         obs_metrics.counter("stream.stale_excluded").inc(stale_excluded)
@@ -1921,4 +2048,12 @@ class StreamEngine:
         ct_sum = Ciphertext(
             c0=jnp.asarray(sum_c0), c1=jnp.asarray(sum_c1), scale=cts.scale
         )
+        if tracer is not None:
+            # Seal the root over everything on the virtual clock: the last
+            # arrival, the commit point, and (hierarchical rounds) the
+            # ship phase's landing horizon.
+            tracer.finish(max(
+                float(commit_s), float(last_t),
+                float(getattr(acc, "ships_done_s", 0.0) or 0.0),
+            ))
         return ct_sum, mets, overflow, smeta

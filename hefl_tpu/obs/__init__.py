@@ -16,18 +16,28 @@ Three legs, one subsystem (ISSUE 5):
     counter/gauge registry (exclusions by cause, retries, resumes,
     autoselect outcomes, XLA new-executable count, device-memory
     high-water) embedded in every bench/profile/chaos artifact.
+  * `obs.spans` / `obs.trend` (ISSUE 20) — per-round lifecycle span
+    trees on the engine's virtual clock (arrival/fold/ship/commit/
+    recovery, exported as Chrome trace-viewer JSON `obs.trace` can load)
+    and the bench-history trend gate (`python -m hefl_tpu.obs.trend`)
+    that turns the committed BENCH_*.json trajectory into TREND.md and a
+    regression check.
 """
 
-from hefl_tpu.obs import events, metrics, scopes, trace
+from hefl_tpu.obs import events, metrics, scopes, spans, trace, trend
 from hefl_tpu.obs.events import EventLog
+from hefl_tpu.obs.spans import SpanTracer
 from hefl_tpu.obs.trace import TraceParseError, trace_attribution
 
 __all__ = [
     "events",
     "metrics",
     "scopes",
+    "spans",
     "trace",
+    "trend",
     "EventLog",
+    "SpanTracer",
     "TraceParseError",
     "trace_attribution",
 ]

@@ -22,6 +22,7 @@ as a queryable metric instead of a test-only lru_cache inspection.
 
 from __future__ import annotations
 
+import math
 import threading
 from typing import Any
 
@@ -55,6 +56,30 @@ class Gauge:
 
 DEFAULT_HISTOGRAM_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0)
 
+# First-N exact sample reservoir per histogram: below this many
+# observations `quantile` is EXACT (linear interpolation over the kept
+# samples); past it, estimation falls back to the cumulative buckets.
+# Deterministic (first N, no sampling) so tests and replayed rounds see
+# identical percentiles.
+RESERVOIR_SIZE = 512
+
+
+def exact_percentile(xs, q: float) -> float:
+    """The q-th percentile (q in [0, 100]) of a sample list by linear
+    interpolation — the ONE percentile implementation the load harness,
+    the histogram small-N path, and the bench sweeps all share (ISSUE 20
+    satellite: `fl/load.py::_pctl` delegates here). Empty input -> 0.0."""
+    xs = sorted(float(v) for v in xs)
+    if not xs:
+        return 0.0
+    if len(xs) == 1:
+        return xs[0]
+    pos = (float(q) / 100.0) * (len(xs) - 1)
+    lo = max(0, min(len(xs) - 1, int(pos)))
+    hi = min(len(xs) - 1, lo + 1)
+    frac = pos - lo
+    return xs[lo] * (1.0 - frac) + xs[hi] * frac
+
 
 class Histogram:
     """Cumulative bucket counts over fixed upper bounds (plus +inf).
@@ -64,24 +89,89 @@ class Histogram:
     upload"). `observe(v)` increments every bucket whose bound is >= v
     (Prometheus-style cumulative buckets), so `value` is JSON-ready:
     {"le_1": n, ..., "le_inf": n, "count": n, "sum": s}.
+
+    `quantile(q)` (q in [0, 1]) is exact while the first-N reservoir
+    still covers every observation, and cumulative-bucket interpolation
+    (Prometheus `histogram_quantile` style: error bounded by the bucket
+    width the quantile lands in) beyond it.
     """
 
-    __slots__ = ("bounds", "counts", "count", "sum")
+    __slots__ = ("bounds", "counts", "count", "sum", "samples")
 
     def __init__(self, bounds: tuple = DEFAULT_HISTOGRAM_BUCKETS) -> None:
         self.bounds = tuple(float(b) for b in bounds)
         self.counts = [0] * (len(self.bounds) + 1)   # + the inf bucket
         self.count = 0
         self.sum: float = 0.0
+        self.samples: list[float] = []   # first-N exact reservoir
 
     def observe(self, v: float) -> None:
         v = float(v)
         self.count += 1
         self.sum += v
+        if len(self.samples) < RESERVOIR_SIZE:
+            self.samples.append(v)
         for i, b in enumerate(self.bounds):
             if v <= b:
                 self.counts[i] += 1
         self.counts[-1] += 1
+
+    def quantile(self, q: float) -> float:
+        """The q-th quantile (q in [0, 1]) of everything observed.
+        Exact (reservoir) while count <= RESERVOIR_SIZE; bucket
+        interpolation past it. Empty histogram -> 0.0."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile q={q}: must be in [0, 1]")
+        if self.count == 0:
+            return 0.0
+        if self.count <= len(self.samples):
+            return exact_percentile(self.samples, q * 100.0)
+        return self._bucket_quantile(
+            q, self.bounds, self.counts, self.count, self.sum
+        )
+
+    @staticmethod
+    def _bucket_quantile(q, bounds, counts, count, total) -> float:
+        """Cumulative-bucket estimation: find the first bucket whose
+        cumulative count reaches rank ceil(q*count) and interpolate
+        linearly inside it (Prometheus histogram_quantile). A rank in
+        the +inf bucket clamps to max(highest bound, mean) — the same
+        bounded lie Prometheus reports rather than an unbounded guess."""
+        rank = max(1, math.ceil(q * count))
+        prev_b, prev_c = None, 0
+        for i, b in enumerate(bounds):
+            c = counts[i]
+            if c >= rank:
+                lo = prev_b if prev_b is not None else min(0.0, b)
+                inb = c - prev_c
+                if inb <= 0:
+                    return b
+                return lo + (b - lo) * (rank - prev_c) / inb
+            prev_b, prev_c = b, c
+        top = bounds[-1] if bounds else 0.0
+        return max(top, total / count)
+
+    @staticmethod
+    def quantile_of(value: dict, q: float) -> float:
+        """`quantile` over a snapshot()/snapshot_delta()-shaped histogram
+        dict ({"le_X": n, ..., "le_inf": n, "count": n, "sum": s}) — the
+        per-run view: a delta dict carries no reservoir, so this is
+        always the bucket estimate. Empty/zero-count dict -> 0.0."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile q={q}: must be in [0, 1]")
+        count = int(value.get("count", 0) or 0)
+        if count <= 0:
+            return 0.0
+        pairs = []
+        for k, v in value.items():
+            if k.startswith("le_") and k != "le_inf":
+                pairs.append((float(k[3:]), int(v or 0)))
+        pairs.sort()
+        bounds = tuple(b for b, _ in pairs)
+        counts = [c for _, c in pairs] + [count]
+        return Histogram._bucket_quantile(
+            q, bounds, counts, count, float(value.get("sum", 0.0) or 0.0)
+        )
 
     @staticmethod
     def _label(b: float) -> str:

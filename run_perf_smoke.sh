@@ -79,7 +79,15 @@
 #       to the unbatched twin with fsyncs/round <= 1/10 of
 #       fsync_policy=always, vectorized fold ingest sha-equal to the
 #       sequential fold, dedup-window peak within the (tau+2)*cohort
-#       bound, and the folds/s + appends-per-fsync throughput floors.
+#       bound, and the folds/s + appends-per-fsync throughput floors;
+#   (q) round-lifecycle spans + latency percentiles (ISSUE 20): a faulty
+#       streaming round must export a Chrome-trace-viewer-loadable span
+#       timeline (hefl.span.* names) whose per-kind span counts equal
+#       the stream.*/dcn.*/journal.* counter deltas EXACTLY
+#       (obs.spans.conservation_errors == []), and the BENCH_LOAD smoke
+#       artifact (now run with --sweep) must carry the commit-latency-
+#       percentiles-vs-(cohort, quorum) family: >= 3 points, every point
+#       committed with p50 <= p95 <= p99.
 # Wired into run_tpu_suite.sh as stage 0 (cheap pre-stage, no backend
 # probe needed — both harnesses pin themselves to CPU in smoke mode).
 set -euo pipefail
@@ -329,7 +337,7 @@ PY
 # bound, EF geometry); the schema gate below adds the CI throughput
 # floors so a silent order-of-magnitude regression in the hot path
 # cannot ship with a green artifact.
-JAX_PLATFORMS=cpu python -m hefl_tpu.fl.load --smoke \
+JAX_PLATFORMS=cpu python -m hefl_tpu.fl.load --smoke --sweep \
   --out "$workdir/BENCH_LOAD_SMOKE.json" > "$workdir/load_smoke.out" || {
   echo "PERF SMOKE FAILED: BENCH_LOAD gates (sha equality / fsync ratio):"
   tail -20 "$workdir/load_smoke.out"
@@ -414,6 +422,136 @@ print(
     f"{appends} appends / {fsyncs} fsyncs, "
     f"ef_bytes={ef.get('bytes_ratio_b4_vs_b8')} (budget 0.55)"
 )
+PY
+
+# (q) round-lifecycle spans (ISSUE 20): drive one faulty streaming round
+# with span tracing on, export the Chrome trace, and gate BOTH halves of
+# the contract — the exported timeline loads through the repo's own
+# trace parser with hefl.span.* names, and the per-kind span counts
+# equal the counter deltas exactly. Then schema-gate the sweep family
+# stage (p) just wrote into BENCH_LOAD_SMOKE.json.
+JAX_PLATFORMS=cpu python - "$workdir" <<'PY'
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from hefl_tpu.ckks.keys import CkksContext, keygen
+from hefl_tpu.data import iid_contiguous, make_dataset, stack_federated
+from hefl_tpu.fl import FaultConfig, StreamConfig, StreamEngine, TrainConfig
+from hefl_tpu.models import SmallCNN
+from hefl_tpu.obs import metrics as obs_metrics
+from hefl_tpu.obs import spans as obs_spans
+from hefl_tpu.obs import trace as obs_trace
+from hefl_tpu.parallel import make_mesh
+
+workdir = sys.argv[1]
+fail = []
+num_clients = 8
+n = num_clients * 8
+(x, y), _, _ = make_dataset("mnist", seed=0, n_train=n, n_test=8)
+xs, ys = stack_federated(x, y, iid_contiguous(n, num_clients))
+model = SmallCNN(num_classes=10)
+params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+mesh = make_mesh(num_clients)
+ctx = CkksContext.create(n=256)
+_, pk = keygen(ctx, jax.random.key(1))
+cfg = TrainConfig(epochs=1, batch_size=4, num_classes=10, augment=False,
+                  val_fraction=0.25)
+eng = StreamEngine(
+    StreamConfig(quorum=0.75, staleness_rounds=1, seed=3, deadline_s=20.0),
+    FaultConfig(seed=5, straggler_fraction=0.3, straggler_delay_s=30.0,
+                duplicate_clients=1, transient_fail_clients=1),
+)
+tracers = []
+for r in range(2):
+    base = obs_metrics.snapshot()
+    _, _, _, sm = eng.run_round(
+        model, cfg, mesh, ctx, pk, params, jnp.asarray(xs), jnp.asarray(ys),
+        jax.random.key(100 + r), r,
+    )
+    delta = obs_metrics.snapshot_delta(base)
+    tracer = eng.last_spans
+    tracers.append(tracer)
+    errs = obs_spans.conservation_errors(tracer.counts(), delta)
+    for e in errs:
+        fail.append(f"SPANS round {r}: {e}")
+    if tracer.counts().get("fold", 0) != sm.fresh + sm.stale_folded:
+        fail.append(
+            f"SPANS round {r}: fold spans "
+            f"{tracer.counts().get('fold', 0)} != fresh+stale "
+            f"{sm.fresh + sm.stale_folded}"
+        )
+out = f"{workdir}/spans.trace.json.gz"
+obs_spans.export_chrome_trace(out, tracers)
+events = obs_trace.load_trace_events(out)
+want = sum(len(t.spans()) for t in tracers)
+if len(events) != want:
+    fail.append(f"SPANS export: {len(events)} trace events != {want} spans")
+names = {e.get("name") for e in events}
+legal = {f"hefl.span.{k}" for k in obs_spans.SPAN_KINDS}
+if not names <= legal:
+    fail.append(f"SPANS export: illegal names {sorted(names - legal)}")
+for must in ("hefl.span.round", "hefl.span.arrival", "hefl.span.fold",
+             "hefl.span.commit"):
+    if must not in names:
+        fail.append(f"SPANS export: {must} missing from the timeline")
+for e in events:
+    if e.get("ph") != "X" or not isinstance(e.get("ts"), (int, float)) \
+            or not isinstance(e.get("dur"), (int, float)) or e["dur"] < 0:
+        fail.append(f"SPANS export: malformed event {e.get('name')}")
+        break
+if fail:
+    print("PERF SMOKE FAILED (SPANS stage):")
+    for f in fail:
+        print(" -", f)
+    sys.exit(1)
+print(
+    f"spans smoke OK: 2 faulty rounds conserved "
+    f"({want} spans == counter deltas), export loadable "
+    f"({len(names)} kinds)"
+)
+PY
+
+python - "$workdir/BENCH_LOAD_SMOKE.json" <<'PY'
+import json
+import sys
+
+fail = []
+art = json.load(open(sys.argv[1]))
+sw = (art.get("bench_load") or {}).get("commit_latency_sweep")
+if not isinstance(sw, dict):
+    fail.append("SWEEP: bench_load.commit_latency_sweep missing")
+    sw = {}
+pts = sw.get("points") or []
+if len(pts) < 3:
+    fail.append(f"SWEEP: {len(pts)} points < 3 — not a family")
+if sw.get("ok") is not True:
+    fail.append("SWEEP: family gates not ok")
+combos = set()
+for p in pts:
+    combos.add((p.get("cohort_size"), p.get("quorum")))
+    lat = p.get("commit_latency_s") or {}
+    p50, p95, p99 = lat.get("p50"), lat.get("p95"), lat.get("p99")
+    if not all(isinstance(v, (int, float)) for v in (p50, p95, p99)):
+        fail.append(f"SWEEP: point {p.get('cohort_size')}x"
+                    f"{p.get('quorum')} missing p50/p95/p99")
+    elif not (p50 <= p95 <= p99):
+        fail.append(f"SWEEP: point {p.get('cohort_size')}x"
+                    f"{p.get('quorum')}: p50 {p50} <= p95 {p95} <= "
+                    f"p99 {p99} violated")
+    if not p.get("committed_rounds"):
+        fail.append(f"SWEEP: point {p.get('cohort_size')}x"
+                    f"{p.get('quorum')} committed no rounds")
+if len(combos) != len(pts):
+    fail.append("SWEEP: duplicate (cohort_size, quorum) points")
+if fail:
+    print("PERF SMOKE FAILED (SWEEP stage):")
+    for f in fail:
+        print(" -", f)
+    sys.exit(1)
+print(f"sweep smoke OK: {len(pts)} (cohort, quorum) points, "
+      "p50<=p95<=p99 everywhere")
 PY
 
 # (k) hybrid-HE uplink (ISSUE 11): wire expansion <= 1.1x + the
@@ -835,8 +973,9 @@ print(
     ">=1.5x HE speedups, cohort_compare bitwise-equal with the >=2x "
     "cohort-only floor, BENCH_DCN flat-vs-hier ratio over the "
     "cohort/hosts floor with arrival-order bitwise equality, BENCH_LOAD "
-    "group-commit sha-equal under the fsync + throughput floors, "
-    "hefl-lint clean with analysis.violations=0 embedded in the run "
-    "metrics"
+    "group-commit sha-equal under the fsync + throughput floors with the "
+    "commit-latency sweep family, span timelines conserved against the "
+    "stream counters and trace-viewer loadable, hefl-lint clean with "
+    "analysis.violations=0 embedded in the run metrics"
 )
 PY

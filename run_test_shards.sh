@@ -148,6 +148,22 @@ HEFL_JOURNAL_FSYNC=always python -m pytest -q -m "not slow" \
   tests/test_faults.py tests/test_stream.py tests/test_journal.py \
   -k "link or ship or tier"
 echo "== lossy-DCN shard (fsync=always): $((SECONDS - t0))s"
+# Trend shard (ISSUE 20): the bench-history regression gate, both
+# directions. The committed BENCH_*.json artifacts must pass their own
+# gate (a renamed artifact key zeroes its series and exits 2; a real
+# regression exits 1), and the seeded fixture — appended after the
+# committed history via --extra — must FAIL it, proving the gate can
+# actually fire and is not a rubber stamp.
+t0=$SECONDS
+python -m hefl_tpu.obs.trend --quiet
+if python -m hefl_tpu.obs.trend --quiet \
+    --extra tests/fixtures/BENCH_r99_seeded_regression.json \
+    > /dev/null 2>&1; then
+  echo "TREND SHARD FAILED: the seeded regression fixture did NOT trip" \
+       "the gate — the trend check is a rubber stamp"
+  exit 1
+fi
+echo "== trend gate (clean history + seeded-fixture trip): $((SECONDS - t0))s"
 # Analysis shard (ISSUE 8/12): the FULL static-analysis gate (no --fast)
 # — everything the pre-shard ran plus the scope-coverage stages, which
 # compile the real round programs (both fusion backends + the secure

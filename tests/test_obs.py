@@ -490,3 +490,88 @@ def test_steady_seconds_leaves_long_phases_alone():
 
     roofline.steady_seconds(slow, reps=2, warmup=1)
     assert len(calls) == 3  # warmup + 2 reps, no repetition chain
+
+
+# ------------------------------------------------------- quantiles (ISSUE 20)
+
+
+def test_histogram_quantile_empty_single_and_validation():
+    from hefl_tpu.obs.metrics import Histogram, exact_percentile
+
+    h = Histogram(bounds=(1.0, 2.0, 4.0))
+    assert h.quantile(0.5) == 0.0          # empty -> 0.0, not an error
+    h.observe(3.25)
+    for q in (0.0, 0.5, 1.0):
+        assert h.quantile(q) == 3.25       # single sample: every quantile
+    with pytest.raises(ValueError, match="quantile"):
+        h.quantile(1.5)
+    with pytest.raises(ValueError, match="quantile"):
+        h.quantile(-0.1)
+    assert exact_percentile([], 50) == 0.0
+    assert exact_percentile([7.0], 99) == 7.0
+
+
+def test_histogram_quantile_exact_matches_shared_percentile():
+    # While the reservoir covers every observation, quantile() is EXACT —
+    # bitwise the shared exact_percentile (the one _pctl delegates to).
+    from hefl_tpu.obs.metrics import Histogram, exact_percentile
+
+    rng = np.random.default_rng(7)
+    xs = rng.uniform(0.0, 8.0, size=200).tolist()
+    h = Histogram(bounds=(1.0, 2.0, 4.0, 8.0))
+    for v in xs:
+        h.observe(v)
+    for q in (0.05, 0.5, 0.95, 0.99):
+        assert h.quantile(q) == exact_percentile(xs, q * 100.0)
+        # and the shared path IS np.percentile's linear interpolation
+        assert abs(
+            h.quantile(q) - float(np.percentile(np.asarray(xs), q * 100))
+        ) < 1e-9
+
+
+def test_histogram_quantile_reservoir_vs_bucket_agreement():
+    # Past RESERVOIR_SIZE the estimate falls back to cumulative-bucket
+    # interpolation; its error is bounded by the bucket width the
+    # quantile lands in (the declared contract).
+    from hefl_tpu.obs.metrics import RESERVOIR_SIZE, Histogram
+
+    bounds = tuple(float(b) for b in range(1, 11))   # width-1 buckets
+    rng = np.random.default_rng(11)
+    xs = rng.uniform(0.0, 10.0, size=RESERVOIR_SIZE * 4)
+    h = Histogram(bounds=bounds)
+    for v in xs:
+        h.observe(float(v))
+    assert h.count > RESERVOIR_SIZE     # bucket path engaged
+    for q in (0.1, 0.5, 0.9):
+        est = h.quantile(q)
+        exact = float(np.percentile(xs, q * 100))
+        assert abs(est - exact) <= 1.0  # <= one bucket width
+    # +inf-bucket rank clamps to max(top bound, mean), never unbounded
+    h2 = Histogram(bounds=(1.0,))
+    for v in (0.5, 50.0, 50.0):
+        h2.observe(v)
+    for v in np.linspace(0.1, 0.9, RESERVOIR_SIZE).tolist():
+        h2.observe(v)
+    assert h2.quantile(1.0) == max(1.0, h2.sum / h2.count)
+
+
+def test_histogram_quantile_of_snapshot_delta():
+    # The per-run view: a snapshot_delta dict carries buckets only (no
+    # reservoir), so quantile_of is the bucket estimate over exactly the
+    # run's observations — earlier runs subtracted out.
+    from hefl_tpu.obs.metrics import Histogram, MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", (1.0, 2.0, 4.0))
+    for v in (0.5, 0.5, 3.0):           # an earlier run's observations
+        h.observe(v)
+    base = reg.snapshot()
+    for v in (1.5, 1.5, 1.5, 3.5):
+        h.observe(v)
+    delta = reg.snapshot_delta(base)["lat"]
+    assert delta["count"] == 4
+    q50 = Histogram.quantile_of(delta, 0.5)
+    assert 1.0 <= q50 <= 2.0            # the (1, 2] bucket, not (0, 1]
+    assert Histogram.quantile_of({"count": 0}, 0.5) == 0.0
+    with pytest.raises(ValueError, match="quantile"):
+        Histogram.quantile_of(delta, 2.0)
